@@ -1,0 +1,34 @@
+"""jepsen_etcd_demo_tpu — a TPU-native distributed-systems correctness harness.
+
+Brand-new framework with the capabilities of the Jepsen etcd tutorial demo
+(reference: /root/reference, `dovidio/jepsen-etcd-demo`): orchestrate a real
+etcd cluster, drive concurrent read/write/CAS and grow-only-set workloads
+through composable operation generators while a nemesis injects network
+partitions, record the full concurrent history, and verify it — linearizability
+against a CAS-register model, set durability, perf charts, HTML timeline —
+persisting every run to a browsable store.
+
+The defining difference from the reference: the linearizability checker's
+Wing–Gong state-space search runs as a vmapped, mesh-shardable JAX/XLA kernel
+(see `ops.wgl` and `parallel/`) instead of knossos's JVM search, behind the
+same pluggable Checker seam (reference seam: jepsen.checker/Checker, invoked
+at src/jepsen/etcdemo.clj:115-119).
+
+Layout (see SURVEY.md §7 for the build plan; subpackages land in this order):
+  ops/        history core: op records, pairing, tensor encoding, JAX WGL kernel
+  models/     state-machine models (register, cas-register, grow-only set)
+  checkers/   Checker protocol + linearizable / set / perf / timeline / compose / independent
+  parallel/   device mesh, batched + frontier-sharded checker execution
+  generators/ pure operation-scheduling combinators (mix/stagger/limit/phases/...)
+  clients/    Client protocol, etcd v2 HTTP client, hermetic in-memory KV
+  db/         DB lifecycle protocol, etcd daemon orchestration, fake DB
+  nemesis/    fault injection (partition-random-halves, fake partitions)
+  control/    remote control plane (SSH runner, local runner, daemon helpers)
+  runner/     the core run loop (workers, history recorder, phases)
+  store/      on-disk run persistence (store/<name>/<ts>/ + latest/current)
+  cli/        command line entry (test / analyze / serve)
+  web/        HTTP browser over the store
+  utils/      clocks, logging, misc
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,14 @@
+"""Checker protocol + concrete checkers.
+
+Equivalent of jepsen.checker as exercised by the reference: compose
+(src/jepsen/etcdemo.clj:115-119,165-167), linearizable (:117), set
+(src/jepsen/etcdemo/set.clj:46), perf (:166), timeline (:119), independent
+(:115). A checker is a pure function of the recorded history.
+"""
+
+from .base import Checker, CheckerError  # noqa: F401
+from .compose import Compose  # noqa: F401
+from .linearizable import Linearizable  # noqa: F401
+from .set_checker import SetChecker  # noqa: F401
+from .independent import IndependentChecker  # noqa: F401
+from .oracle import check_events_oracle, brute_force_check  # noqa: F401

@@ -1,0 +1,38 @@
+"""The Checker seam: one method, pure function of the recorded history.
+
+Mirrors jepsen.checker/Checker — check(test, history, opts) -> map with
+:valid? (reference call sites: src/jepsen/etcdemo.clj:115-119,165-167). The
+TPU linearizable checker plugs in behind this exact seam so test composition
+is untouched (BASELINE.json north star).
+
+`valid` is tri-state like jepsen's: True, False, or "unknown" (e.g. frontier
+overflow / nothing to check).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Sequence
+
+from ..ops.op import Op
+
+
+class CheckerError(Exception):
+    pass
+
+
+class Checker(abc.ABC):
+    @abc.abstractmethod
+    def check(self, test: dict, history: Sequence[Op],
+              opts: dict | None = None) -> dict[str, Any]:
+        """Return at least {"valid": True|False|"unknown"}."""
+
+
+def merge_valid(vs: list) -> Any:
+    """jepsen's validity merge: all true -> true; any false -> false;
+    otherwise unknown."""
+    if any(v is False for v in vs):
+        return False
+    if all(v is True for v in vs):
+        return True
+    return "unknown"

@@ -1,0 +1,138 @@
+"""Independent-keys checker — equivalent of `independent/checker`.
+
+The reference lifts a single-register workload onto many independent keys:
+values become (key, value) tuples (src/jepsen/etcdemo.clj:90), and
+`independent/checker` splits the history per key and runs the sub-checker on
+each (src/jepsen/etcdemo.clj:115).
+
+TPU twist: when the sub-checker is a `Linearizable` with the JAX backend — or
+a `Compose` whose direct entries include one — all per-key histories are
+encoded, padded to a common event length, stacked, and checked in ONE vmapped
+kernel launch; per-key histories are embarrassingly parallel, so the key axis
+is the batch axis (BASELINE.json configs[2]). Each distinct Linearizable
+entry gets its own batched launch under its own result name; every other
+composed checker still runs per key, unbatched.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from .base import Checker, merge_valid
+from .compose import Compose
+from .linearizable import Linearizable
+from ..ops.op import Op, INVOKE
+
+
+def split_by_key(history: Sequence[Op]) -> dict[Any, list[Op]]:
+    """Split a tuple-valued history into per-key sub-histories.
+
+    Invocations carry (key, v) tuples; completions may or may not (e.g. a
+    :write completion keeps the tuple, a timeout :info has whatever the invoke
+    had). Like jepsen.independent, the key is taken from the op's tuple value;
+    completions are routed to the key of their pending invocation.
+    """
+    keyed: dict[Any, list[Op]] = {}
+    key_of_process: dict[Any, Any] = {}
+    for op in history:
+        if op.process == "nemesis":
+            continue
+        if op.type == INVOKE:
+            if not (isinstance(op.value, tuple) and len(op.value) == 2):
+                raise ValueError(
+                    f"independent history op without (key, value) tuple: {op}")
+            k, v = op.value
+            key_of_process[op.process] = k
+        else:
+            k = key_of_process.pop(op.process, None)
+            if k is None:
+                continue
+            v = op.value[1] if (isinstance(op.value, tuple)
+                                and len(op.value) == 2) else op.value
+        sub = Op(type=op.type, f=op.f, value=v, process=op.process,
+                 time=op.time, index=op.index, error=op.error)
+        keyed.setdefault(k, []).append(sub)
+    return keyed
+
+
+class IndependentChecker(Checker):
+    def __init__(self, sub_checker: Checker, batch_jax: bool = True):
+        self.sub_checker = sub_checker
+        self.batch_jax = batch_jax
+
+    def check(self, test: dict, history: Sequence[Op],
+              opts: dict | None = None) -> dict[str, Any]:
+        keyed = split_by_key(history)
+        if not keyed:
+            return {"valid": True, "key_count": 0}
+        keys = sorted(keyed, key=str)
+
+        # Which checkers can ride the batched kernel? Only direct entries:
+        # either the sub-checker itself, or first-level values of a Compose.
+        batchable: dict[str | None, Linearizable] = {}
+        if self.batch_jax and len(keyed) > 1:
+            if (isinstance(self.sub_checker, Linearizable)
+                    and self.sub_checker.backend == "jax"):
+                batchable[None] = self.sub_checker
+            elif isinstance(self.sub_checker, Compose):
+                for name, sub in self.sub_checker.checkers.items():
+                    if isinstance(sub, Linearizable) and sub.backend == "jax":
+                        batchable[name] = sub
+
+        batched: dict[str | None, dict[Any, dict]] = {
+            name: _batched_linearizable(lin, keyed)
+            for name, lin in batchable.items()
+        }
+
+        results: dict[Any, dict] = {}
+        for k in keys:
+            results[k] = self._check_key(test, keyed[k], opts, batched, k)
+        valid = merge_valid([r.get("valid") for r in results.values()])
+        return {"valid": valid, "key_count": len(keyed),
+                "results": {str(k): v for k, v in results.items()}}
+
+    def _check_key(self, test, sub_history, opts, batched, key):
+        def pick(name, checker):
+            pre = batched.get(name, {}).get(key)
+            if pre is not None and pre["valid"] != "unknown":
+                return pre
+            return checker.check(test, sub_history, opts)
+
+        if not isinstance(self.sub_checker, Compose):
+            return pick(None, self.sub_checker)
+        sub_results = {name: pick(name, sub)
+                       for name, sub in self.sub_checker.checkers.items()}
+        return {"valid": merge_valid([r.get("valid")
+                                      for r in sub_results.values()]),
+                **sub_results}
+
+
+def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]]
+                          ) -> dict[Any, dict]:
+    """Encode every key's history, pad to one event length, run one vmapped
+    kernel launch over the key batch."""
+    from ..ops import wgl
+    import jax.numpy as jnp
+
+    encs = {k: lin.encode(h) for k, h in keyed.items()}
+    k_slots = max(e.k_slots for e in encs.values())
+    e_cap = max(1, max(e.events.shape[0] for e in encs.values()))
+    keys = list(encs)
+    stack = np.stack([encs[k].padded_to(e_cap).events for k in keys])
+    check = wgl.cached_batch_checker(lin.model,
+                                     wgl.WGLConfig(k_slots, lin.f_cap))
+    out = {name: np.asarray(v) for name, v in
+           check(jnp.asarray(stack)).items()}
+    results = {}
+    for i, k in enumerate(keys):
+        one = {name: out[name][i].item() for name in out}
+        results[k] = {
+            "valid": wgl.verdict(one),
+            "backend": "jax-batched",
+            "op_count": encs[k].n_ops,
+            "dead_event": one["dead_event"],
+            "max_frontier": one["max_frontier"],
+        }
+    return results

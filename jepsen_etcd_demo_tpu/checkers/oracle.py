@@ -1,0 +1,152 @@
+"""Reference (oracle) linearizability checkers, pure Python/NumPy.
+
+Two independent implementations used for differential testing of the JAX
+kernel (SURVEY.md §4), both consuming the same event encoding as the kernel:
+
+  * `check_events_oracle` — Wing–Gong/Lowe frontier search with set-based
+    dedup. Same algorithmic idea as knossos's :linear algorithm
+    (reference call site src/jepsen/etcdemo.clj:117): maintain the set of
+    (model-state, linearized-bitmask) configurations; expand closure under
+    firing pending ops; at each return, keep only configurations that have
+    linearized the returning op.
+
+  * `brute_force_check` — enumerate every linearization order consistent with
+    the event stream (exponential; tiny histories only). Ground truth for the
+    oracle itself.
+
+Both treat `info` ops exactly like knossos: pending forever, may fire at any
+later point, never required to fire (reference :info mapping at
+src/jepsen/etcdemo.clj:100-102).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..models.base import Model
+from ..ops.encode import EncodedHistory, EV_INVOKE, EV_RETURN, EV_PAD
+
+
+@dataclass
+class OracleResult:
+    valid: bool
+    dead_event: int = -1       # first event index where the frontier emptied
+    max_frontier: int = 0      # high-water mark of |frontier|
+    configs_explored: int = 0
+
+    def to_dict(self):
+        return {
+            "valid": self.valid,
+            "dead_event": self.dead_event,
+            "max_frontier": self.max_frontier,
+            "configs_explored": self.configs_explored,
+        }
+
+
+def check_events_oracle(enc: EncodedHistory, model: Model) -> OracleResult:
+    events = np.asarray(enc.events)
+    slots: dict[int, tuple[int, int, int, int]] = {}
+    frontier: set[tuple[int, int]] = {(int(model.init_state()), 0)}
+    max_frontier = len(frontier)
+    explored = 0
+
+    def closure(configs: set[tuple[int, int]]) -> set[tuple[int, int]]:
+        nonlocal explored
+        seen = set(configs)
+        stack = list(configs)
+        while stack:
+            state, mask = stack.pop()
+            for slot, (f, a1, a2, rv) in slots.items():
+                if mask >> slot & 1:
+                    continue
+                legal, nxt = model.step_py(state, f, a1, a2, rv)
+                explored += 1
+                if legal:
+                    cfg = (int(nxt), mask | (1 << slot))
+                    if cfg not in seen:
+                        seen.add(cfg)
+                        stack.append(cfg)
+        return seen
+
+    for i in range(enc.n_events):
+        kind, slot, f, a1, a2, rv = (int(x) for x in events[i])
+        if kind == EV_PAD:
+            continue
+        if kind == EV_INVOKE:
+            slots[slot] = (f, a1, a2, rv)
+        elif kind == EV_RETURN:
+            expanded = closure(frontier)
+            max_frontier = max(max_frontier, len(expanded))
+            bit = 1 << slot
+            frontier = {(s, m & ~bit) for (s, m) in expanded if m & bit}
+            del slots[slot]
+            if not frontier:
+                return OracleResult(False, dead_event=i,
+                                    max_frontier=max_frontier,
+                                    configs_explored=explored)
+        max_frontier = max(max_frontier, len(frontier))
+    return OracleResult(True, max_frontier=max_frontier,
+                        configs_explored=explored)
+
+
+def brute_force_check(enc: EncodedHistory, model: Model,
+                      max_ops: int = 12) -> Optional[bool]:
+    """Exhaustive check by enumerating linearization orders.
+
+    Returns None when the history is too large to enumerate. An op may fire at
+    any point after its EV_INVOKE; ok ops must fire before their EV_RETURN;
+    info ops may fire anytime after invoke or never.
+    """
+    events = np.asarray(enc.events)[: enc.n_events]
+    if enc.n_ops > max_ops:
+        return None
+
+    # Assign each invocation a stable id and find its invoke/return event pos.
+    ops = []           # id -> (f, a1, a2, rv, invoke_pos, return_pos or None)
+    live: dict[int, int] = {}  # slot -> op id
+    for pos, (kind, slot, f, a1, a2, rv) in enumerate(events):
+        if kind == EV_INVOKE:
+            live[int(slot)] = len(ops)
+            ops.append([int(f), int(a1), int(a2), int(rv), pos, None])
+        elif kind == EV_RETURN:
+            ops[live.pop(int(slot))][5] = pos
+
+    n = len(ops)
+    seen: set[tuple[int, int, int]] = set()
+
+    def search(pos: int, fired: int, state: int) -> bool:
+        if (pos, fired, state) in seen:
+            return False
+        seen.add((pos, fired, state))
+        return _search(pos, fired, state)
+
+    def _search(pos: int, fired: int, state: int) -> bool:
+        """Can we schedule linearization points for events[pos:]?"""
+        if pos == len(events):
+            return True
+        # Option: fire any fireable op whose invoke is before `pos` boundary.
+        # We process event boundaries one at a time; between boundaries any
+        # set of pending ops may fire in any order.
+        kind, slot, f, a1, a2, rv = (int(x) for x in events[pos])
+        # Ops eligible to fire *now*: invoked (invoke_pos < pos boundary ...).
+        for i in range(n):
+            fop, fa1, fa2, frv, ipos, rpos = ops[i]
+            if fired >> i & 1:
+                continue
+            if ipos >= pos:
+                continue  # not yet invoked
+            if rpos is not None and rpos < pos:
+                continue  # unreachable: enforced at its return boundary
+            legal, nxt = model.step_py(state, fop, fa1, fa2, frv)
+            if legal and search(pos, fired | (1 << i), int(nxt)):
+                return True
+        if kind == EV_RETURN:
+            i = next(j for j, o in enumerate(ops) if o[5] == pos)
+            if not (fired >> i & 1):
+                return False  # must have fired before returning
+        return search(pos + 1, fired, state)
+
+    return search(0, 0, int(model.init_state()))

@@ -1,0 +1,65 @@
+"""Grow-only-set durability checker — equivalent of `checker/set`.
+
+Reference semantics (src/jepsen/etcdemo/set.clj:46): concurrent :add ops, one
+final :read of the whole set. Every successfully-acknowledged add must appear
+in the final read (lost = failures); elements that appear without ever being
+invoked are corruption. Indeterminate (:info) adds that do appear are
+"recovered"; absent ones are "unsure" (not failures) — matching jepsen's set
+checker accounting.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from .base import Checker
+from ..ops.op import Op, INVOKE, OK, INFO
+
+
+class SetChecker(Checker):
+    def check(self, test: dict, history: Sequence[Op],
+              opts: dict | None = None) -> dict[str, Any]:
+        attempts: set = set()
+        ok_adds: set = set()
+        info_adds: set = set()
+        final_read = None
+        pending: dict[Any, Op] = {}
+        for op in history:
+            if op.type == INVOKE:
+                pending[op.process] = op
+                if op.f == "add":
+                    attempts.add(op.value)
+            else:
+                inv = pending.pop(op.process, None)
+                if inv is None:
+                    continue
+                if inv.f == "add":
+                    if op.type == OK:
+                        ok_adds.add(inv.value)
+                    elif op.type == INFO:
+                        info_adds.add(inv.value)
+                elif inv.f == "read" and op.type == OK:
+                    final_read = set(op.value) if op.value is not None else None
+        # Adds whose completion never arrived are indeterminate too.
+        for inv in pending.values():
+            if inv.f == "add":
+                info_adds.add(inv.value)
+
+        if final_read is None:
+            return {"valid": "unknown", "error": "no final read",
+                    "attempt_count": len(attempts), "ok_count": len(ok_adds)}
+
+        lost = ok_adds - final_read
+        unexpected = final_read - attempts
+        recovered = (final_read & info_adds) - ok_adds
+        valid = not lost and not unexpected
+        return {
+            "valid": valid,
+            "attempt_count": len(attempts),
+            "ok_count": len(ok_adds),
+            "lost_count": len(lost),
+            "lost": sorted(lost)[:100],
+            "unexpected_count": len(unexpected),
+            "unexpected": sorted(unexpected)[:100],
+            "recovered_count": len(recovered),
+        }

@@ -1,0 +1,23 @@
+"""State-machine models checked for linearizability.
+
+The reference checks a CAS register via knossos.model/cas-register
+(src/jepsen/etcdemo.clj:15,117). Models here expose two equivalent step
+functions: `step_py` (Python scalars, used by the oracle checker) and `step`
+(branchless array math, traced into the JAX kernel).
+"""
+
+from .base import Model  # noqa: F401
+from .cas_register import CASRegister  # noqa: F401
+from .register import Register  # noqa: F401
+
+REGISTRY = {
+    "cas-register": CASRegister,
+    "register": Register,
+}
+
+
+def get_model(name: str) -> Model:
+    try:
+        return REGISTRY[name]()
+    except KeyError:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(REGISTRY)}")

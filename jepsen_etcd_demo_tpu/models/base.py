@@ -1,0 +1,45 @@
+"""Model protocol: a deterministic state machine stepped by linearized ops.
+
+Equivalent of knossos.model's Model/step seam (exercised by the reference at
+src/jepsen/etcdemo.clj:117). A step either yields a successor state or is
+illegal (the knossos "inconsistent" result); the checker prunes illegal
+transitions from candidate linearization orders.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Tuple
+
+
+class Model(abc.ABC):
+    """A state machine over int32 scalar states.
+
+    States are int32 scalars so a search frontier is a flat int32 vector.
+    Models with richer state must encode it into one int32 (or a future
+    vector-state extension of the kernel).
+    """
+
+    name: str = "model"
+
+    def cache_key(self) -> tuple:
+        """Hashable identity for jit-compilation caches. Two models with equal
+        cache keys must have identical step semantics."""
+        return (self.name, self.init_state())
+
+    @abc.abstractmethod
+    def init_state(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def step_py(self, state: int, f: int, a1: int, a2: int, rv: int
+                ) -> Tuple[bool, int]:
+        """Python-scalar step: (legal, next_state)."""
+
+    @abc.abstractmethod
+    def step(self, state, f, a1, a2, rv):
+        """Branchless array step: (legal, next_state).
+
+        Must be expressible with arithmetic/where only (no data-dependent
+        Python control flow) so it vmaps and compiles on TPU.
+        """

@@ -1,0 +1,47 @@
+"""CAS register: the model the reference's linearizability check runs over.
+
+Semantics mirror knossos.model/cas-register as exercised by the demo
+(src/jepsen/etcdemo.clj:117; client semantics :83-105):
+  read  — legal iff the current value equals the observed value `rv`
+          (NIL means the key was absent / parse-long of nil, :87-90).
+  write — always legal; sets the value (:92-93).
+  cas   — legal iff current value == old (a1); sets value to new (a2)
+          (:95-98). A cas that returned :fail never reaches the model: failed
+          ops are excluded from the history (encode.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import Model
+from ..ops.encode import NIL, F_READ, F_WRITE, F_CAS
+
+
+class CASRegister(Model):
+    name = "cas-register"
+
+    def __init__(self, initial: int = NIL):
+        self.initial = initial
+
+    def init_state(self) -> int:
+        return self.initial
+
+    def step_py(self, state, f, a1, a2, rv):
+        if f == F_READ:
+            return (state == rv, state)
+        if f == F_WRITE:
+            return (True, a1)
+        if f == F_CAS:
+            return (state == a1, a2 if state == a1 else state)
+        raise ValueError(f"bad f {f}")
+
+    def step(self, state, f, a1, a2, rv):
+        is_read = f == F_READ
+        is_write = f == F_WRITE
+        is_cas = f == F_CAS
+        legal = jnp.where(is_read, state == rv,
+                          jnp.where(is_cas, state == a1, is_write))
+        nxt = jnp.where(is_write, a1,
+                        jnp.where(is_cas & (state == a1), a2, state))
+        return legal, nxt.astype(jnp.int32)

@@ -1,0 +1,37 @@
+"""Plain read/write register (no CAS) — the simplest linearizability model.
+
+Not used by the reference demo directly (it always checks cas-register,
+src/jepsen/etcdemo.clj:117) but part of the knossos model family the checker
+seam supports; useful for tests and for histories without CAS ops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import Model
+from ..ops.encode import NIL, F_READ, F_WRITE
+
+
+class Register(Model):
+    name = "register"
+
+    def __init__(self, initial: int = NIL):
+        self.initial = initial
+
+    def init_state(self) -> int:
+        return self.initial
+
+    def step_py(self, state, f, a1, a2, rv):
+        if f == F_READ:
+            return (state == rv, state)
+        if f == F_WRITE:
+            return (True, a1)
+        return (False, state)  # cas unsupported in the plain register
+
+    def step(self, state, f, a1, a2, rv):
+        is_read = f == F_READ
+        is_write = f == F_WRITE
+        legal = jnp.where(is_read, state == rv, is_write)
+        nxt = jnp.where(is_write, a1, state)
+        return legal, nxt.astype(jnp.int32)

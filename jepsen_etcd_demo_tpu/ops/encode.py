@@ -1,0 +1,216 @@
+"""Encode a concurrent history into padded int32 event tensors for the checker.
+
+This is the boundary between the host plane (Python op records) and the device
+plane (JAX kernels). A register-workload history (reference ops constructed at
+src/jepsen/etcdemo.clj:67-69, completed at :83-105) becomes:
+
+  events[E, 6] int32 rows: (kind, slot, f, a1, a2, rv)
+
+    kind: EV_INVOKE — an op becomes pending (its fields are loaded into `slot`)
+          EV_RETURN — the op in `slot` returned ok; every surviving
+                      linearization must have linearized it by now
+          EV_PAD    — padding (no-op)
+    f:    F_READ / F_WRITE / F_CAS
+    a1,a2: op arguments (write value; cas old/new)
+    rv:   observed value for reads (NIL when the key was missing)
+
+Completion-status handling (the correctness-critical part, reference
+src/jepsen/etcdemo.clj:100-105):
+  ok    -> EV_INVOKE at the invoke's history position, EV_RETURN at the
+           completion's position.
+  info  -> EV_INVOKE only: the op stays pending forever and may be linearized
+           at any later point, but never must be. (Indeterminate reads impose
+           no constraint at all and are dropped entirely.)
+  fail  -> dropped: the op is known not to have taken effect.
+
+Slots: because per-process ops are sequential, the number of simultaneously
+pending ops is bounded by concurrency plus the number of accumulated `info`
+ops. Each pending op occupies one of `k_slots` slots for the duration of its
+pendingness; a config's "linearized set" is then a fixed-width bitmask over
+slots rather than an unbounded set — this is what makes the search frontier a
+static-shape tensor. Slot ids are freed on EV_RETURN (at that point every
+surviving config has linearized the op, so its bit is cleared everywhere).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from .op import Op, INVOKE, OK, FAIL, INFO
+
+# Value encoding. The reference register draws values from (rand-int 5), i.e.
+# 0..4 (src/jepsen/etcdemo.clj:68-69); NIL encodes "key missing" observed by a
+# read (parse-long of nil at :71-74,87-90). Any int32 value >= 0 is supported.
+NIL = -1
+
+# Function codes.
+F_READ, F_WRITE, F_CAS = 0, 1, 2
+FUNC_CODES = {"read": F_READ, "write": F_WRITE, "cas": F_CAS}
+
+# Event kinds.
+EV_INVOKE, EV_RETURN, EV_PAD = 0, 1, 2
+
+EVENT_WIDTH = 6  # (kind, slot, f, a1, a2, rv)
+
+
+class EncodeError(ValueError):
+    pass
+
+
+class SlotOverflow(EncodeError):
+    """More simultaneously-pending ops than k_slots."""
+
+
+@dataclass
+class Invocation:
+    """One paired invocation: invoke entry + (optional) completion entry."""
+
+    f: int                 # F_READ / F_WRITE / F_CAS
+    a1: int
+    a2: int
+    rv: int                # observed read value (NIL if none / missing key)
+    status: str            # ok | fail | info
+    invoke_index: int      # position of the invoke entry in the history
+    complete_index: int    # position of the completion entry; -1 if none
+    process: Any = None
+
+
+@dataclass
+class EncodedHistory:
+    """Padded event tensor plus bookkeeping, ready for the WGL kernels."""
+
+    events: np.ndarray     # [E, 6] int32
+    n_events: int          # real (non-pad) events
+    n_ops: int             # invocations included (ok + open info)
+    k_slots: int
+    max_pending: int       # high-water mark of simultaneously pending ops
+
+    def padded_to(self, e_cap: int) -> "EncodedHistory":
+        if e_cap < self.events.shape[0]:
+            raise EncodeError(
+                f"cannot pad events of length {self.events.shape[0]} to {e_cap}"
+            )
+        ev = np.full((e_cap, EVENT_WIDTH), 0, dtype=np.int32)
+        ev[:, 0] = EV_PAD
+        ev[: self.events.shape[0]] = self.events
+        return EncodedHistory(ev, self.n_events, self.n_ops, self.k_slots,
+                              self.max_pending)
+
+
+def _encode_value(v: Any) -> int:
+    if v is None:
+        return NIL
+    return int(v)
+
+
+def pair_history(history: Sequence[Op]) -> list[Invocation]:
+    """Pair invoke entries with their completions by process id.
+
+    Mirrors the framework recorder's pairing [dep]; a process has at most one
+    outstanding invocation at a time (jepsen worker model). Invocations whose
+    completion never arrives are treated as `info` (crashed mid-op), exactly
+    like jepsen treats them when a run ends.
+    """
+    pending: dict[Any, tuple[int, Op]] = {}
+    out: list[Invocation] = []
+    for idx, op in enumerate(history):
+        if op.type == INVOKE:
+            if op.process in pending:
+                raise EncodeError(
+                    f"process {op.process} invoked twice without completing "
+                    f"(history indices {pending[op.process][0]} and {idx})"
+                )
+            pending[op.process] = (idx, op)
+        elif op.type in (OK, FAIL, INFO):
+            if op.process not in pending:
+                raise EncodeError(
+                    f"completion for process {op.process} at history index "
+                    f"{idx} has no pending invocation"
+                )
+            inv_idx, inv = pending.pop(op.process)
+            out.append(_make_invocation(inv, op, inv_idx, idx))
+        else:
+            raise EncodeError(f"unknown op type {op.type!r} at index {idx}")
+    # Unfinished invocations: open forever.
+    for proc, (inv_idx, inv) in pending.items():
+        out.append(_make_invocation(inv, None, inv_idx, -1))
+    out.sort(key=lambda i: i.invoke_index)
+    return out
+
+
+def _make_invocation(inv: Op, comp: Optional[Op], inv_idx: int,
+                     comp_idx: int) -> Invocation:
+    if inv.f not in FUNC_CODES:
+        raise EncodeError(f"unsupported register op f={inv.f!r}")
+    f = FUNC_CODES[inv.f]
+    status = comp.type if comp is not None else INFO
+    a1 = a2 = 0
+    rv = NIL
+    value = inv.value
+    if f == F_READ:
+        if comp is not None and comp.type == OK:
+            rv = _encode_value(comp.value)
+    elif f == F_WRITE:
+        a1 = _encode_value(value)
+    elif f == F_CAS:
+        old, new = value
+        a1, a2 = _encode_value(old), _encode_value(new)
+    return Invocation(f=f, a1=a1, a2=a2, rv=rv, status=status,
+                      invoke_index=inv_idx, complete_index=comp_idx,
+                      process=inv.process)
+
+
+def encode_events(invocations: Sequence[Invocation], k_slots: int = 32
+                  ) -> EncodedHistory:
+    """Build the (kind, slot, f, a1, a2, rv) event stream with slot assignment.
+
+    Events are emitted in history order: each included invocation contributes
+    an EV_INVOKE at its invoke position and, when status == ok, an EV_RETURN at
+    its completion position. `fail` ops and `info` reads are excluded (see
+    module docstring).
+    """
+    # Collect timeline points: (history_index, is_return, invocation).
+    points: list[tuple[int, int, Invocation]] = []
+    for inv in invocations:
+        if inv.status == FAIL:
+            continue
+        if inv.status == INFO and inv.f == F_READ:
+            continue  # an indeterminate read imposes no constraint
+        points.append((inv.invoke_index, 0, inv))
+        if inv.status == OK:
+            points.append((inv.complete_index, 1, inv))
+    points.sort(key=lambda p: (p[0], p[1]))
+
+    free = list(range(k_slots - 1, -1, -1))  # pop() yields lowest slot first
+    slot_of: dict[int, int] = {}             # invoke_index -> slot
+    rows: list[list[int]] = []
+    max_pending = 0
+    for hist_idx, is_return, inv in points:
+        if not is_return:
+            if not free:
+                raise SlotOverflow(
+                    f"more than {k_slots} simultaneously pending ops at "
+                    f"history index {hist_idx}; raise k_slots"
+                )
+            slot = free.pop()
+            slot_of[inv.invoke_index] = slot
+            rows.append([EV_INVOKE, slot, inv.f, inv.a1, inv.a2, inv.rv])
+            max_pending = max(max_pending, k_slots - len(free))
+        else:
+            slot = slot_of.pop(inv.invoke_index)
+            rows.append([EV_RETURN, slot, inv.f, inv.a1, inv.a2, inv.rv])
+            free.append(slot)
+
+    events = np.asarray(rows, dtype=np.int32).reshape(-1, EVENT_WIDTH)
+    n_ops = sum(1 for _, r, _i in points if not r)
+    return EncodedHistory(events=events, n_events=len(rows), n_ops=n_ops,
+                          k_slots=k_slots, max_pending=max_pending)
+
+
+def encode_register_history(history: Sequence[Op], k_slots: int = 32
+                            ) -> EncodedHistory:
+    """History of register ops (read/write/cas) -> padded event tensor."""
+    return encode_events(pair_history(history), k_slots=k_slots)

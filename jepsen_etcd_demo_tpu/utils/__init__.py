@@ -1,0 +1,1 @@
+"""Clocks, logging, fuzzing, misc."""

@@ -1,0 +1,143 @@
+"""Random concurrent-history generation for differential testing and bench.
+
+`gen_register_history` simulates a real linearizable CAS register with an
+explicit linearization point chosen inside each op's invoke/complete window,
+so the produced history is linearizable *by construction* (unless mutated).
+It exercises every completion status the reference client can produce
+(ok/fail/info — src/jepsen/etcdemo.clj:83-105):
+
+  * ok ops linearize at some point inside their window;
+  * cas ops that linearize against a mismatched value complete :fail
+    (the reference client maps a false cas! to :fail, :95-98);
+  * some ops take effect but never complete (:info — timeout after effect);
+  * some ops fail before taking effect (:fail — timeout before effect is NOT
+    how the reference maps write timeouts, but read timeouts map to :fail,
+    :100-102).
+
+`mutate_history` breaks a valid history (corrupt a read, resurrect a failed
+write) to produce likely-invalid inputs; differential tests only require the
+two checkers to AGREE, so mutants that stay valid are fine.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ..ops.op import Op, INVOKE, OK, FAIL, INFO
+
+
+def gen_register_history(
+    rng: random.Random,
+    n_ops: int = 50,
+    n_procs: int = 5,
+    value_range: int = 5,
+    p_read: float = 0.4,
+    p_write: float = 0.35,
+    p_info: float = 0.05,
+    p_fail_read: float = 0.05,
+) -> list[Op]:
+    """Generate a valid (linearizable) single-register history."""
+    value: Optional[int] = None  # the register; None == key missing
+    history: list[Op] = []
+    # pending: proc -> dict(op fields, linearized?, result)
+    pending: dict[int, dict] = {}
+    free = list(range(n_procs))
+    invoked = 0
+
+    def emit(op: Op):
+        op.index = len(history)
+        op.time = len(history) * 1000
+        history.append(op)
+
+    while invoked < n_ops or pending:
+        choices = []
+        if invoked < n_ops and free:
+            choices.append("invoke")
+        unlin = [p for p, d in pending.items() if not d["lin"]]
+        lin = [p for p, d in pending.items() if d["lin"]]
+        if unlin:
+            choices.append("linearize")
+            choices.append("fail_read")
+        if lin:
+            choices.append("complete")
+        action = rng.choice(choices)
+
+        if action == "invoke":
+            proc = free.pop(rng.randrange(len(free)))
+            x = rng.random()
+            if x < p_read:
+                f, v = "read", None
+            elif x < p_read + p_write:
+                f, v = "write", rng.randrange(value_range)
+            else:
+                f, v = "cas", (rng.randrange(value_range),
+                               rng.randrange(value_range))
+            emit(Op(type=INVOKE, f=f, value=v, process=proc))
+            pending[proc] = {"f": f, "value": v, "lin": False, "result": None}
+            invoked += 1
+        elif action == "linearize":
+            proc = rng.choice(unlin)
+            d = pending[proc]
+            if d["f"] == "read":
+                d["result"] = value
+            elif d["f"] == "write":
+                value = d["value"]
+            else:  # cas
+                old, new = d["value"]
+                if value == old:
+                    value = new
+                    d["result"] = True
+                else:
+                    d["result"] = False
+            d["lin"] = True
+        elif action == "fail_read":
+            # A read that times out maps to :fail (didn't logically happen).
+            reads = [p for p in unlin if pending[p]["f"] == "read"]
+            if not reads or rng.random() > p_fail_read * 4:
+                continue
+            proc = rng.choice(reads)
+            emit(Op(type=FAIL, f="read", value=None, process=proc,
+                    error="timeout"))
+            del pending[proc]
+            free.append(proc)
+        else:  # complete
+            proc = rng.choice(lin)
+            d = pending.pop(proc)
+            if rng.random() < p_info and d["f"] != "read":
+                # Took effect but the ack was lost: indeterminate forever.
+                emit(Op(type=INFO, f=d["f"], value=d["value"], process=proc,
+                        error="timeout"))
+                # jepsen crashes the worker and allocates a fresh process id;
+                # model that so the process never completes this op.
+                free.append(max(list(free) + list(pending) + [proc]) + 1)
+                continue
+            if d["f"] == "read":
+                emit(Op(type=OK, f="read", value=d["result"], process=proc))
+            elif d["f"] == "write":
+                emit(Op(type=OK, f="write", value=d["value"], process=proc))
+            else:
+                status = OK if d["result"] else FAIL
+                emit(Op(type=status, f="cas", value=d["value"], process=proc))
+            free.append(proc)
+    return history
+
+
+def mutate_history(rng: random.Random, history: list[Op],
+                   value_range: int = 5) -> list[Op]:
+    """Corrupt a valid history so it is (probably) not linearizable."""
+    out = [Op(**{**op.__dict__}) for op in history]
+    candidates = [i for i, op in enumerate(out)
+                  if op.type == OK and op.f == "read"]
+    if candidates:
+        i = rng.choice(candidates)
+        old = out[i].value
+        choices = [v for v in range(value_range) if v != old] + [None]
+        out[i].value = rng.choice([c for c in choices if c != old])
+        return out
+    # No ok read to corrupt: flip a failed cas to ok.
+    candidates = [i for i, op in enumerate(out)
+                  if op.type == FAIL and op.f == "cas"]
+    if candidates:
+        out[rng.choice(candidates)].type = OK
+    return out

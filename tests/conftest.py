@@ -1,0 +1,22 @@
+"""Test config: force JAX onto a virtual 8-device CPU platform.
+
+Must run before the first `import jax` anywhere in the test process
+(SURVEY.md §4: CPU-backend jit tests + 8 simulated devices for mesh tests).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import random  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return random.Random(0xE7CD)

@@ -1,0 +1,95 @@
+"""Golden histories with known linearizability verdicts.
+
+Hand-written classics (SURVEY.md §4 "golden histories"), each a
+(name, history, expected_valid) triple over the single CAS register with
+initial value nil. Process ids are ints; history order is the recorded order.
+"""
+
+from jepsen_etcd_demo_tpu.ops.op import Op, INVOKE, OK, FAIL, INFO
+
+
+def _h(*rows):
+    out = []
+    for i, (typ, f, value, proc) in enumerate(rows):
+        out.append(Op(type=typ, f=f, value=value, process=proc, time=i * 1000,
+                      index=i))
+    return out
+
+
+GOLDEN = [
+    ("empty", _h(), True),
+    ("single-write", _h(
+        (INVOKE, "write", 1, 0), (OK, "write", 1, 0)), True),
+    ("write-then-read", _h(
+        (INVOKE, "write", 1, 0), (OK, "write", 1, 0),
+        (INVOKE, "read", None, 1), (OK, "read", 1, 1)), True),
+    ("read-initial-nil", _h(
+        (INVOKE, "read", None, 0), (OK, "read", None, 0)), True),
+    ("read-unwritten-value", _h(
+        (INVOKE, "read", None, 0), (OK, "read", 3, 0)), False),
+    # Sequential w1;w2 then read of stale 1 — real-time order forbids it.
+    ("stale-read-after-overwrite", _h(
+        (INVOKE, "write", 1, 0), (OK, "write", 1, 0),
+        (INVOKE, "write", 2, 0), (OK, "write", 2, 0),
+        (INVOKE, "read", None, 1), (OK, "read", 1, 1)), False),
+    # Same but the read overlaps w2, so it may linearize before it.
+    ("concurrent-read-during-overwrite", _h(
+        (INVOKE, "write", 1, 0), (OK, "write", 1, 0),
+        (INVOKE, "write", 2, 0),
+        (INVOKE, "read", None, 1), (OK, "read", 1, 1),
+        (OK, "write", 2, 0)), True),
+    # Read completed before a non-overlapping write began must not see it.
+    ("read-sees-future-write", _h(
+        (INVOKE, "read", None, 0), (OK, "read", 4, 0),
+        (INVOKE, "write", 4, 1), (OK, "write", 4, 1)), False),
+    # A write that returned :fail never took effect.
+    ("failed-write-observed", _h(
+        (INVOKE, "write", 1, 0), (FAIL, "write", 1, 0),
+        (INVOKE, "read", None, 1), (OK, "read", 1, 1)), False),
+    # An :info (indeterminate) write MAY have taken effect...
+    ("info-write-observed", _h(
+        (INVOKE, "write", 1, 0), (INFO, "write", 1, 0),
+        (INVOKE, "read", None, 1), (OK, "read", 1, 1)), True),
+    # ...or may not have.
+    ("info-write-unobserved", _h(
+        (INVOKE, "write", 1, 0), (INFO, "write", 1, 0),
+        (INVOKE, "read", None, 1), (OK, "read", None, 1)), True),
+    # The open op can take effect arbitrarily late (after later ops).
+    ("info-write-late-effect", _h(
+        (INVOKE, "write", 1, 0), (INFO, "write", 1, 0),
+        (INVOKE, "write", 2, 1), (OK, "write", 2, 1),
+        (INVOKE, "read", None, 1), (OK, "read", 2, 1),
+        (INVOKE, "read", None, 1), (OK, "read", 1, 1)), True),
+    # But an open op takes effect at most once.
+    ("info-write-effect-twice", _h(
+        (INVOKE, "write", 1, 0), (INFO, "write", 1, 0),
+        (INVOKE, "write", 2, 1), (OK, "write", 2, 1),
+        (INVOKE, "read", None, 1), (OK, "read", 1, 1),
+        (INVOKE, "write", 3, 1), (OK, "write", 3, 1),
+        (INVOKE, "read", None, 1), (OK, "read", 1, 1)), False),
+    # CAS basics.
+    ("cas-success", _h(
+        (INVOKE, "write", 2, 0), (OK, "write", 2, 0),
+        (INVOKE, "cas", (2, 4), 1), (OK, "cas", (2, 4), 1),
+        (INVOKE, "read", None, 0), (OK, "read", 4, 0)), True),
+    ("cas-wrong-witness", _h(
+        (INVOKE, "write", 2, 0), (OK, "write", 2, 0),
+        (INVOKE, "cas", (3, 4), 1), (OK, "cas", (3, 4), 1)), False),
+    ("cas-failed-excluded", _h(
+        (INVOKE, "write", 2, 0), (OK, "write", 2, 0),
+        (INVOKE, "cas", (3, 4), 1), (FAIL, "cas", (3, 4), 1),
+        (INVOKE, "read", None, 0), (OK, "read", 2, 0)), True),
+    # Concurrent cas ops racing on the same witness: only one may win.
+    ("cas-both-win", _h(
+        (INVOKE, "write", 0, 0), (OK, "write", 0, 0),
+        (INVOKE, "cas", (0, 1), 1), (INVOKE, "cas", (0, 2), 2),
+        (OK, "cas", (0, 1), 1), (OK, "cas", (0, 2), 2)), False),
+    ("cas-chain-win", _h(
+        (INVOKE, "write", 0, 0), (OK, "write", 0, 0),
+        (INVOKE, "cas", (0, 1), 1), (INVOKE, "cas", (1, 2), 2),
+        (OK, "cas", (0, 1), 1), (OK, "cas", (1, 2), 2)), True),
+    # Never-completed invoke behaves like :info (crashed mid-op).
+    ("dangling-invoke", _h(
+        (INVOKE, "write", 1, 0),
+        (INVOKE, "read", None, 1), (OK, "read", 1, 1)), True),
+]

@@ -1,0 +1,175 @@
+"""Checker-layer tests: Linearizable backends/fallback, IndependentChecker
+batched dispatch, SetChecker, Compose."""
+
+import pytest
+
+from jepsen_etcd_demo_tpu.checkers import (Checker, Compose, Linearizable,
+                                           SetChecker, IndependentChecker)
+from jepsen_etcd_demo_tpu.checkers.independent import split_by_key
+from jepsen_etcd_demo_tpu.ops.op import Op, INVOKE, OK, FAIL, INFO
+from jepsen_etcd_demo_tpu.utils.fuzz import gen_register_history, mutate_history
+
+
+def _h(*rows):
+    return [Op(type=t, f=f, value=v, process=p, index=i)
+            for i, (t, f, v, p) in enumerate(rows)]
+
+
+def _keyed(key, history):
+    out = []
+    for op in history:
+        v = (key, op.value)
+        out.append(Op(type=op.type, f=op.f, value=v, process=(key, op.process),
+                      time=op.time, index=op.index))
+    return out
+
+
+class TestLinearizable:
+    def test_backends_agree(self, rng):
+        for i in range(5):
+            h = gen_register_history(rng, n_ops=25, n_procs=4)
+            if i % 2:
+                h = mutate_history(rng, h)
+            vj = Linearizable(backend="jax").check({}, h)["valid"]
+            vo = Linearizable(backend="oracle").check({}, h)["valid"]
+            assert vj == vo
+
+    def test_overflow_escalation_and_fallback(self, rng):
+        h = gen_register_history(rng, n_ops=30, n_procs=5)
+        res = Linearizable(backend="jax", f_cap=2).check({}, h)
+        assert res["valid"] is True  # exact in the end, whatever the path
+
+    def test_empty(self):
+        assert Linearizable().check({}, [])["valid"] is True
+
+    def test_invalid_reports_dead_event(self):
+        h = _h((INVOKE, "read", None, 0), (OK, "read", 4, 0))
+        res = Linearizable(backend="jax").check({}, h)
+        assert res["valid"] is False
+        assert res["dead_event"] == 1
+
+
+class TestCompose:
+    def test_merge(self, rng):
+        h = gen_register_history(rng, n_ops=10)
+        c = Compose({"a": Linearizable(backend="oracle"),
+                     "b": Linearizable(backend="jax")})
+        res = c.check({}, h)
+        assert res["valid"] is True
+        assert res["a"]["valid"] is True and res["b"]["valid"] is True
+
+    def test_any_false_wins(self):
+        class Always(Checker):
+            def __init__(self, v):
+                self.v = v
+
+            def check(self, test, history, opts=None):
+                return {"valid": self.v}
+
+        assert Compose({"a": Always(True), "b": Always(False)}).check(
+            {}, [])["valid"] is False
+        assert Compose({"a": Always(True), "b": Always("unknown")}).check(
+            {}, [])["valid"] == "unknown"
+
+    def test_reserved_name(self):
+        with pytest.raises(ValueError):
+            Compose({"valid": SetChecker()})
+
+
+class TestIndependent:
+    def test_split_by_key(self):
+        h = _h((INVOKE, "write", ("a", 1), 0), (INVOKE, "write", ("b", 2), 1),
+               (OK, "write", ("b", 2), 1), (OK, "write", ("a", 1), 0))
+        keyed = split_by_key(h)
+        assert set(keyed) == {"a", "b"}
+        assert [op.value for op in keyed["a"]] == [1, 1]
+
+    def test_split_routes_completion_by_invoke_key(self):
+        # A timeout :info completion may carry no tuple; routed by process.
+        h = [Op(type=INVOKE, f="write", value=("k", 5), process=3),
+             Op(type=INFO, f="write", value=None, process=3, error="timeout")]
+        keyed = split_by_key(h)
+        assert list(keyed) == ["k"]
+        assert keyed["k"][1].type == INFO
+
+    def test_batched_matches_per_key(self, rng):
+        h = []
+        expected = {}
+        for k in range(6):
+            sub = gen_register_history(rng, n_ops=15, n_procs=3)
+            if k in (2, 4):
+                sub = mutate_history(rng, sub)
+            expected[str(k)] = Linearizable(backend="oracle").check(
+                {}, sub)["valid"]
+            h.extend(_keyed(k, sub))
+        res = IndependentChecker(Linearizable(backend="jax")).check({}, h)
+        got = {k: r["valid"] for k, r in res["results"].items()}
+        assert got == expected
+        assert res["valid"] == (False if False in expected.values() else True)
+
+    def test_compose_subcheckers_all_run(self, rng):
+        # Regression: every named entry of a composed sub-checker must appear
+        # in each per-key result — nothing silently dropped by batching.
+        calls = []
+
+        class Probe(Checker):
+            def check(self, test, history, opts=None):
+                calls.append(len(history))
+                return {"valid": True, "probed": True}
+
+        h = []
+        for k in range(3):
+            h.extend(_keyed(k, gen_register_history(rng, n_ops=10)))
+        sub = Compose({"linear": Linearizable(backend="jax"),
+                       "probe": Probe()})
+        res = IndependentChecker(sub).check({}, h)
+        assert res["valid"] is True
+        for k in ("0", "1", "2"):
+            assert res["results"][k]["probe"]["probed"]
+            assert res["results"][k]["linear"]["backend"] == "jax-batched"
+        assert len(calls) == 3
+
+    def test_single_key_unbatched(self, rng):
+        h = _keyed("only", gen_register_history(rng, n_ops=10))
+        res = IndependentChecker(Linearizable(backend="jax")).check({}, h)
+        assert res["results"]["only"]["backend"] == "jax"
+
+
+class TestSetChecker:
+    def test_all_durable(self):
+        h = _h((INVOKE, "add", 1, 0), (OK, "add", 1, 0),
+               (INVOKE, "add", 2, 1), (OK, "add", 2, 1),
+               (INVOKE, "read", None, 0), (OK, "read", [1, 2], 0))
+        res = SetChecker().check({}, h)
+        assert res["valid"] is True
+        assert res["ok_count"] == 2
+
+    def test_lost_add(self):
+        h = _h((INVOKE, "add", 1, 0), (OK, "add", 1, 0),
+               (INVOKE, "read", None, 0), (OK, "read", [], 0))
+        res = SetChecker().check({}, h)
+        assert res["valid"] is False
+        assert res["lost"] == [1]
+
+    def test_unexpected_element(self):
+        h = _h((INVOKE, "read", None, 0), (OK, "read", [7], 0))
+        res = SetChecker().check({}, h)
+        assert res["valid"] is False
+        assert res["unexpected"] == [7]
+
+    def test_info_add_recovered_or_unsure(self):
+        h = _h((INVOKE, "add", 1, 0), (INFO, "add", 1, 0),
+               (INVOKE, "add", 2, 1), (INFO, "add", 2, 1),
+               (INVOKE, "read", None, 2), (OK, "read", [1], 2))
+        res = SetChecker().check({}, h)
+        assert res["valid"] is True  # info adds are never "lost"
+        assert res["recovered_count"] == 1
+
+    def test_no_final_read(self):
+        h = _h((INVOKE, "add", 1, 0), (OK, "add", 1, 0))
+        assert SetChecker().check({}, h)["valid"] == "unknown"
+
+    def test_dangling_add_is_indeterminate(self):
+        h = _h((INVOKE, "add", 5, 0),
+               (INVOKE, "read", None, 1), (OK, "read", [5], 1))
+        assert SetChecker().check({}, h)["valid"] is True

@@ -1,0 +1,121 @@
+"""History pairing/encoding unit tests (SURVEY.md §4: round-trip, padding,
+:info open-op semantics)."""
+
+import numpy as np
+import pytest
+
+from jepsen_etcd_demo_tpu.ops.op import (Op, INVOKE, OK, FAIL, INFO,
+                                         history_to_jsonl, history_from_jsonl)
+from jepsen_etcd_demo_tpu.ops.encode import (
+    NIL, F_READ, F_WRITE, F_CAS, EV_INVOKE, EV_RETURN, EV_PAD,
+    pair_history, encode_register_history, SlotOverflow)
+from jepsen_etcd_demo_tpu.utils.fuzz import gen_register_history
+
+
+def _h(*rows):
+    return [Op(type=t, f=f, value=v, process=p, index=i)
+            for i, (t, f, v, p) in enumerate(rows)]
+
+
+def test_pairing_basic():
+    h = _h((INVOKE, "write", 3, 0), (OK, "write", 3, 0),
+           (INVOKE, "read", None, 1), (OK, "read", 3, 1))
+    invs = pair_history(h)
+    assert len(invs) == 2
+    w, r = invs
+    assert (w.f, w.a1, w.status) == (F_WRITE, 3, OK)
+    assert (r.f, r.rv, r.status) == (F_READ, 3, OK)
+    assert w.invoke_index == 0 and w.complete_index == 1
+
+
+def test_pairing_interleaved_processes():
+    h = _h((INVOKE, "write", 1, 0), (INVOKE, "write", 2, 1),
+           (OK, "write", 2, 1), (OK, "write", 1, 0))
+    invs = pair_history(h)
+    assert [i.a1 for i in invs] == [1, 2]
+    assert invs[0].complete_index == 3
+
+
+def test_dangling_invoke_becomes_info():
+    h = _h((INVOKE, "cas", (1, 2), 0))
+    invs = pair_history(h)
+    assert invs[0].status == INFO
+    assert invs[0].complete_index == -1
+    assert (invs[0].a1, invs[0].a2) == (1, 2)
+
+
+def test_double_invoke_rejected():
+    h = _h((INVOKE, "read", None, 0), (INVOKE, "read", None, 0))
+    with pytest.raises(ValueError):
+        pair_history(h)
+
+
+def test_encoding_drops_fail_and_info_reads():
+    h = _h((INVOKE, "write", 1, 0), (FAIL, "write", 1, 0),
+           (INVOKE, "read", None, 1), (FAIL, "read", None, 1),
+           (INVOKE, "read", None, 2), (INFO, "read", None, 2),
+           (INVOKE, "write", 2, 3), (INFO, "write", 2, 3))
+    enc = encode_register_history(h)
+    # Only the info write survives, as a lone EV_INVOKE.
+    assert enc.n_ops == 1
+    assert enc.n_events == 1
+    kind, slot, f, a1, _, _ = enc.events[0]
+    assert (kind, f, a1) == (EV_INVOKE, F_WRITE, 2)
+
+
+def test_event_order_and_slot_reuse():
+    h = _h((INVOKE, "write", 1, 0), (OK, "write", 1, 0),
+           (INVOKE, "read", None, 0), (OK, "read", 1, 0))
+    enc = encode_register_history(h, k_slots=32)
+    kinds = list(enc.events[:, 0])
+    assert kinds == [EV_INVOKE, EV_RETURN, EV_INVOKE, EV_RETURN]
+    # Sequential ops reuse slot 0.
+    assert list(enc.events[:, 1]) == [0, 0, 0, 0]
+    assert enc.max_pending == 1
+
+
+def test_nil_read_encoding():
+    h = _h((INVOKE, "read", None, 0), (OK, "read", None, 0))
+    enc = encode_register_history(h)
+    assert enc.events[0][5] == NIL
+
+
+def test_slot_overflow():
+    h = _h(*[(INVOKE, "write", 1, p) for p in range(5)])
+    with pytest.raises(SlotOverflow):
+        encode_register_history(h, k_slots=4)
+    enc = encode_register_history(h, k_slots=8)
+    assert enc.max_pending == 5
+
+
+def test_padding():
+    h = _h((INVOKE, "write", 1, 0), (OK, "write", 1, 0))
+    enc = encode_register_history(h).padded_to(16)
+    assert enc.events.shape == (16, 6)
+    assert all(enc.events[i][0] == EV_PAD for i in range(2, 16))
+
+
+def test_jsonl_round_trip():
+    import random
+    h = gen_register_history(random.Random(7), n_ops=30)
+    text = history_to_jsonl(h)
+    h2 = history_from_jsonl(text)
+    assert len(h2) == len(h)
+    for a, b in zip(h, h2):
+        assert (a.type, a.f, a.process, a.index) == (b.type, b.f, b.process,
+                                                     b.index)
+        if a.f == "cas":
+            assert tuple(a.value) == tuple(b.value)
+        else:
+            assert a.value == b.value
+    # Encodings agree exactly.
+    e1, e2 = encode_register_history(h), encode_register_history(h2)
+    assert np.array_equal(e1.events, e2.events)
+
+
+def test_fuzz_histories_encode(rng):
+    for _ in range(20):
+        h = gen_register_history(rng, n_ops=40, n_procs=6)
+        enc = encode_register_history(h)
+        assert enc.n_events >= enc.n_ops
+        assert enc.max_pending <= 32

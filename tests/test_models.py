@@ -1,0 +1,67 @@
+"""Model step truth tables (SURVEY.md §4), py vs jax step agreement."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jepsen_etcd_demo_tpu.models import CASRegister, Register, get_model
+from jepsen_etcd_demo_tpu.ops.encode import NIL, F_READ, F_WRITE, F_CAS
+
+
+CASES = [
+    # (state, f, a1, a2, rv) -> (legal, next)
+    ((NIL, F_READ, 0, 0, NIL), (True, NIL)),    # read of missing key
+    ((NIL, F_READ, 0, 0, 3), (False, NIL)),
+    ((3, F_READ, 0, 0, 3), (True, 3)),
+    ((3, F_READ, 0, 0, 4), (False, 3)),
+    ((NIL, F_WRITE, 2, 0, NIL), (True, 2)),
+    ((4, F_WRITE, 0, 0, NIL), (True, 0)),
+    ((2, F_CAS, 2, 4, NIL), (True, 4)),
+    ((2, F_CAS, 3, 4, NIL), (False, 2)),
+    ((NIL, F_CAS, 0, 1, NIL), (False, NIL)),    # cas against missing key
+]
+
+
+@pytest.mark.parametrize("args,expected", CASES)
+def test_cas_register_truth_table(args, expected):
+    m = CASRegister()
+    state, f, a1, a2, rv = args
+    legal, nxt = m.step_py(state, f, a1, a2, rv)
+    exp_legal, exp_next = expected
+    assert bool(legal) == exp_legal
+    if exp_legal:
+        assert int(nxt) == exp_next
+
+
+@pytest.mark.parametrize("args,expected", CASES)
+def test_jax_step_matches_py(args, expected):
+    m = CASRegister()
+    state, f, a1, a2, rv = (jnp.int32(x) for x in args)
+    legal, nxt = m.step(state, f, a1, a2, rv)
+    legal_py, nxt_py = m.step_py(*args)
+    assert bool(legal) == bool(legal_py)
+    if legal_py:
+        assert int(nxt) == int(nxt_py)
+
+
+def test_jax_step_vectorized():
+    m = CASRegister()
+    f = jnp.array([F_READ, F_WRITE, F_CAS])
+    a1 = jnp.array([0, 7, 1])
+    a2 = jnp.array([0, 0, 9])
+    rv = jnp.array([1, NIL, NIL])
+    legal, nxt = m.step(jnp.int32(1), f, a1, a2, rv)
+    assert np.array_equal(np.asarray(legal), [True, True, True])
+    assert np.array_equal(np.asarray(nxt), [1, 7, 9])
+
+
+def test_plain_register_rejects_cas():
+    m = Register()
+    legal, _ = m.step_py(1, F_CAS, 1, 2, NIL)
+    assert not legal
+
+
+def test_registry():
+    assert isinstance(get_model("cas-register"), CASRegister)
+    with pytest.raises(KeyError):
+        get_model("nope")

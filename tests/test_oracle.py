@@ -1,0 +1,64 @@
+"""Oracle WGL checker: golden verdicts + brute-force cross-validation."""
+
+import random
+
+import pytest
+
+from jepsen_etcd_demo_tpu.checkers.oracle import (check_events_oracle,
+                                                  brute_force_check)
+from jepsen_etcd_demo_tpu.models import CASRegister
+from jepsen_etcd_demo_tpu.ops.encode import encode_register_history
+from jepsen_etcd_demo_tpu.utils.fuzz import gen_register_history, mutate_history
+
+from golden import GOLDEN
+
+
+@pytest.mark.parametrize("name,history,expected",
+                         GOLDEN, ids=[g[0] for g in GOLDEN])
+def test_golden_oracle(name, history, expected):
+    enc = encode_register_history(history)
+    res = check_events_oracle(enc, CASRegister())
+    assert res.valid == expected, f"{name}: got {res.valid}"
+
+
+@pytest.mark.parametrize("name,history,expected",
+                         GOLDEN, ids=[g[0] for g in GOLDEN])
+def test_golden_brute_force(name, history, expected):
+    enc = encode_register_history(history)
+    got = brute_force_check(enc, CASRegister(), max_ops=12)
+    assert got is not None
+    assert got == expected, f"{name}: got {got}"
+
+
+def test_fuzz_valid_histories_pass(rng):
+    for i in range(30):
+        h = gen_register_history(rng, n_ops=40, n_procs=5)
+        enc = encode_register_history(h)
+        res = check_events_oracle(enc, CASRegister())
+        assert res.valid, f"fuzz seed iter {i} wrongly invalid"
+
+
+def test_fuzz_oracle_matches_brute_force(rng):
+    agree_invalid = 0
+    for i in range(60):
+        h = gen_register_history(rng, n_ops=7, n_procs=3)
+        if rng.random() < 0.5:
+            h = mutate_history(rng, h)
+        enc = encode_register_history(h)
+        res = check_events_oracle(enc, CASRegister())
+        bf = brute_force_check(enc, CASRegister(), max_ops=10)
+        assert bf is not None
+        assert res.valid == bf, f"iter {i}: oracle={res.valid} brute={bf}"
+        if not bf:
+            agree_invalid += 1
+    assert agree_invalid > 3  # the mutator actually produced invalid cases
+
+
+def test_dead_event_reported(rng):
+    from jepsen_etcd_demo_tpu.ops.op import Op
+    h = [Op(type="invoke", f="read", value=None, process=0),
+         Op(type="ok", f="read", value=4, process=0)]
+    enc = encode_register_history(h)
+    res = check_events_oracle(enc, CASRegister())
+    assert not res.valid
+    assert res.dead_event == 1
