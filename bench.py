@@ -1,0 +1,115 @@
+"""Benchmark: WGL linearizability checking throughput, TPU kernel vs CPU oracle.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no benchmark numbers (BASELINE.md): its checker is
+knossos's JVM search, which this build replaces with the JAX/XLA kernel. The
+baseline stand-in is therefore this repo's pure-Python oracle WGL checker
+(checkers/oracle.py — same algorithm, same event encoding, host CPU), playing
+the role of the JVM hot loop. vs_baseline = kernel events/sec ÷ oracle
+events/sec on the same histories.
+
+Workload: a corpus of fuzzed single-register histories (valid by
+construction — the checker must run to completion, the worst case for the
+search) checked by the vmapped batch kernel on one chip, plus one long
+history through the single-history kernel.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+import numpy as np
+
+
+N_OPS = 150           # ops per history (tutorial run scale, BASELINE configs[0])
+N_PROCS = 10          # concurrency, matching the reference's 10 threads/key
+K_SLOTS = 24          # pending-op slot capacity (<=28 enables packed dedup)
+F_CAP = 2048          # frontier capacity (dense 10-proc frontiers reach ~2k)
+CORPUS = 64           # histories per batched launch
+REPEATS = 3
+
+
+def build_corpus():
+    from jepsen_etcd_demo_tpu.ops.encode import (encode_register_history,
+                                                 encode_return_steps)
+    from jepsen_etcd_demo_tpu.utils.fuzz import gen_register_history
+
+    rng = random.Random(0xBE7C)
+    # p_info low: every :info op stays pending forever and occupies a slot
+    # for the rest of the history (knossos semantics), so long histories
+    # need them rare (or a wide slot table).
+    encs = [encode_register_history(
+        gen_register_history(rng, n_ops=N_OPS, n_procs=N_PROCS,
+                             p_info=0.002), k_slots=K_SLOTS)
+        for _ in range(CORPUS)]
+    steps = [encode_return_steps(e) for e in encs]
+    r_cap = max(s.slot_tabs.shape[0] for s in steps)
+    padded = [s.padded_to(r_cap) for s in steps]
+    tabs = np.stack([p.slot_tabs for p in padded])
+    act = np.stack([p.slot_active for p in padded])
+    tgt = np.stack([p.targets for p in padded])
+    return encs, (tabs, act, tgt)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from jepsen_etcd_demo_tpu.checkers.oracle import check_events_oracle
+    from jepsen_etcd_demo_tpu.models import CASRegister
+    from jepsen_etcd_demo_tpu.ops import wgl
+
+    from jepsen_etcd_demo_tpu.ops import wgl2
+
+    model = CASRegister()
+    encs, (tabs, act, tgt) = build_corpus()
+    total_events = int(sum(e.n_events for e in encs))
+
+    # --- TPU (or whatever jax.devices() gives) batched v2 kernel ---
+    max_value = max(e.max_value for e in encs)
+    cfg = wgl2.make_config(model, K_SLOTS, F_CAP, max_value)
+    check = wgl2.make_batch_checker2(model, cfg)
+    args = tuple(jax.device_put(jnp.asarray(a)) for a in (tabs, act, tgt))
+    out = check(*args)  # compile + warmup
+    survived = np.asarray(out["survived"])
+    assert survived.all(), "bench corpus must be valid by construction"
+    best = float("inf")
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        out = check(*args)
+        # NB np.asarray (a real device fetch): block_until_ready does not
+        # reliably block under the tunneled TPU backend.
+        np.asarray(out["survived"])
+        best = min(best, time.perf_counter() - t0)
+    kernel_eps = total_events / best
+
+    # --- CPU oracle baseline (the JVM-checker stand-in) ---
+    t0 = time.perf_counter()
+    for enc in encs:
+        res = check_events_oracle(enc, model)
+        assert res.valid
+    oracle_s = time.perf_counter() - t0
+    oracle_eps = total_events / oracle_s
+
+    print(json.dumps({
+        "metric": "wgl_check_throughput",
+        "value": round(kernel_eps, 1),
+        "unit": "history-events/sec",
+        "vs_baseline": round(kernel_eps / oracle_eps, 2),
+        "detail": {
+            "device": str(jax.devices()[0]),
+            "corpus": CORPUS,
+            "ops_per_history": N_OPS,
+            "batch_wall_s": round(best, 4),
+            "oracle_wall_s": round(oracle_s, 4),
+            "histories_per_sec": round(CORPUS / best, 2),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -94,6 +94,9 @@ class IndependentChecker(Checker):
                 "results": {str(k): v for k, v in results.items()}}
 
     def _check_key(self, test, sub_history, opts, batched, key):
+        opts = dict(opts or {})
+        opts["key"] = key  # sub-checkers emit per-key artifacts (timeline)
+
         def pick(name, checker):
             pre = batched.get(name, {}).get(key)
             if pre is not None and pre["valid"] != "unknown":
@@ -111,20 +114,34 @@ class IndependentChecker(Checker):
 
 def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]]
                           ) -> dict[Any, dict]:
-    """Encode every key's history, pad to one event length, run one vmapped
-    kernel launch over the key batch."""
-    from ..ops import wgl
+    """Encode every key's history into the return-major form, pad to one
+    step count, run one vmapped kernel launch over the key batch."""
+    from ..ops import wgl, wgl2
+    from ..ops.encode import (encode_return_steps, encode_register_history,
+                              ReturnSteps)
     import jax.numpy as jnp
 
-    encs = {k: lin.encode(h) for k, h in keyed.items()}
-    k_slots = max(e.k_slots for e in encs.values())
-    e_cap = max(1, max(e.events.shape[0] for e in encs.values()))
+    event_encs = {k: lin.encode(h) for k, h in keyed.items()}
+    # One kernel serves the whole batch, so every key must share k_slots:
+    # re-encode any key whose per-key escalation picked a smaller table
+    # (ragged [R,K,4] tensors cannot stack).
+    k_slots = max(e.k_slots for e in event_encs.values())
+    encs: dict[Any, ReturnSteps] = {}
+    for k, e in event_encs.items():
+        if e.k_slots != k_slots:
+            e = encode_register_history(keyed[k], k_slots=k_slots)
+        encs[k] = encode_return_steps(e)
+    r_cap = max(1, max(e.slot_tabs.shape[0] for e in encs.values()))
     keys = list(encs)
-    stack = np.stack([encs[k].padded_to(e_cap).events for k in keys])
-    check = wgl.cached_batch_checker(lin.model,
-                                     wgl.WGLConfig(k_slots, lin.f_cap))
-    out = {name: np.asarray(v) for name, v in
-           check(jnp.asarray(stack)).items()}
+    padded = [encs[k].padded_to(r_cap) for k in keys]
+    tabs = jnp.asarray(np.stack([p.slot_tabs for p in padded]))
+    act = jnp.asarray(np.stack([p.slot_active for p in padded]))
+    tgt = jnp.asarray(np.stack([p.targets for p in padded]))
+    max_value = max(e.max_value for e in encs.values())
+    check = wgl2.cached_batch_checker2(
+        lin.model, wgl2.make_config(lin.model, k_slots, lin.f_cap,
+                                    max_value))
+    out = {name: np.asarray(v) for name, v in check(tabs, act, tgt).items()}
     results = {}
     for i, k in enumerate(keys):
         one = {name: out[name][i].item() for name in out}
@@ -132,7 +149,7 @@ def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]]
             "valid": wgl.verdict(one),
             "backend": "jax-batched",
             "op_count": encs[k].n_ops,
-            "dead_event": one["dead_event"],
+            "dead_step": one["dead_step"],
             "max_frontier": one["max_frontier"],
         }
     return results

@@ -16,15 +16,25 @@ from typing import Any, Sequence
 
 from .base import Checker
 from .oracle import check_events_oracle
+from ..ops.encode import EV_RETURN
 from ..models import Model, get_model
 from ..ops.op import Op
 from ..ops.encode import (EncodedHistory, SlotOverflow,
                           encode_register_history)
 
 
+def _event_to_step(enc: EncodedHistory, dead_event: int) -> int:
+    """Translate an event index (oracle) into a return-step index (v2 kernel
+    schema): the count of returns strictly before the fatal one."""
+    if dead_event < 0:
+        return -1
+    ev = enc.events[:dead_event, 0]
+    return int((ev == EV_RETURN).sum())
+
+
 class Linearizable(Checker):
     def __init__(self, model: Model | str = "cas-register",
-                 backend: str = "jax", k_slots: int = 32, f_cap: int = 256):
+                 backend: str = "jax", k_slots: int = 24, f_cap: int = 256):
         self.model = get_model(model) if isinstance(model, str) else model
         if backend not in ("jax", "oracle"):
             raise ValueError(f"unknown backend {backend!r}")
@@ -51,32 +61,38 @@ class Linearizable(Checker):
             return {"valid": True, "op_count": 0, "backend": self.backend}
         if self.backend == "oracle":
             res = check_events_oracle(enc, self.model).to_dict()
+            res["dead_step"] = _event_to_step(enc, res.pop("dead_event"))
             res["backend"] = "oracle"
             res["op_count"] = enc.n_ops
             return res
         return self._check_jax(enc)
 
     def _check_jax(self, enc: EncodedHistory) -> dict[str, Any]:
-        from ..ops import wgl
+        from ..ops import wgl, wgl2
+        from ..ops.encode import encode_return_steps
 
+        rs = encode_return_steps(enc)
         f_cap = self.f_cap
-        for attempt in range(2):
-            check = wgl.cached_checker(self.model,
-                                       wgl.WGLConfig(enc.k_slots, f_cap))
-            import jax.numpy as jnp
+        for attempt in range(3):
+            check = wgl2.cached_checker2(
+                self.model, wgl2.config_for(rs, self.model, f_cap))
             out = {k: v.item() if hasattr(v, "item") else v
-                   for k, v in check(jnp.asarray(enc.events)).items()}
+                   for k, v in check(*wgl2.steps_arrays(rs)).items()}
             valid = wgl.verdict(out)
             if valid != "unknown":
                 break
             f_cap *= 4  # overflow killed the frontier; retry bigger
         if valid == "unknown":
-            # Exact fallback: the oracle has no capacity limit.
+            # Exact fallback: the oracle has no capacity limit. Result keys
+            # are normalized to the jax schema (dead_step = return-step
+            # index) so consumers see one shape whatever the path.
             res = check_events_oracle(enc, self.model).to_dict()
-            res.update(backend="jax+oracle-fallback", op_count=enc.n_ops)
+            res["dead_step"] = _event_to_step(enc, res.pop("dead_event"))
+            res.update(backend="jax+oracle-fallback", op_count=enc.n_ops,
+                       overflow=False, f_cap=None)
             return res
         return {"valid": valid, "backend": "jax", "op_count": enc.n_ops,
-                "dead_event": out["dead_event"],
+                "dead_step": out["dead_step"],
                 "max_frontier": out["max_frontier"],
                 "overflow": out["overflow"],
                 "f_cap": f_cap}

@@ -53,10 +53,17 @@ def check_events_oracle(enc: EncodedHistory, model: Model) -> OracleResult:
     max_frontier = len(frontier)
     explored = 0
 
-    def closure(configs: set[tuple[int, int]]) -> set[tuple[int, int]]:
+    def closure(configs: set[tuple[int, int]],
+                target_slot: int) -> set[tuple[int, int]]:
+        """Reachable configs, with just-in-time linearization: configs that
+        have fired `target_slot` (the returning op) are banked, not expanded
+        further — everything beyond that boundary is regenerable at the next
+        return, so the stored frontier stays minimal (Lowe's JIT
+        linearization, the knossos :linear algorithm's key optimization)."""
         nonlocal explored
+        tbit = 1 << target_slot
         seen = set(configs)
-        stack = list(configs)
+        stack = [c for c in configs if not c[1] & tbit]
         while stack:
             state, mask = stack.pop()
             for slot, (f, a1, a2, rv) in slots.items():
@@ -68,7 +75,8 @@ def check_events_oracle(enc: EncodedHistory, model: Model) -> OracleResult:
                     cfg = (int(nxt), mask | (1 << slot))
                     if cfg not in seen:
                         seen.add(cfg)
-                        stack.append(cfg)
+                        if not cfg[1] & tbit:
+                            stack.append(cfg)
         return seen
 
     for i in range(enc.n_events):
@@ -78,7 +86,7 @@ def check_events_oracle(enc: EncodedHistory, model: Model) -> OracleResult:
         if kind == EV_INVOKE:
             slots[slot] = (f, a1, a2, rv)
         elif kind == EV_RETURN:
-            expanded = closure(frontier)
+            expanded = closure(frontier, slot)
             max_frontier = max(max_frontier, len(expanded))
             bit = 1 << slot
             frontier = {(s, m & ~bit) for (s, m) in expanded if m & bit}

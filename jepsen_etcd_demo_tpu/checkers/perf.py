@@ -1,0 +1,135 @@
+"""Performance checker — equivalent of checker/perf.
+
+The reference turns the timestamped history into latency-over-time (raw and
+quantile) and throughput charts via gnuplot, written into the run's store dir
+(reference call site src/jepsen/etcdemo.clj:166; SURVEY.md §5.1). Same three
+artifacts here via matplotlib: latency-raw.png, latency-quantiles.png,
+rate.png — plus the summary stats in the result map (always "valid": perf is
+observability, not an assertion).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from pathlib import Path
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..ops.op import Op, INVOKE, OK, FAIL, INFO
+from .base import Checker
+
+log = logging.getLogger(__name__)
+
+SECOND = 1_000_000_000
+QUANTILES = [0.5, 0.95, 0.99, 1.0]
+
+
+def latency_pairs(history: Sequence[Op]):
+    """(f, completion-type, invoke-time-ns, latency-ns) per completed client
+    op; nemesis excluded."""
+    pending: dict[Any, Op] = {}
+    out = []
+    for op in history:
+        if op.process == "nemesis":
+            continue
+        if op.type == INVOKE:
+            pending[op.process] = op
+        elif op.type in (OK, FAIL, INFO):
+            inv = pending.pop(op.process, None)
+            if inv is not None:
+                out.append((op.f, op.type, inv.time, op.time - inv.time))
+    return out
+
+
+class PerfChecker(Checker):
+    def __init__(self, dt_s: float = 1.0):
+        self.dt_s = dt_s  # rate-chart bucket width
+
+    def check(self, test: dict, history: Sequence[Op],
+              opts: dict | None = None) -> dict[str, Any]:
+        pairs = latency_pairs(history)
+        result: dict[str, Any] = {"valid": True, "count": len(pairs)}
+        if pairs:
+            lat_s = np.array([p[3] for p in pairs]) / SECOND
+            result["latency"] = {
+                "mean": float(lat_s.mean()),
+                **{f"p{int(q * 100)}": float(np.quantile(lat_s, q))
+                   for q in QUANTILES},
+            }
+            span = max(p[2] for p in pairs) / SECOND
+            result["rate_hz"] = len(pairs) / max(span, 1e-9)
+        store_dir = (opts or {}).get("store_dir")
+        if store_dir and pairs:
+            try:
+                self._render(Path(store_dir), pairs)
+                result["charts"] = ["latency-raw.png",
+                                    "latency-quantiles.png", "rate.png"]
+            except Exception as e:  # charts are best-effort observability
+                log.warning("perf chart rendering failed: %s", e)
+        return result
+
+    def _render(self, store_dir: Path, pairs) -> None:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        colors = {OK: "#2a9d43", FAIL: "#d43a2a", INFO: "#e9a820"}
+        markers = {"read": "o", "write": "s", "cas": "^", "add": "s"}
+
+        # latency-raw: scatter of every op, by type/outcome.
+        fig, ax = plt.subplots(figsize=(10, 5))
+        by = defaultdict(list)
+        for f, typ, t_inv, lat in pairs:
+            by[(f, typ)].append((t_inv / SECOND, lat / SECOND))
+        for (f, typ), pts in sorted(by.items()):
+            xs, ys = zip(*pts)
+            ax.scatter(xs, ys, s=12, alpha=0.7, color=colors.get(typ, "gray"),
+                       marker=markers.get(f, "x"), label=f"{f} {typ}")
+        ax.set_yscale("log")
+        ax.set_xlabel("time (s)")
+        ax.set_ylabel("latency (s)")
+        ax.legend(fontsize=7, ncol=3)
+        ax.set_title("latency raw")
+        fig.savefig(store_dir / "latency-raw.png", dpi=100,
+                    bbox_inches="tight")
+        plt.close(fig)
+
+        # latency-quantiles over time windows.
+        fig, ax = plt.subplots(figsize=(10, 5))
+        t = np.array([p[2] for p in pairs]) / SECOND
+        lat = np.array([p[3] for p in pairs]) / SECOND
+        edges = np.arange(0, t.max() + self.dt_s, self.dt_s)
+        for q in QUANTILES:
+            xs, ys = [], []
+            for lo, hi in zip(edges[:-1], edges[1:]):
+                m = (t >= lo) & (t < hi)
+                if m.any():
+                    xs.append((lo + hi) / 2)
+                    ys.append(np.quantile(lat[m], q))
+            if xs:
+                ax.plot(xs, ys, marker=".", label=f"p{int(q * 100)}")
+        ax.set_yscale("log")
+        ax.set_xlabel("time (s)")
+        ax.set_ylabel("latency (s)")
+        ax.legend(fontsize=8)
+        ax.set_title("latency quantiles")
+        fig.savefig(store_dir / "latency-quantiles.png", dpi=100,
+                    bbox_inches="tight")
+        plt.close(fig)
+
+        # rate: ops/sec per outcome over time.
+        fig, ax = plt.subplots(figsize=(10, 4))
+        for typ in (OK, FAIL, INFO):
+            ts = np.array([p[2] for p in pairs if p[1] == typ]) / SECOND
+            if len(ts):
+                hist, e = np.histogram(ts, bins=edges)
+                ax.plot((e[:-1] + e[1:]) / 2, hist / self.dt_s,
+                        color=colors[typ], label=typ)
+        ax.set_xlabel("time (s)")
+        ax.set_ylabel("ops/s")
+        ax.legend(fontsize=8)
+        ax.set_title("throughput")
+        fig.savefig(store_dir / "rate.png", dpi=100, bbox_inches="tight")
+        plt.close(fig)

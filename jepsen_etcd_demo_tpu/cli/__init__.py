@@ -1,0 +1,4 @@
+"""Command-line entry — jepsen.cli equivalent (reference -main,
+src/jepsen/etcdemo.clj:192-199)."""
+
+from .main import main, build_parser  # noqa: F401

@@ -1,0 +1,178 @@
+"""CLI: `test`, `analyze`, and `serve` subcommands.
+
+Mirror of the reference's entry (src/jepsen/etcdemo.clj:192-199: cli/run!
+over single-test-cmd + serve-cmd) with the demo's four flags
+(-q/--quorum, -r/--rate, --ops-per-key, -w/--workload; :177-190) plus the
+framework-standard flags the test-map merge supplies (--nodes, --time-limit,
+--concurrency, --test-count, --username; :147-152 docstring + noop-test
+[dep]). `analyze` is the stored-history re-check flow (check is re-runnable
+without re-running the cluster, SURVEY.md §5.4); the reference demo itself
+doesn't expose it but jepsen does.
+
+Exit code contract: nonzero iff a test's result is not valid (jepsen's run!
+behavior [dep])."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import sys
+from typing import Optional, Sequence
+
+from ..compose import WORKLOADS, etcd_test, fake_test
+from ..runner import run_test
+
+
+def positive_float(s: str) -> float:
+    v = float(s)
+    if v <= 0:
+        # the reference validates "must be a positive number" (:183)
+        raise argparse.ArgumentTypeError("must be a positive number")
+    return v
+
+
+def positive_int(s: str) -> int:
+    v = int(s)
+    if v <= 0:
+        raise argparse.ArgumentTypeError("must be a positive integer")
+    return v
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="jepsen-tpu",
+        description="TPU-native distributed-systems correctness harness")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    t = sub.add_parser("test", help="run a test")
+    t.add_argument("-w", "--workload", required=True,
+                   choices=sorted(WORKLOADS),
+                   help="test workload to run (required, like the "
+                        "reference's -w)")
+    t.add_argument("-q", "--quorum", action="store_true", default=False,
+                   help="use quorum reads (default false)")
+    t.add_argument("-r", "--rate", type=positive_float, default=10.0,
+                   metavar="HZ", help="approximate request rate (default 10)")
+    t.add_argument("--ops-per-key", type=positive_int, default=100,
+                   help="ops per key before rotating (default 100)")
+    t.add_argument("--nodes", default="n1,n2,n3,n4,n5",
+                   help="comma-separated node list")
+    t.add_argument("--time-limit", type=positive_float, default=30.0,
+                   help="main-phase wall clock budget in seconds")
+    t.add_argument("--concurrency", type=positive_int, default=10,
+                   help="client worker count")
+    t.add_argument("--test-count", type=positive_int, default=1,
+                   help="number of times to run the test")
+    t.add_argument("--username", default="root", help="ssh username")
+    t.add_argument("--private-key", default=None, help="ssh identity file")
+    t.add_argument("--seed", type=int, default=0,
+                   help="schedule/value rng seed (determinism!)")
+    t.add_argument("--store", default="store", help="results store root")
+    t.add_argument("--fake", action="store_true",
+                   help="hermetic run against the in-process fake cluster "
+                        "(no ssh/etcd)")
+    t.add_argument("--no-nemesis", action="store_true",
+                   help="disable fault injection")
+    t.add_argument("--version", default="v3.1.5",
+                   help="etcd version to install")
+    t.add_argument("--stale-read-prob", type=float, default=0.0,
+                   help="[fake] inject stale non-quorum reads")
+    t.add_argument("--lost-write-prob", type=float, default=0.0,
+                   help="[fake] inject acked-but-lost updates")
+
+    a = sub.add_parser("analyze", help="re-check a stored history")
+    a.add_argument("run_dir", help="store/<name>/<ts> directory")
+    a.add_argument("-w", "--workload", default="register",
+                   choices=sorted(WORKLOADS))
+    a.add_argument("--model", default="cas-register")
+    a.add_argument("--backend", default="jax", choices=["jax", "oracle"])
+
+    s = sub.add_parser("serve", help="serve the results store over http")
+    s.add_argument("--port", type=int, default=8080)
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--store", default="store")
+    return p
+
+
+def _test_opts(args) -> dict:
+    return {
+        "workload": args.workload,
+        "quorum": args.quorum,
+        "rate": args.rate,
+        "ops_per_key": args.ops_per_key,
+        "nodes": [n.strip() for n in args.nodes.split(",") if n.strip()],
+        "time_limit": args.time_limit,
+        "concurrency": args.concurrency,
+        "seed": args.seed,
+        "store_root": args.store,
+        "no_nemesis": args.no_nemesis,
+        "version": args.version,
+        "ssh": {"username": args.username, "private_key": args.private_key},
+        "stale_read_prob": args.stale_read_prob,
+        "lost_write_prob": args.lost_write_prob,
+    }
+
+
+def cmd_test(args) -> int:
+    rc = 0
+    for i in range(args.test_count):
+        opts = _test_opts(args)
+        opts["seed"] = args.seed + i
+        test = fake_test(opts) if args.fake else etcd_test(opts)
+        result = asyncio.run(run_test(test))
+        print(json.dumps({"valid": result.get("valid"),
+                          "op_count": result.get("op_count"),
+                          "run_seconds": round(
+                              result.get("run_seconds", 0), 2)}))
+        if result.get("valid") is not True:
+            rc = 1
+    return rc
+
+
+def cmd_analyze(args) -> int:
+    from ..store.store import RunDir
+    from ..checkers import (Compose, IndependentChecker, Linearizable,
+                            SetChecker, TimelineChecker)
+    from ..checkers.perf import PerfChecker
+
+    run = RunDir(args.run_dir)
+    history = run.read_history()
+    if args.workload == "set":
+        sub = SetChecker()
+        checker = Compose({"perf": PerfChecker(), "indep": sub})
+    else:
+        checker = Compose({"perf": PerfChecker(),
+                           "indep": IndependentChecker(Compose({
+                               "linear": Linearizable(args.model,
+                                                      backend=args.backend),
+                               "timeline": TimelineChecker()}))})
+    result = checker.check({}, history, {"store_dir": str(run.path)})
+    run.write_results(result)
+    print(json.dumps({"valid": result.get("valid")}))
+    return 0 if result.get("valid") is True else 1
+
+
+def cmd_serve(args) -> int:
+    from ..web.server import serve
+    serve(args.store, host=args.host, port=args.port)
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    args = build_parser().parse_args(argv)
+    if args.command == "test":
+        return cmd_test(args)
+    if args.command == "analyze":
+        return cmd_analyze(args)
+    if args.command == "serve":
+        return cmd_serve(args)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,12 @@
+"""Client layer: the 5-method lifecycle protocol + concrete clients.
+
+Equivalent of jepsen.client's Client protocol as implemented by the reference
+demo (register client: src/jepsen/etcdemo.clj:76-108; set client:
+src/jepsen/etcdemo/set.clj:10-40).
+"""
+
+from .base import Client, ClientError, Timeout, NotFound  # noqa: F401
+from .fake_kv import FakeKVStore, FakeKVClient  # noqa: F401
+from .register import RegisterClient  # noqa: F401
+from .set_client import SetClient  # noqa: F401
+from .etcd import EtcdClient, EtcdError  # noqa: F401

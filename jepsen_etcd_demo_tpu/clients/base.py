@@ -1,0 +1,66 @@
+"""Client lifecycle protocol — mirror of jepsen.client/Client.
+
+Five methods, same seam as the reference implements at
+src/jepsen/etcdemo.clj:78-108: open! / setup! / invoke! / close! / teardown!.
+`invoke` is async (workers are asyncio tasks, the analogue of jepsen's worker
+threads) and returns the *completed* op.
+
+Completion semantics the whole checker stack depends on (reference
+src/jepsen/etcdemo.clj:100-105):
+  * A definite failure completes :fail (op did not happen).
+  * An INDETERMINATE failure (e.g. timeout on a write/cas) completes :info —
+    the op may have taken effect; the checker must keep it open forever.
+  * Reads may complete :fail on timeout because an unobserved read never
+    constrains the model (reference :100-102 maps reads to :fail).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+from ..ops.op import Op
+
+
+class ClientError(Exception):
+    """Definite failure: the op did not take effect."""
+
+
+class NotFound(ClientError):
+    """Key absent — the reference's etcd errorCode 100 edge
+    (src/jepsen/etcdemo.clj:104-105)."""
+
+
+class Timeout(Exception):
+    """Indeterminate: the op may or may not have taken effect
+    (SocketTimeoutException edge, src/jepsen/etcdemo.clj:100-102)."""
+
+
+class Client(abc.ABC):
+    """Per-process client. The runner calls open() to get a fresh connected
+    instance per logical process, setup() once per run for data-plane init,
+    then invoke() per op; close()/teardown() on the way down."""
+
+    async def open(self, test: dict, node: str) -> "Client":
+        """Return a client connected to `node` (may be self)."""
+        return self
+
+    async def setup(self, test: dict) -> None:
+        pass
+
+    @abc.abstractmethod
+    async def invoke(self, test: dict, op: Op) -> Op:
+        """Execute op, return its completion (type ok/fail/info)."""
+
+    async def close(self, test: dict) -> None:
+        pass
+
+    async def teardown(self, test: dict) -> None:
+        pass
+
+
+def completed(op: Op, type_: str, value: Any = None, error: Any = None) -> Op:
+    """Build the completion record for an invocation."""
+    return Op(type=type_, f=op.f,
+              value=op.value if value is None else value,
+              process=op.process, error=error)

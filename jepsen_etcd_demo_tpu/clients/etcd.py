@@ -1,0 +1,120 @@
+"""etcd v2 HTTP API client — the verschlimmbesserung 5-call surface.
+
+The reference speaks etcd's v2 keys API through verschlimmbesserung
+(connect/get/reset!/cas!/swap!, reference src/jepsen/etcdemo.clj:79-98,
+set.clj:13-29) with a 5000 ms timeout (:79). Same surface here over httpx:
+
+  GET /v2/keys/<k>[?quorum=true]            -> value | NotFound(code 100)
+  PUT /v2/keys/<k> value=v                  -> reset
+  PUT /v2/keys/<k> prevValue=old value=new  -> cas (False on code 101)
+  swap(k, fn): get-with-index + prevIndex CAS retry loop
+
+Error mapping at this layer is value-level only; the op-level completion
+mapping (timeout→info etc.) lives in RegisterClient/SetClient, exactly like
+the reference splits verschlimmbesserung from the Client record.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import httpx
+
+from .base import ClientError, NotFound, Timeout
+
+ETCD_KEY_MISSING = 100   # etcd v2 errorCode for absent key (reference :104)
+ETCD_CAS_FAILED = 101    # compare failed
+
+
+class EtcdError(ClientError):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"etcd error {code}: {message}")
+        self.code = code
+
+
+class EtcdClient:
+    """One connection to one node's client port (2379,
+    reference support.clj:14-17)."""
+
+    def __init__(self, base_url: str, timeout_s: float = 5.0):
+        self.base_url = base_url.rstrip("/")
+        self.http = httpx.AsyncClient(timeout=timeout_s)
+
+    @classmethod
+    def connect(cls, node: str, port: int = 2379,
+                timeout_s: float = 5.0) -> "EtcdClient":
+        return cls(f"http://{node}:{port}", timeout_s=timeout_s)
+
+    async def close(self):
+        await self.http.aclose()
+
+    def _url(self, key: str) -> str:
+        return f"{self.base_url}/v2/keys/{key}"
+
+    @staticmethod
+    def _raise_for(body: dict):
+        code = body.get("errorCode")
+        if code == ETCD_KEY_MISSING:
+            raise NotFound(body.get("message", "key not found"))
+        if code is not None and code != ETCD_CAS_FAILED:
+            raise EtcdError(code, body.get("message", ""))
+
+    async def _request(self, method: str, url: str, **kw) -> dict:
+        try:
+            resp = await self.http.request(method, url, **kw)
+            return resp.json()
+        except (httpx.TimeoutException, httpx.ConnectError,
+                httpx.ReadError, httpx.RemoteProtocolError) as e:
+            raise Timeout(str(e)) from e
+
+    # -- the 5-call surface ----------------------------------------------
+    async def get(self, key: str, quorum: bool = False) -> Optional[str]:
+        params = {"quorum": "true"} if quorum else {}
+        body = await self._request("GET", self._url(key), params=params)
+        if body.get("errorCode") == ETCD_KEY_MISSING:
+            return None
+        self._raise_for(body)
+        return body["node"]["value"]
+
+    async def get_with_index(self, key: str,
+                             quorum: bool = False) -> tuple[str, int]:
+        params = {"quorum": "true"} if quorum else {}
+        body = await self._request("GET", self._url(key), params=params)
+        self._raise_for(body)
+        node = body["node"]
+        return node["value"], node["modifiedIndex"]
+
+    async def reset(self, key: str, value: Any) -> None:
+        body = await self._request("PUT", self._url(key),
+                                   data={"value": str(value)})
+        self._raise_for(body)
+
+    async def cas(self, key: str, old: Any, new: Any) -> bool:
+        body = await self._request(
+            "PUT", self._url(key),
+            data={"value": str(new)}, params={"prevValue": str(old)})
+        if body.get("errorCode") == ETCD_CAS_FAILED:
+            return False
+        self._raise_for(body)
+        return True
+
+    async def swap(self, key: str, fn) -> str:
+        """Atomic read-modify-write via prevIndex CAS retries — the client-
+        side loop verschlimmbesserung's swap! runs (reference set.clj:26-31)."""
+        for _ in range(64):
+            cur, idx = await self.get_with_index(key, quorum=True)
+            new = fn(cur)
+            body = await self._request(
+                "PUT", self._url(key),
+                data={"value": str(new)}, params={"prevIndex": str(idx)})
+            if body.get("errorCode") == ETCD_CAS_FAILED:
+                continue
+            self._raise_for(body)
+            return new
+        raise Timeout("swap retry budget exhausted")
+
+
+def etcd_conn_factory(port: int = 2379, timeout_s: float = 5.0):
+    def factory(test, node):
+        return EtcdClient.connect(node, port=port, timeout_s=timeout_s)
+    return factory

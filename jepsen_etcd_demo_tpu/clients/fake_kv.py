@@ -1,0 +1,158 @@
+"""Hermetic in-process KV "cluster" with injectable consistency bugs.
+
+The reference has no hermetic backend at all — every run needs a real 5-node
+etcd cluster (SURVEY.md §4). This build adds one so the full pipeline
+(generator → client → history → checker) runs in CI: a fake replicated
+register store exposing the same 5-call surface the demo uses through
+verschlimmbesserung (connect/get/reset/cas/swap — reference
+src/jepsen/etcdemo.clj:79-98, set.clj:13-29), plus fault hooks the fake
+nemesis drives.
+
+Fault model:
+  * Partition: the store tracks a set of "isolated" nodes. A client bound to
+    an isolated node gets Timeout on every op (indeterminate — the op is
+    counted as possibly-applied with probability `partial_apply_prob`,
+    exercising the :info open-forever path end to end).
+  * Injectable bugs (to prove the checkers DETECT badness, SURVEY.md §4):
+      stale_read_prob      — non-quorum reads may return a stale snapshot
+                             (quorum reads are always linearizable, matching
+                             etcd's q=true semantics the -q flag toggles,
+                             reference src/jepsen/etcdemo.clj:88,179)
+      lost_write_prob      — acked writes that never took effect
+      duplicate_cas_prob   — a failed CAS that actually applied (acked :fail
+                             but took effect), the inverse indeterminacy
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Any, Optional
+
+from ..ops.op import Op
+from .base import Client, NotFound, Timeout
+
+
+class FakeKVStore:
+    """The simulated cluster: one logical linearizable register map, plus a
+    bounded history of past snapshots for stale reads."""
+
+    def __init__(self, nodes: Optional[list[str]] = None,
+                 seed: int = 0,
+                 stale_read_prob: float = 0.0,
+                 lost_write_prob: float = 0.0,
+                 duplicate_cas_prob: float = 0.0,
+                 partial_apply_prob: float = 0.5,
+                 op_delay_s: float = 0.0):
+        self.nodes = nodes or ["n1", "n2", "n3", "n4", "n5"]
+        self.data: dict[str, Any] = {}
+        self.snapshots: list[dict[str, Any]] = []
+        self.isolated: set[str] = set()
+        self.rng = random.Random(seed)
+        self.stale_read_prob = stale_read_prob
+        self.lost_write_prob = lost_write_prob
+        self.duplicate_cas_prob = duplicate_cas_prob
+        self.partial_apply_prob = partial_apply_prob
+        self.op_delay_s = op_delay_s
+        self.lock = asyncio.Lock()
+
+    # -- fault hooks (driven by the fake nemesis) -------------------------
+    def isolate(self, nodes: set[str]):
+        self.isolated = set(nodes)
+
+    def heal(self):
+        self.isolated = set()
+
+    def _snapshot(self):
+        self.snapshots.append(dict(self.data))
+        if len(self.snapshots) > 64:
+            self.snapshots.pop(0)
+
+    async def _enter(self, node: str):
+        if self.op_delay_s:
+            await asyncio.sleep(self.op_delay_s * self.rng.random())
+        if node in self.isolated:
+            # Partitioned node: the op MAY still land (it raced the
+            # partition). Apply-then-timeout gives the checker real
+            # indeterminacy to reason about.
+            raise Timeout(f"node {node} partitioned")
+
+    # -- the 5-call surface ----------------------------------------------
+    async def get(self, node: str, key: str, quorum: bool = False) -> Any:
+        await self._enter(node)
+        async with self.lock:
+            if (not quorum and self.snapshots
+                    and self.rng.random() < self.stale_read_prob):
+                snap = self.rng.choice(self.snapshots)
+                return snap.get(key)
+            return self.data.get(key)
+
+    async def reset(self, node: str, key: str, value: Any) -> None:
+        maybe_timeout = node in self.isolated
+        if maybe_timeout and self.rng.random() >= self.partial_apply_prob:
+            raise Timeout(f"node {node} partitioned")
+        async with self.lock:
+            self._snapshot()
+            if self.rng.random() >= self.lost_write_prob:
+                self.data[key] = value
+        if maybe_timeout:
+            raise Timeout(f"node {node} partitioned (op applied)")
+        if self.op_delay_s:
+            await asyncio.sleep(self.op_delay_s * self.rng.random())
+
+    async def cas(self, node: str, key: str, old: Any, new: Any) -> bool:
+        maybe_timeout = node in self.isolated
+        if maybe_timeout and self.rng.random() >= self.partial_apply_prob:
+            raise Timeout(f"node {node} partitioned")
+        async with self.lock:
+            if key not in self.data:
+                raise NotFound(key)
+            applied = self.data[key] == old
+            if applied:
+                self._snapshot()
+                # Lost-update bug: ack success but drop the update.
+                if self.rng.random() >= self.lost_write_prob:
+                    self.data[key] = new
+            elif self.rng.random() < self.duplicate_cas_prob:
+                self._snapshot()
+                self.data[key] = new  # bug: acked :fail but applied
+        if maybe_timeout:
+            raise Timeout(f"node {node} partitioned (op applied)")
+        if self.op_delay_s:
+            await asyncio.sleep(self.op_delay_s * self.rng.random())
+        return applied
+
+    async def swap(self, node: str, key: str, fn) -> Any:
+        """Atomic read-modify-write retry loop — verschlimmbesserung's swap!
+        (reference set.clj:26-31 uses it for set adds)."""
+        for _ in range(64):
+            await self._enter(node)
+            async with self.lock:
+                if key not in self.data:
+                    raise NotFound(key)
+                cur = self.data[key]
+            new = fn(cur)
+            try:
+                if await self.cas(node, key, cur, new):
+                    return new
+            except NotFound:
+                raise
+        raise Timeout("swap retry budget exhausted")
+
+
+class FakeKVClient(Client):
+    """Value-level client over FakeKVStore; register/set clients layer the
+    op-semantics (error mapping) on top of this, exactly like the reference
+    clients layer over verschlimmbesserung."""
+
+    def __init__(self, store: FakeKVStore):
+        self.store = store
+        self.node: Optional[str] = None
+
+    async def open(self, test: dict, node: str) -> "FakeKVClient":
+        c = FakeKVClient(self.store)
+        c.node = node
+        return c
+
+    async def invoke(self, test: dict, op: Op) -> Op:  # pragma: no cover
+        raise NotImplementedError("use RegisterClient/SetClient over a store")

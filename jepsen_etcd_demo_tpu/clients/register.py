@@ -1,0 +1,96 @@
+"""Register-workload client: read / write / cas over a KV connection.
+
+Mirror of the reference's Client record (src/jepsen/etcdemo.clj:76-108),
+including the load-bearing error mapping:
+  * timeout on read        -> :fail (:error :timeout)     [:100-102]
+  * timeout on write/cas   -> :info (indeterminate!)      [:100-102]
+  * key-missing (etcd 100) -> :fail (:error :not-found)   [:104-105]
+  * cas returned false     -> :fail                       [:95-98]
+
+Values are (key, value) independent-tuples (reference :84,:90); reads parse
+the stored string to an int, None surviving for missing keys (:71-74,:87-90).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..ops.op import Op
+from .base import Client, ClientError, NotFound, Timeout, completed
+
+
+def parse_long(s: Optional[str]):
+    """nil-passing string→int (reference parse-long, :71-74)."""
+    return None if s is None else int(s)
+
+
+class RegisterClient(Client):
+    """conn_factory(test, node) -> an object with async get/reset/cas
+    (FakeKV bound connection or EtcdClient)."""
+
+    def __init__(self, conn_factory: Callable, conn=None):
+        self.conn_factory = conn_factory
+        self.conn = conn
+
+    async def open(self, test: dict, node: str) -> "RegisterClient":
+        conn = self.conn_factory(test, node)
+        if hasattr(conn, "__await__"):
+            conn = await conn
+        return RegisterClient(self.conn_factory, conn)
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        k, v = op.value
+        try:
+            if op.f == "read":
+                raw = await self.conn.get(str(k),
+                                          quorum=bool(test.get("quorum")))
+                return completed(op, "ok", value=(k, parse_long(raw)))
+            if op.f == "write":
+                await self.conn.reset(str(k), str(v))
+                return completed(op, "ok")
+            if op.f == "cas":
+                old, new = v
+                ok = await self.conn.cas(str(k), str(old), str(new))
+                return completed(op, "ok" if ok else "fail")
+            raise ValueError(f"unknown op f={op.f!r}")
+        except Timeout:
+            if op.f == "read":
+                return completed(op, "fail", error="timeout")
+            return completed(op, "info", error="timeout")
+        except NotFound:
+            return completed(op, "fail", error="not-found")
+        except ClientError as e:
+            return completed(op, "fail", error=str(e))
+
+    async def close(self, test: dict) -> None:
+        close = getattr(self.conn, "close", None)
+        if close is not None:
+            res = close()
+            if hasattr(res, "__await__"):
+                await res
+
+
+class _BoundFakeConn:
+    """FakeKVStore bound to one node, presenting async get/reset/cas/swap."""
+
+    def __init__(self, store, node: str):
+        self.store = store
+        self.node = node
+
+    async def get(self, key, quorum=False):
+        return await self.store.get(self.node, key, quorum=quorum)
+
+    async def reset(self, key, value):
+        return await self.store.reset(self.node, key, value)
+
+    async def cas(self, key, old, new):
+        return await self.store.cas(self.node, key, old, new)
+
+    async def swap(self, key, fn):
+        return await self.store.swap(self.node, key, fn)
+
+
+def fake_conn_factory(store):
+    def factory(test, node):
+        return _BoundFakeConn(store, node)
+    return factory

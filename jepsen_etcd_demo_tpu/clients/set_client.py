@@ -1,0 +1,78 @@
+"""Grow-only-set client: concurrent adds to one key, one final read.
+
+Mirror of the reference SetClient (src/jepsen/etcdemo/set.clj:10-40): a single
+fixed key holds a serialized set; setup initializes it to the empty set
+(:15-16); :add conj's via the connection's atomic swap (read-modify-write CAS
+retry loop, :26-31); :read parses the stored serialization (:21-24).
+
+Serialization: JSON sorted list (the reference stores Clojure EDN "#{}" —
+same idea, host-language-native encoding)."""
+
+from __future__ import annotations
+
+import json
+from typing import Callable
+
+from ..ops.op import Op
+from .base import Client, ClientError, NotFound, Timeout, completed
+
+SET_KEY = "a-set"
+
+
+def _dumps(s: set) -> str:
+    return json.dumps(sorted(s))
+
+
+def _loads(raw: str) -> set:
+    return set(json.loads(raw))
+
+
+class SetClient(Client):
+    def __init__(self, conn_factory: Callable, conn=None):
+        self.conn_factory = conn_factory
+        self.conn = conn
+
+    async def open(self, test: dict, node: str) -> "SetClient":
+        conn = self.conn_factory(test, node)
+        if hasattr(conn, "__await__"):
+            conn = await conn
+        return SetClient(self.conn_factory, conn)
+
+    async def setup(self, test: dict) -> None:
+        # Initialize, then read back and retry: setup must succeed even
+        # against a backend with injected lost-write bugs (the workload's
+        # assertions are about the RUN, not about setup).
+        for _ in range(16):
+            await self.conn.reset(SET_KEY, _dumps(set()))
+            if await self.conn.get(SET_KEY, quorum=True) is not None:
+                return
+        raise RuntimeError("SetClient.setup could not initialize the set key")
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                raw = await self.conn.get(SET_KEY,
+                                          quorum=bool(test.get("quorum")))
+                if raw is None:
+                    return completed(op, "fail", error="not-found")
+                return completed(op, "ok", value=sorted(_loads(raw)))
+            if op.f == "add":
+                await self.conn.swap(
+                    SET_KEY, lambda raw: _dumps(_loads(raw) | {op.value}))
+                return completed(op, "ok")
+            raise ValueError(f"unknown op f={op.f!r}")
+        except Timeout:
+            if op.f == "read":
+                return completed(op, "fail", error="timeout")
+            return completed(op, "info", error="timeout")
+        except NotFound:
+            return completed(op, "fail", error="not-found")
+        except ClientError as e:
+            return completed(op, "fail", error=str(e))
+
+    async def close(self, test: dict) -> None:
+        close = getattr(self.conn, "close", None)
+        if close is not None:
+            res = close()
+            if hasattr(res, "__await__"):
+                await res

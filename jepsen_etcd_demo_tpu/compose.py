@@ -1,0 +1,205 @@
+"""Test composition (L7) — the etcd-test / workloads layer.
+
+Mirror of the reference's test assembly (src/jepsen/etcdemo.clj:110-190):
+workload registry {"set", "register"} (:128-131), the phased generator
+with rate limiting + cycling nemesis schedule (add-phase-generator,
+:134-144) and the main → heal → recover → final-phase shape (:168-174),
+all merged over noop-test-style defaults (:156-157).
+
+Two entry compositions:
+  * etcd_test  — the real thing: etcd DB over SSH, partition nemesis.
+  * fake_test  — same wiring over the in-process FakeKVStore (hermetic; the
+    build's "distributed-without-cluster" capability, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from . import generators as gen
+from .checkers import Compose, IndependentChecker, Linearizable, SetChecker
+from .checkers.perf import PerfChecker
+from .checkers.timeline import TimelineChecker
+from .clients.etcd import etcd_conn_factory
+from .clients.fake_kv import FakeKVStore
+from .clients.register import RegisterClient, fake_conn_factory
+from .clients.set_client import SetClient
+from .db.debian import debian_setup
+from .db.etcd import EtcdDB
+from .db.fake import FakeDB
+from .nemesis.partition import PartitionRandomHalves, FakePartitionNemesis
+
+# noop-test-style defaults (reference tests/noop-test [dep]: n1..n5,
+# concurrency, time-limit; overridden by CLI opts then by the demo map,
+# src/jepsen/etcdemo.clj:156-157).
+DEFAULTS: dict[str, Any] = {
+    "nodes": ["n1", "n2", "n3", "n4", "n5"],
+    "concurrency": 10,
+    "time_limit": 30,
+    "rate": 10.0,           # Hz (reference :180-183)
+    "ops_per_key": 100,     # (:184-187)
+    "quorum": False,        # (:179)
+    "seed": 0,
+    "store_root": "store",
+}
+
+
+def r(ctx):
+    """{:type :invoke, :f :read} (reference :67)."""
+    return {"f": "read", "value": None}
+
+
+def w(ctx):
+    """write of (rand-int 5) (reference :68)."""
+    return {"f": "write", "value": ctx.rng.randrange(5)}
+
+
+def cas(ctx):
+    """cas of a random [old new] over 0-4 (reference :69)."""
+    return {"f": "cas", "value": (ctx.rng.randrange(5), ctx.rng.randrange(5))}
+
+
+def register_workload(opts: dict, conn_factory: Callable) -> dict:
+    """Register workload (reference :110-126): mixed r/w/cas over many
+    independent keys, checked {linear: TPU-WGL cas-register, timeline: html}
+    per key under the independent wrapper."""
+    return {
+        "client": RegisterClient(conn_factory),
+        "checker": IndependentChecker(Compose({
+            "linear": Linearizable("cas-register", backend="jax"),
+            "timeline": TimelineChecker(),
+        })),
+        "generator": gen.concurrent_generator(
+            10, _key_stream(), lambda k: gen.limit(
+                int(opts.get("ops_per_key", 100)), gen.mix([r, w, cas]))),
+        "final_generator": None,
+    }
+
+
+def _key_stream():
+    i = 0
+    while True:
+        yield i
+        i += 1
+
+
+def set_workload(opts: dict, conn_factory: Callable) -> dict:
+    """Grow-only-set workload (reference set.clj:42-49): infinite adds of
+    successive ints, one final read after healing, set-durability checker."""
+    counter = iter(range(10**9))
+    return {
+        "client": SetClient(conn_factory),
+        "checker": SetChecker(),
+        "generator": gen.repeat(lambda ctx: {"f": "add",
+                                             "value": next(counter)}),
+        "final_generator": gen.once({"f": "read", "value": None}),
+    }
+
+
+WORKLOADS = {
+    "register": register_workload,
+    "set": set_workload,
+}
+
+
+def add_phase_generator(opts: dict, workload_gen, final_gen) -> gen.Phases:
+    """Rate-limit the client stream, overlay the cycling nemesis schedule,
+    cap wall time; then the heal → recover → final-read phases
+    (reference :134-144 and :168-174)."""
+    rate = float(opts.get("rate", 10.0))
+    main = gen.time_limit(
+        float(opts.get("time_limit", 30)),
+        _merge(
+            gen.clients_gen(gen.stagger(1.0 / rate, workload_gen)),
+            gen.nemesis_gen(gen.cycle(lambda: [
+                gen.sleep(float(opts.get("nemesis_interval", 5))),
+                gen.once({"f": "start", "value": None}),
+                gen.sleep(float(opts.get("nemesis_interval", 5))),
+                gen.once({"f": "stop", "value": None}),
+            ])) if not opts.get("no_nemesis") else gen.Gen()))
+    phases = [
+        main,
+        gen.log("Healing cluster"),
+        gen.nemesis_gen(gen.once({"f": "stop", "value": None})),
+        gen.log("Waiting for recovery"),
+        gen.sleep(float(opts.get("recovery_wait", 10))),
+    ]
+    if final_gen is not None:
+        phases.append(gen.clients_gen(final_gen))
+    return gen.phases(*phases)
+
+
+class _merge(gen.Gen):
+    """Interleave two channel-routed generators: each asker takes from
+    whichever answers (clients stream + nemesis stream side by side,
+    reference :136-143)."""
+
+    def __init__(self, *gens):
+        self.gens = list(gens)
+
+    def next_for(self, ctx):
+        best_wake = None
+        exhausted = 0
+        for g in self.gens:
+            out = g.next_for(ctx)
+            if isinstance(out, gen.Pending):
+                if out.wake is not None:
+                    best_wake = (out.wake if best_wake is None
+                                 else min(best_wake, out.wake))
+            elif out is None:
+                exhausted += 1
+            else:
+                return out
+        if exhausted == len(self.gens):
+            return None
+        return gen.Pending(best_wake)
+
+
+def compose_test(opts: dict, conn_factory: Callable,
+                 workload_name: Optional[str] = None) -> dict:
+    """Build the test map: defaults ← opts ← workload wiring
+    (merge order mirrors reference :156-175)."""
+    test = dict(DEFAULTS)
+    test.update(opts)
+    name = workload_name or test.get("workload", "register")
+    workload = WORKLOADS[name](test, conn_factory)
+    test.setdefault("name", f"etcd q={str(test['quorum']).lower()}")
+    test["workload"] = name
+    test["client"] = workload["client"]
+    test["generator"] = add_phase_generator(
+        test, workload["generator"], workload.get("final_generator"))
+    test["checker"] = Compose({
+        "perf": PerfChecker(),
+        "indep": workload["checker"],
+    })
+    return test
+
+
+def etcd_test(opts: dict) -> dict:
+    """The real composition (reference etcd-test, :146-175): Debian OS prep,
+    etcd v3.1.5 DB, SSH control, iptables partition nemesis."""
+    test = compose_test(opts, etcd_conn_factory())
+    test["db"] = EtcdDB(version=opts.get("version", "v3.1.5"))
+    test["os_setup"] = lambda runner, node: debian_setup(runner, node)
+    test["nemesis"] = PartitionRandomHalves(seed=int(test.get("seed", 0)))
+    return test
+
+
+def fake_test(opts: dict, store: Optional[FakeKVStore] = None) -> dict:
+    """Hermetic composition over the in-process fake cluster."""
+    opts = dict(opts)
+    opts["local_mode"] = True
+    if store is None:
+        store = FakeKVStore(seed=int(opts.get("seed", 0)),
+                            stale_read_prob=float(
+                                opts.get("stale_read_prob", 0.0)),
+                            lost_write_prob=float(
+                                opts.get("lost_write_prob", 0.0)),
+                            duplicate_cas_prob=float(
+                                opts.get("duplicate_cas_prob", 0.0)))
+    test = compose_test(opts, fake_conn_factory(store))
+    test["db"] = FakeDB()
+    test["nemesis"] = FakePartitionNemesis(store,
+                                           seed=int(test.get("seed", 0)))
+    test["fake_store"] = store
+    return test

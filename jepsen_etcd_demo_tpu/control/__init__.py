@@ -1,0 +1,17 @@
+"""Remote-control plane: run commands on cluster nodes.
+
+Equivalent of jepsen.control + control.util (reference call sites
+src/jepsen/etcdemo.clj:36-60: c/su, c/exec, cu/install-archive!,
+cu/start-daemon!, cu/stop-daemon!). The transport is the system `ssh`
+binary driven over subprocess (the reference uses clj-ssh/jsch,
+jepsen.etcdemo.iml:21,38); a LocalRunner runs the same command surface
+against localhost for hermetic tests (SURVEY.md §4
+"distributed-without-cluster").
+"""
+
+from .runner import (  # noqa: F401
+    CommandResult, CommandError, Runner, LocalRunner, SSHRunner, shellquote,
+)
+from .daemon import (  # noqa: F401
+    install_archive, start_daemon, stop_daemon, daemon_running,
+)

@@ -1,0 +1,66 @@
+"""Daemon + archive helpers — jepsen.control.util equivalents.
+
+Reference call sites: cu/install-archive! (download + unpack a release
+tarball, src/jepsen/etcdemo.clj:37-40), cu/start-daemon! (daemonize with
+pidfile + logfile + chdir, :42-54), cu/stop-daemon! (kill by pidfile, :59).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from .runner import Runner, shellquote
+
+
+async def install_archive(r: Runner, url: str, dest_dir: str,
+                          su: bool = True) -> None:
+    """Download `url` (tar.gz) and unpack into dest_dir, stripping the
+    top-level directory like cu/install-archive! does."""
+    tmp = f"/tmp/jepsen-archive-{abs(hash(url)) % 10**8}.tar.gz"
+    await r.run(
+        f"mkdir -p {shellquote(dest_dir)} && "
+        f"([ -f {shellquote(tmp)} ] || "
+        f" wget -q -O {shellquote(tmp)} {shellquote(url)} || "
+        f" curl -fsSL -o {shellquote(tmp)} {shellquote(url)}) && "
+        f"tar xzf {shellquote(tmp)} -C {shellquote(dest_dir)} "
+        f"--strip-components=1",
+        su=su, timeout_s=600.0)
+
+
+async def start_daemon(r: Runner, binary: str, args: Sequence,
+                       logfile: str, pidfile: str, chdir: str,
+                       su: bool = True) -> None:
+    """Start `binary args...` as a daemon: nohup + setsid, stdout/stderr to
+    logfile, pid recorded. Idempotent: a live pidfile means already running
+    (cu/start-daemon! semantics)."""
+    argstr = " ".join(shellquote(a) for a in args)
+    await r.run(
+        f"if [ -f {shellquote(pidfile)} ] && "
+        f"kill -0 $(cat {shellquote(pidfile)}) 2>/dev/null; then "
+        f"  echo already-running; "
+        f"else "
+        f"  cd {shellquote(chdir)} && "
+        f"  setsid nohup {shellquote(binary)} {argstr} "
+        f"  >> {shellquote(logfile)} 2>&1 < /dev/null & "
+        f"  echo $! > {shellquote(pidfile)}; "
+        f"fi",
+        su=su, timeout_s=60.0)
+
+
+async def stop_daemon(r: Runner, pidfile: str, su: bool = True) -> None:
+    """Kill the daemon by pidfile (SIGKILL like cu/stop-daemon!), then
+    remove the pidfile. Idempotent."""
+    await r.run(
+        f"if [ -f {shellquote(pidfile)} ]; then "
+        f"  kill -9 $(cat {shellquote(pidfile)}) 2>/dev/null || true; "
+        f"  rm -f {shellquote(pidfile)}; "
+        f"fi",
+        su=su, check=False, timeout_s=60.0)
+
+
+async def daemon_running(r: Runner, pidfile: str) -> bool:
+    res = await r.run(
+        f"[ -f {shellquote(pidfile)} ] && "
+        f"kill -0 $(cat {shellquote(pidfile)}) 2>/dev/null",
+        check=False)
+    return res.ok

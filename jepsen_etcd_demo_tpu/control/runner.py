@@ -1,0 +1,159 @@
+"""Command runners: SSH to a node, or localhost subprocess."""
+
+from __future__ import annotations
+
+import asyncio
+import shlex
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+
+def shellquote(arg) -> str:
+    return shlex.quote(str(arg))
+
+
+@dataclass
+class CommandResult:
+    argv: list[str]
+    returncode: int
+    stdout: str
+    stderr: str
+
+    @property
+    def ok(self) -> bool:
+        return self.returncode == 0
+
+
+class CommandError(Exception):
+    def __init__(self, result: CommandResult):
+        self.result = result
+        super().__init__(
+            f"command {' '.join(result.argv)!r} exited "
+            f"{result.returncode}: {result.stderr[-500:]}")
+
+
+class Runner:
+    """Run shell commands 'on a node'. su=True wraps with sudo
+    (c/su, reference src/jepsen/etcdemo.clj:36)."""
+
+    node: str = "local"
+
+    async def run(self, cmd: str, su: bool = False, check: bool = True,
+                  timeout_s: float = 120.0) -> CommandResult:
+        raise NotImplementedError
+
+    async def exec(self, *argv, su: bool = False, check: bool = True,
+                   timeout_s: float = 120.0) -> CommandResult:
+        """c/exec equivalent: argv-style, auto-quoted."""
+        cmd = " ".join(shellquote(a) for a in argv)
+        return await self.run(cmd, su=su, check=check, timeout_s=timeout_s)
+
+    async def _spawn(self, argv: Sequence[str], check: bool,
+                     timeout_s: float) -> CommandResult:
+        proc = await asyncio.create_subprocess_exec(
+            *argv,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE)
+        try:
+            out, err = await asyncio.wait_for(proc.communicate(), timeout_s)
+        except asyncio.TimeoutError:
+            proc.kill()
+            await proc.wait()
+            res = CommandResult(list(argv), -1, "", f"timeout after {timeout_s}s")
+            if check:
+                raise CommandError(res)
+            return res
+        res = CommandResult(list(argv), proc.returncode or 0,
+                            out.decode(errors="replace"),
+                            err.decode(errors="replace"))
+        if check and not res.ok:
+            raise CommandError(res)
+        return res
+
+
+class LocalRunner(Runner):
+    """Run on this host — hermetic stand-in for a node (CI without a
+    cluster). su is a no-op by default so tests never sudo."""
+
+    def __init__(self, node: str = "local", allow_su: bool = False):
+        self.node = node
+        self.allow_su = allow_su
+
+    async def run(self, cmd: str, su: bool = False, check: bool = True,
+                  timeout_s: float = 120.0) -> CommandResult:
+        if su and self.allow_su:
+            cmd = f"sudo sh -c {shellquote(cmd)}"
+        return await self._spawn(["sh", "-c", cmd], check, timeout_s)
+
+
+class SSHRunner(Runner):
+    """Drive a node over the system ssh binary.
+
+    Equivalent transport role to the reference's clj-ssh/jsch sessions
+    (jepsen.etcdemo.iml:21,38): one logical session per node, command
+    assembly with quoting, sudo wrapping."""
+
+    def __init__(self, node: str, username: str = "root",
+                 port: int = 22, private_key: Optional[str] = None,
+                 strict_host_key_checking: bool = False,
+                 connect_timeout_s: int = 10):
+        self.node = node
+        self.username = username
+        self.port = port
+        self.private_key = private_key
+        self.strict = strict_host_key_checking
+        self.connect_timeout_s = connect_timeout_s
+
+    def _ssh_argv(self, cmd: str) -> list[str]:
+        argv = ["ssh", "-p", str(self.port),
+                "-o", "BatchMode=yes",
+                "-o", f"ConnectTimeout={self.connect_timeout_s}"]
+        if not self.strict:
+            argv += ["-o", "StrictHostKeyChecking=no",
+                     "-o", "UserKnownHostsFile=/dev/null",
+                     "-o", "LogLevel=ERROR"]
+        if self.private_key:
+            argv += ["-i", self.private_key]
+        argv += [f"{self.username}@{self.node}", cmd]
+        return argv
+
+    async def run(self, cmd: str, su: bool = False, check: bool = True,
+                  timeout_s: float = 120.0) -> CommandResult:
+        if su and self.username != "root":
+            cmd = f"sudo sh -c {shellquote(cmd)}"
+        return await self._spawn(self._ssh_argv(cmd), check, timeout_s)
+
+    async def upload(self, local_path: str, remote_path: str) -> CommandResult:
+        argv = ["scp", "-P", str(self.port), "-o", "BatchMode=yes"]
+        if not self.strict:
+            argv += ["-o", "StrictHostKeyChecking=no",
+                     "-o", "UserKnownHostsFile=/dev/null",
+                     "-o", "LogLevel=ERROR"]
+        if self.private_key:
+            argv += ["-i", self.private_key]
+        argv += [local_path, f"{self.username}@{self.node}:{remote_path}"]
+        return await self._spawn(argv, True, 300.0)
+
+    async def download(self, remote_path: str, local_path: str,
+                       check: bool = False) -> CommandResult:
+        argv = ["scp", "-P", str(self.port), "-o", "BatchMode=yes"]
+        if not self.strict:
+            argv += ["-o", "StrictHostKeyChecking=no",
+                     "-o", "UserKnownHostsFile=/dev/null",
+                     "-o", "LogLevel=ERROR"]
+        if self.private_key:
+            argv += ["-i", self.private_key]
+        argv += [f"{self.username}@{self.node}:{remote_path}", local_path]
+        return await self._spawn(argv, check, 300.0)
+
+
+def runner_for(test: dict, node: str) -> Runner:
+    """Build the control-plane runner a test's config asks for."""
+    if test.get("local_mode"):
+        return LocalRunner(node)
+    ssh = test.get("ssh", {})
+    return SSHRunner(node,
+                     username=ssh.get("username", "root"),
+                     port=ssh.get("port", 22),
+                     private_key=ssh.get("private_key"),
+                     strict_host_key_checking=ssh.get("strict", False))

@@ -1,0 +1,9 @@
+"""DB orchestration layer — jepsen.db protocol equivalents.
+
+Reference: the db/DB + db/LogFiles reify at src/jepsen/etcdemo.clj:30-65.
+"""
+
+from .base import DB  # noqa: F401
+from .etcd import EtcdDB, node_url, peer_url, client_url, initial_cluster  # noqa: F401
+from .fake import FakeDB  # noqa: F401
+from .debian import debian_setup  # noqa: F401

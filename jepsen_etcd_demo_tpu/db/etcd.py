@@ -1,0 +1,84 @@
+"""etcd cluster orchestration + topology helpers.
+
+Mirrors the reference's db reify (src/jepsen/etcdemo.clj:25-65) and
+support.clj URL builders (src/jepsen/etcdemo/support.clj:4-26): install the
+release tarball, start the daemon with full static-cluster flags, wait for
+convergence; teardown kills and wipes; etcd.log is the collectable log.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..control.runner import Runner
+from ..control.daemon import install_archive, start_daemon, stop_daemon
+from .base import DB
+
+log = logging.getLogger(__name__)
+
+DIR = "/opt/etcd"                       # reference :25
+BINARY = "etcd"                         # :26
+LOGFILE = f"{DIR}/etcd.log"             # :27
+PIDFILE = f"{DIR}/etcd.pid"             # :28
+
+PEER_PORT = 2380                        # support.clj:9-12
+CLIENT_PORT = 2379                      # support.clj:14-17
+
+DEFAULT_VERSION = "v3.1.5"              # reference :162
+
+
+def node_url(node: str, port: int) -> str:
+    """HTTP url for connecting to a node on a port (support.clj:4-7)."""
+    return f"http://{node}:{port}"
+
+
+def peer_url(node: str) -> str:
+    return node_url(node, PEER_PORT)
+
+
+def client_url(node: str) -> str:
+    return node_url(node, CLIENT_PORT)
+
+
+def initial_cluster(nodes: list[str]) -> str:
+    """node=peer-url pairs joined by commas (support.clj:19-26)."""
+    return ",".join(f"{n}={peer_url(n)}" for n in nodes)
+
+
+def tarball_url(version: str) -> str:
+    """Release tarball location (reference :37-40)."""
+    return (f"https://storage.googleapis.com/etcd/{version}/"
+            f"etcd-{version}-linux-amd64.tar.gz")
+
+
+class EtcdDB(DB):
+    def __init__(self, version: str = DEFAULT_VERSION,
+                 settle_s: float = 10.0):
+        self.version = version
+        self.settle_s = settle_s  # convergence wait (reference :55)
+
+    async def setup(self, test: dict, r: Runner, node: str) -> None:
+        log.info("installing etcd %s on %s", self.version, node)
+        await install_archive(r, tarball_url(self.version), DIR)
+        nodes = test["nodes"]
+        await start_daemon(
+            r, f"{DIR}/{BINARY}",
+            ["--log-output", "stderr",
+             "--name", node,
+             "--listen-peer-urls", peer_url(node),
+             "--listen-client-urls", client_url(node),
+             "--advertise-client-urls", client_url(node),
+             "--initial-cluster-state", "new",
+             "--initial-advertise-peer-urls", peer_url(node),
+             "--initial-cluster", initial_cluster(nodes)],
+            logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
+        await asyncio.sleep(self.settle_s)
+
+    async def teardown(self, test: dict, r: Runner, node: str) -> None:
+        log.info("tearing down etcd on %s", node)
+        await stop_daemon(r, PIDFILE)
+        await r.run(f"rm -rf {DIR}", su=True, check=False)
+
+    def log_files(self, test: dict, node: str) -> list[str]:
+        return [LOGFILE]

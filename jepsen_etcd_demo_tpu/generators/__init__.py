@@ -1,0 +1,36 @@
+"""Operation-scheduling generator combinators.
+
+Equivalent of jepsen.generator as exercised by the reference demo
+(src/jepsen/etcdemo.clj:120-125,134-144,168-174; set.clj:47-49): `mix`,
+`limit`, `stagger`, `time-limit`, `phases`, `nemesis`, `clients`, `log`,
+`sleep`, `once`, `cycle`, plus jepsen.independent's `concurrent-generator`
+(src/jepsen/etcdemo.clj:120-125).
+
+Design. The reference opts into jepsen's *pure* generator engine
+(`:pure-generators true`, src/jepsen/etcdemo.clj:158) whose point is that op
+scheduling has no shared-mutable-state races across worker threads. This build
+achieves the same property differently: generators are small state machines
+that are only ever advanced by the runner's single-threaded dispatcher (one
+asyncio event loop task touches them; workers await on queues), and all
+randomness flows through one seeded `random.Random` — so schedules are
+deterministic under a seed, which the reference engine does not even provide.
+
+Protocol: `Gen.next_for(ctx)` returns
+  * an `Op`          — dispatch it now (consumes the op),
+  * `Pending(wake)`  — nothing for this asker until `wake` (ns; None = until
+                       some other event changes the world),
+  * `None`           — exhausted for this asker, forever.
+
+`ctx` carries the asking process ("nemesis" or a client int), the current
+relative time in ns, and the shared rng. Time is injected, never read from the
+wall clock, so generators are unit-testable with a fake clock (SURVEY.md §4).
+"""
+
+from .core import (  # noqa: F401
+    Gen, GenContext, Pending, NEMESIS,
+    fn_gen, lift, Mix, Limit, Once, TimeLimit, Stagger, Sleep, Log, Seq,
+    Cycle, Repeat, OnNemesis, OnClients, Phases, Synchronize,
+    mix, limit, once, time_limit, stagger, sleep, log, seq, cycle, repeat,
+    nemesis_gen, clients_gen, phases,
+)
+from .independent import ConcurrentGenerator, concurrent_generator, tuple_gen  # noqa: F401

@@ -1,0 +1,404 @@
+"""Core generator combinators (see package docstring for the protocol)."""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Optional, Sequence, Union
+
+from ..ops.op import Op, INVOKE
+
+NEMESIS = "nemesis"
+
+SECOND = 1_000_000_000  # ns
+
+
+@dataclass(frozen=True)
+class Pending:
+    """Nothing to dispatch for this asker right now.
+
+    wake: relative time (ns) at which asking again may yield an op, or None
+    when the generator is waiting on an external event (e.g. another phase)."""
+
+    wake: Optional[int] = None
+
+
+@dataclass
+class GenContext:
+    """What a generator may observe when asked for an op."""
+
+    time: int                    # relative ns since test start
+    process: Any                 # asking worker: client int or NEMESIS
+    rng: random.Random
+    test: dict | None = None
+
+    def for_process(self, process) -> "GenContext":
+        return GenContext(self.time, process, self.rng, self.test)
+
+
+NextResult = Union[Op, Pending, None]
+
+
+class Gen:
+    """Base generator: exhausted immediately."""
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        return None
+
+
+class _FnGen(Gen):
+    """Wraps a callable returning an Op (or a dict of Op fields) per call.
+
+    The reference's op constructors r/w/cas (src/jepsen/etcdemo.clj:67-69) map
+    to fn generators: each call constructs a fresh invoke op, drawing
+    randomness from the shared seeded rng via ctx."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        out = self.fn(ctx)
+        return _as_op(out, ctx)
+
+
+def _as_op(out, ctx: GenContext) -> NextResult:
+    if out is None or isinstance(out, (Op, Pending)):
+        return out
+    if isinstance(out, dict):
+        d = dict(out)
+        d.setdefault("type", INVOKE)
+        return Op(**d)
+    raise TypeError(f"generator fn returned {out!r}")
+
+
+def fn_gen(fn: Callable) -> Gen:
+    return _FnGen(fn)
+
+
+def lift(x) -> Gen:
+    """Coerce: Gen | callable | Op | dict | iterable-of-those -> Gen."""
+    if isinstance(x, Gen):
+        return x
+    if callable(x):
+        return _FnGen(x)
+    if isinstance(x, Op):
+        return Once(_ConstGen(x))
+    if isinstance(x, dict):
+        d = dict(x)
+        d.setdefault("type", INVOKE)
+        return Once(_ConstGen(Op(**d)))
+    if isinstance(x, (list, tuple)):
+        return Seq([lift(e) for e in x])
+    raise TypeError(f"cannot lift {x!r} to a generator")
+
+
+class _ConstGen(Gen):
+    def __init__(self, op: Op):
+        self.op = op
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        # Fresh copy each emission: downstream mutates process/time/index.
+        o = self.op
+        return Op(type=o.type, f=o.f, value=o.value, process=o.process,
+                  time=o.time, error=o.error)
+
+
+class Mix(Gen):
+    """Random uniform choice among sub-generators per emission — gen/mix
+    (reference src/jepsen/etcdemo.clj:123). Exhausted sub-gens drop out; the
+    mix is exhausted when all are."""
+
+    def __init__(self, gens: Sequence):
+        self.gens = [lift(g) for g in gens]
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        live = list(range(len(self.gens)))
+        best_wake = None
+        while live:
+            i = live[ctx.rng.randrange(len(live))]
+            out = self.gens[i].next_for(ctx)
+            if isinstance(out, Op):
+                return out
+            if isinstance(out, Pending):
+                if out.wake is not None:
+                    best_wake = (out.wake if best_wake is None
+                                 else min(best_wake, out.wake))
+                live.remove(i)
+            else:
+                self.gens.pop(i)
+                live = [j if j < i else j - 1 for j in live if j != i]
+        if self.gens:
+            return Pending(best_wake)
+        return None
+
+
+class Limit(Gen):
+    """At most n ops, then exhausted — gen/limit
+    (reference src/jepsen/etcdemo.clj:124, :ops-per-key)."""
+
+    def __init__(self, n: int, gen):
+        self.remaining = n
+        self.gen = lift(gen)
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        if self.remaining <= 0:
+            return None
+        out = self.gen.next_for(ctx)
+        if isinstance(out, Op):
+            self.remaining -= 1
+        return out
+
+
+def once(gen) -> Gen:
+    """gen/once — exactly one op (reference src/jepsen/etcdemo.clj:171)."""
+    return Limit(1, gen)
+
+
+Once = once
+
+
+class TimeLimit(Gen):
+    """Exhausted once ctx.time exceeds the budget — gen/time-limit
+    (reference src/jepsen/etcdemo.clj:144). The window starts at the first
+    ask, matching jepsen (each phase's time-limit is relative to its start)."""
+
+    def __init__(self, seconds: float, gen):
+        self.budget_ns = int(seconds * SECOND)
+        self.deadline: Optional[int] = None
+        self.gen = lift(gen)
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        if self.deadline is None:
+            self.deadline = ctx.time + self.budget_ns
+        if ctx.time >= self.deadline:
+            return None
+        return self.gen.next_for(ctx)
+
+
+class Stagger(Gen):
+    """Rate limiting: successive ops are spaced by a uniform random delay in
+    [0, 2*mean) so the long-run rate is 1/mean — gen/stagger semantics
+    (reference src/jepsen/etcdemo.clj:137 uses (/ rate) i.e. mean = 1/rate
+    seconds across ALL workers of the channel, not per worker)."""
+
+    def __init__(self, mean_seconds: float, gen):
+        self.mean_ns = int(mean_seconds * SECOND)
+        self.next_time: Optional[int] = None
+        self.gen = lift(gen)
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        if self.next_time is None:
+            self.next_time = ctx.time
+        if ctx.time < self.next_time:
+            return Pending(self.next_time)
+        out = self.gen.next_for(ctx)
+        if isinstance(out, Op):
+            self.next_time += ctx.rng.randrange(max(1, 2 * self.mean_ns))
+            # Never fall behind more than one interval (jepsen catches up
+            # after stalls rather than bursting).
+            self.next_time = max(self.next_time, ctx.time)
+        return out
+
+
+class Sleep(Gen):
+    """Emit nothing for `seconds`, then exhausted — gen/sleep
+    (reference src/jepsen/etcdemo.clj:139,141,173)."""
+
+    def __init__(self, seconds: float):
+        self.budget_ns = int(seconds * SECOND)
+        self.deadline: Optional[int] = None
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        if self.deadline is None:
+            self.deadline = ctx.time + self.budget_ns
+        if ctx.time >= self.deadline:
+            return None
+        return Pending(self.deadline)
+
+
+class Log(Gen):
+    """Emit one :log pseudo-op the runner prints — gen/log
+    (reference src/jepsen/etcdemo.clj:170,172)."""
+
+    def __init__(self, message: str):
+        self.message: Optional[str] = message
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        if self.message is None:
+            return None
+        msg, self.message = self.message, None
+        return Op(type="log", f="log", value=msg)
+
+
+class Seq(Gen):
+    """Sub-generators in order; advance when the head exhausts. (Unlike
+    Phases there is NO barrier: the next gen starts as soon as the previous
+    stops emitting, concurrent with in-flight ops.)"""
+
+    def __init__(self, gens: Sequence):
+        self.gens = [lift(g) for g in gens]
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        while self.gens:
+            out = self.gens[0].next_for(ctx)
+            if out is not None:
+                return out
+            self.gens.pop(0)
+        return None
+
+
+class Cycle(Gen):
+    """Endlessly rebuild-and-drain a generator from a factory — gen/cycle
+    (the reference's nemesis schedule, src/jepsen/etcdemo.clj:138-143)."""
+
+    def __init__(self, factory: Callable[[], Any]):
+        self.factory = factory
+        self.current = lift(factory())
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        for _ in range(2):
+            out = self.current.next_for(ctx)
+            if out is not None:
+                return out
+            self.current = lift(self.factory())
+        # A factory whose product is immediately exhausted would spin forever.
+        return None
+
+
+def cycle(*gens_or_factory) -> Gen:
+    if len(gens_or_factory) == 1 and callable(gens_or_factory[0]) \
+            and not isinstance(gens_or_factory[0], Gen):
+        return Cycle(gens_or_factory[0])
+    items = list(gens_or_factory)
+    return Cycle(lambda: [_rebuild(g) for g in items])
+
+
+def _rebuild(g):
+    """Cycle needs fresh stateful combinators each lap; specs that are plain
+    data (dicts, Ops, callables) are re-lifted, Gen instances are reused
+    (only valid if stateless)."""
+    return lift(g)
+
+
+class Repeat(Gen):
+    """Emit ops from (a fresh copy of) the underlying fn generator forever."""
+
+    def __init__(self, fn: Callable):
+        self.gen = _FnGen(fn)
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        return self.gen.next_for(ctx)
+
+
+class OnNemesis(Gen):
+    """Route a generator to the nemesis channel only — gen/nemesis
+    (reference src/jepsen/etcdemo.clj:138). Client askers see Pending."""
+
+    def __init__(self, gen):
+        self.gen = lift(gen)
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        if ctx.process != NEMESIS:
+            return Pending(None)
+        return self.gen.next_for(ctx)
+
+
+class OnClients(Gen):
+    """Route to client workers only — gen/clients
+    (reference src/jepsen/etcdemo.clj:136-137)."""
+
+    def __init__(self, gen):
+        self.gen = lift(gen)
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        if ctx.process == NEMESIS:
+            return Pending(None)
+        return self.gen.next_for(ctx)
+
+
+class Synchronize(Gen):
+    """Marker: the runner must wait for all in-flight ops to complete before
+    asking the wrapped generator (jepsen's synchronize / phase barrier)."""
+
+    def __init__(self, gen):
+        self.gen = lift(gen)
+        self.barrier_passed = False
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        return self.gen.next_for(ctx)
+
+
+class Phases(Gen):
+    """Sequential phases with a full barrier between them — gen/phases
+    (reference src/jepsen/etcdemo.clj:168-174). The runner detects the
+    phase boundary via `barrier_pending()` and drains in-flight ops before
+    the next phase starts."""
+
+    def __init__(self, *gens):
+        self.phases = [lift(g) for g in gens]
+        self.index = 0
+        self._need_barrier = False
+
+    def barrier_pending(self) -> bool:
+        return self._need_barrier
+
+    def barrier_done(self):
+        self._need_barrier = False
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        while self.index < len(self.phases):
+            if self._need_barrier:
+                return Pending(None)
+            out = self.phases[self.index].next_for(ctx)
+            if out is not None:
+                return out
+            # This asker found the phase exhausted. The phase flips only when
+            # the runner confirms the barrier (all workers idle).
+            self.index += 1
+            self._need_barrier = self.index < len(self.phases)
+        return None
+
+
+# Lowercase constructors mirroring the jepsen namespace.
+def mix(gens) -> Gen:
+    return Mix(gens)
+
+
+def limit(n: int, gen) -> Gen:
+    return Limit(n, gen)
+
+
+def time_limit(seconds: float, gen) -> Gen:
+    return TimeLimit(seconds, gen)
+
+
+def stagger(mean_seconds: float, gen) -> Gen:
+    return Stagger(mean_seconds, gen)
+
+
+def sleep(seconds: float) -> Gen:
+    return Sleep(seconds)
+
+
+def log(message: str) -> Gen:
+    return Log(message)
+
+
+def seq(*gens) -> Gen:
+    return Seq(list(gens))
+
+
+def repeat(fn: Callable) -> Gen:
+    return Repeat(fn)
+
+
+def nemesis_gen(gen) -> Gen:
+    return OnNemesis(gen)
+
+
+def clients_gen(gen) -> Gen:
+    return OnClients(gen)
+
+
+def phases(*gens) -> Phases:
+    return Phases(*gens)

@@ -1,0 +1,82 @@
+"""jepsen.independent's concurrent-generator equivalent.
+
+The reference multiplexes a single-key workload over many independent keys:
+`independent/concurrent-generator 10 (range) (fn [k] ...)` — 10 worker
+threads per key, each key's generator limited to :ops-per-key, groups
+rotating to fresh keys as their key exhausts (src/jepsen/etcdemo.clj:120-125).
+Emitted op values become (key, value) tuples (src/jepsen/etcdemo.clj:90),
+which `IndependentChecker` later splits per key — the vmap batch axis of the
+TPU checker (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+from ..ops.op import Op
+from .core import Gen, GenContext, NextResult, Pending, NEMESIS, lift
+
+
+class tuple_gen(Gen):
+    """Wrap a generator so each emitted op's value becomes (key, value)."""
+
+    def __init__(self, key, gen):
+        self.key = key
+        self.gen = lift(gen)
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        out = self.gen.next_for(ctx)
+        if isinstance(out, Op):
+            out.value = (self.key, out.value)
+        return out
+
+
+class ConcurrentGenerator(Gen):
+    """n workers per key; worker groups rotate through the key stream.
+
+    Group g = client_process // n. Each group holds its own sub-generator
+    (fn(key), tuple-wrapped); when it exhausts, the group pulls the next key
+    from the shared stream. Nemesis askers always see Pending (this generator
+    feeds the client channel only, like the reference's)."""
+
+    def __init__(self, n: int, keys: Iterable, fn: Callable[[Any], Any]):
+        if n <= 0:
+            raise ValueError("n must be positive")
+        self.n = n
+        self.keys: Iterator = iter(keys)
+        self.fn = fn
+        self.group_gens: dict[int, Optional[Gen]] = {}
+        self.exhausted_keys = False
+
+    def _fresh(self) -> Optional[Gen]:
+        try:
+            key = next(self.keys)
+        except StopIteration:
+            self.exhausted_keys = True
+            return None
+        return tuple_gen(key, self.fn(key))
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        if ctx.process == NEMESIS:
+            return Pending(None)
+        # Processes reincarnate as p + concurrency after :info crashes, but
+        # the group is a property of the worker THREAD (jepsen maps threads,
+        # not processes, to keys).
+        conc = (ctx.test or {}).get("concurrency")
+        thread = int(ctx.process) % int(conc) if conc else int(ctx.process)
+        group = thread // self.n
+        if group not in self.group_gens:
+            self.group_gens[group] = self._fresh()
+        while True:
+            gen = self.group_gens[group]
+            if gen is None:
+                return None
+            out = gen.next_for(ctx)
+            if out is not None:
+                return out
+            self.group_gens[group] = self._fresh()
+
+
+def concurrent_generator(n: int, keys: Iterable,
+                         fn: Callable[[Any], Any]) -> Gen:
+    return ConcurrentGenerator(n, keys, fn)

@@ -22,6 +22,30 @@ class Model(abc.ABC):
 
     name: str = "model"
 
+    # Packing: when every reachable state is bounded by the values appearing
+    # in the history (register-like models), (state, linearized-mask) can
+    # live in ONE uint32 sort key — a payload-free single-key dedup in the
+    # checker. `packable_states=True` opts in; `state_offset` maps the
+    # smallest state (NIL=-1) to 0. The actual bit width is derived from
+    # each history's real values via pack_bits(), NEVER from an assumed
+    # value range (any int32 value is legal in a history, encode.py:46).
+    packable_states: bool = False
+    state_offset: int = 0
+
+    def pack_bits(self, max_value: int) -> int:
+        """Bits needed to pack any reachable state, given the largest value
+        encoded in the history; 0 = not packable.
+
+        The reachable range is {init_state()} ∪ history values — the initial
+        state counts even when no history value comes near it (a large
+        `initial` that silently wrapped into mask bits was a reproduced
+        soundness bug). Negative values never reach here: the encoder
+        rejects them (NIL=-1 is a reserved sentinel, encode.py)."""
+        if not self.packable_states:
+            return 0
+        top = max(int(max_value), int(self.init_state())) + self.state_offset
+        return max(1, top.bit_length())
+
     def cache_key(self) -> tuple:
         """Hashable identity for jit-compilation caches. Two models with equal
         cache keys must have identical step semantics."""

@@ -20,9 +20,11 @@ from ..ops.encode import NIL, F_READ, F_WRITE, F_CAS
 
 class CASRegister(Model):
     name = "cas-register"
+    packable_states = True  # states ⊆ {initial} ∪ history values
 
     def __init__(self, initial: int = NIL):
         self.initial = initial
+        self.state_offset = -min(NIL, initial)
 
     def init_state(self) -> int:
         return self.initial

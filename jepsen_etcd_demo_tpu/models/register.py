@@ -15,9 +15,11 @@ from ..ops.encode import NIL, F_READ, F_WRITE
 
 class Register(Model):
     name = "register"
+    packable_states = True  # states ⊆ {initial} ∪ history values
 
     def __init__(self, initial: int = NIL):
         self.initial = initial
+        self.state_offset = -min(NIL, initial)
 
     def init_state(self) -> int:
         return self.initial

@@ -1,0 +1,13 @@
+"""Fault-injection (nemesis) layer.
+
+Equivalent of jepsen.nemesis as used by the reference:
+nemesis/partition-random-halves (src/jepsen/etcdemo.clj:164), driven by
+:start/:stop ops on the nemesis generator channel (:138-143) and healed in
+the final phase (:170-171).
+"""
+
+from .base import Nemesis, NoopNemesis  # noqa: F401
+from .partition import (  # noqa: F401
+    PartitionRandomHalves, FakePartitionNemesis, bisect_nodes, random_halves,
+)
+from .process_faults import KillNemesis, PauseNemesis  # noqa: F401

@@ -1,0 +1,30 @@
+"""Nemesis protocol: a special client on the fault plane.
+
+Like jepsen.nemesis, a nemesis has client-shaped lifecycle (setup/invoke/
+teardown) but its ops target the environment, not the data plane. The runner
+gives it ops from the nemesis generator channel ({:f :start}/{:f :stop},
+reference src/jepsen/etcdemo.clj:138-143)."""
+
+from __future__ import annotations
+
+import abc
+
+from ..ops.op import Op
+
+
+class Nemesis(abc.ABC):
+    async def setup(self, test: dict) -> None:
+        pass
+
+    @abc.abstractmethod
+    async def invoke(self, test: dict, op: Op) -> Op:
+        """Execute the fault op; return its completion (:info with a
+        description value, like jepsen nemeses)."""
+
+    async def teardown(self, test: dict) -> None:
+        """Must leave the environment healed."""
+
+
+class NoopNemesis(Nemesis):
+    async def invoke(self, test: dict, op: Op) -> Op:
+        return Op(type="info", f=op.f, value="noop", process=op.process)

@@ -1,0 +1,113 @@
+"""Network partitions.
+
+PartitionRandomHalves = the reference's nemesis/partition-random-halves
+(src/jepsen/etcdemo.clj:164): on :start, split nodes into a random
+majority/minority and drop traffic between the halves with iptables over the
+control plane; on :stop, heal. FakePartitionNemesis does the same against the
+in-process FakeKVStore (isolates the minority) so partition tests run
+hermetically.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ..control.runner import Runner, runner_for
+from ..ops.op import Op
+from .base import Nemesis
+
+
+def bisect_nodes(nodes: list[str], rng: random.Random
+                 ) -> tuple[list[str], list[str]]:
+    """Random majority/minority split (jepsen shuffles then bisects; with odd
+    n the first half is the minority)."""
+    shuffled = list(nodes)
+    rng.shuffle(shuffled)
+    half = len(shuffled) // 2
+    return shuffled[:half], shuffled[half:]
+
+
+def random_halves(nodes: list[str], rng: random.Random
+                  ) -> dict[str, list[str]]:
+    """Map each node -> nodes it can still reach."""
+    minority, majority = bisect_nodes(nodes, rng)
+    reach = {}
+    for n in minority:
+        reach[n] = list(minority)
+    for n in majority:
+        reach[n] = list(majority)
+    return reach
+
+
+class PartitionRandomHalves(Nemesis):
+    """iptables-based partition over SSH, like jepsen's partitioner."""
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+        self.active: Optional[tuple[list[str], list[str]]] = None
+
+    async def setup(self, test: dict) -> None:
+        await self._heal(test)
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        if op.f == "start":
+            minority, majority = bisect_nodes(test["nodes"], self.rng)
+            await self._partition(test, minority, majority)
+            self.active = (minority, majority)
+            value = {"isolated": minority, "majority": majority}
+        elif op.f == "stop":
+            await self._heal(test)
+            self.active = None
+            value = "network healed"
+        else:
+            value = f"unknown nemesis op {op.f}"
+        return Op(type="info", f=op.f, value=value, process=op.process)
+
+    async def teardown(self, test: dict) -> None:
+        await self._heal(test)
+
+    async def _partition(self, test: dict, minority: list[str],
+                         majority: list[str]) -> None:
+        # Drop in both directions on every node so the cut is symmetric even
+        # if one side's rules fail to land.
+        for side, other in ((minority, majority), (majority, minority)):
+            for node in side:
+                r = runner_for(test, node)
+                for peer in other:
+                    await r.run(
+                        f"iptables -A INPUT -s {peer} -j DROP -w", su=True,
+                        check=False)
+
+    async def _heal(self, test: dict) -> None:
+        for node in test["nodes"]:
+            r = runner_for(test, node)
+            await r.run("iptables -F -w && iptables -X -w", su=True,
+                        check=False)
+
+
+class FakePartitionNemesis(Nemesis):
+    """Partition the in-process FakeKVStore: isolate a random minority.
+
+    Same op surface and :start/:stop semantics as the real partitioner, so
+    the reference's nemesis schedule (5s on / 5s off cycle,
+    src/jepsen/etcdemo.clj:138-143) runs unchanged in hermetic tests."""
+
+    def __init__(self, store, seed: int = 0):
+        self.store = store
+        self.rng = random.Random(seed)
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        if op.f == "start":
+            minority, majority = bisect_nodes(test["nodes"], self.rng)
+            self.store.isolate(set(minority))
+            value = {"isolated": minority, "majority": majority}
+        elif op.f == "stop":
+            self.store.heal()
+            value = "network healed"
+        else:
+            value = f"unknown nemesis op {op.f}"
+        return Op(type="info", f=op.f, value=value, process=op.process)
+
+    async def teardown(self, test: dict) -> None:
+        self.store.heal()

@@ -1,0 +1,80 @@
+"""Process-level faults: kill/restart and pause/resume the DB daemon.
+
+Beyond the reference demo (which only partitions), but part of the jepsen
+nemesis family the build's fault-injection ABC covers (SURVEY.md §5.3:
+"partition first (same semantics), then kill/pause")."""
+
+from __future__ import annotations
+
+import random
+
+from ..control.runner import runner_for
+from ..ops.op import Op
+from .base import Nemesis
+
+
+class KillNemesis(Nemesis):
+    """:start kills the DB daemon on a random subset; :stop restarts it."""
+
+    def __init__(self, db, seed: int = 0):
+        self.db = db
+        self.rng = random.Random(seed)
+        self.killed: list[str] = []
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        if op.f == "start":
+            n = self.rng.randrange(1, max(2, len(test["nodes"]) // 2 + 1))
+            self.killed = self.rng.sample(test["nodes"], n)
+            for node in self.killed:
+                r = runner_for(test, node)
+                from ..db.etcd import PIDFILE
+                from ..control.daemon import stop_daemon
+                await stop_daemon(r, PIDFILE)
+            value = {"killed": self.killed}
+        elif op.f == "stop":
+            for node in self.killed:
+                r = runner_for(test, node)
+                await self.db.setup(test, r, node)
+            value = {"restarted": self.killed}
+            self.killed = []
+        else:
+            value = f"unknown nemesis op {op.f}"
+        return Op(type="info", f=op.f, value=value, process=op.process)
+
+    async def teardown(self, test: dict) -> None:
+        pass
+
+
+class PauseNemesis(Nemesis):
+    """:start SIGSTOPs the daemon on a random subset; :stop SIGCONTs."""
+
+    def __init__(self, pidfile: str, seed: int = 0):
+        self.pidfile = pidfile
+        self.rng = random.Random(seed)
+        self.paused: list[str] = []
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        if op.f == "start":
+            n = self.rng.randrange(1, max(2, len(test["nodes"]) // 2 + 1))
+            self.paused = self.rng.sample(test["nodes"], n)
+            for node in self.paused:
+                r = runner_for(test, node)
+                await r.run(f"kill -STOP $(cat {self.pidfile})", su=True,
+                            check=False)
+            value = {"paused": self.paused}
+        elif op.f == "stop":
+            for node in self.paused:
+                r = runner_for(test, node)
+                await r.run(f"kill -CONT $(cat {self.pidfile})", su=True,
+                            check=False)
+            value = {"resumed": self.paused}
+            self.paused = []
+        else:
+            value = f"unknown nemesis op {op.f}"
+        return Op(type="info", f=op.f, value=value, process=op.process)
+
+    async def teardown(self, test: dict) -> None:
+        for node in self.paused:
+            r = runner_for(test, node)
+            await r.run(f"kill -CONT $(cat {self.pidfile})", su=True,
+                        check=False)

@@ -87,6 +87,8 @@ class EncodedHistory:
     n_ops: int             # invocations included (ok + open info)
     k_slots: int
     max_pending: int       # high-water mark of simultaneously pending ops
+    max_value: int = 0     # largest encoded value (a1/a2/rv); bounds the
+    #                        model state space for packed-key dedup
 
     def padded_to(self, e_cap: int) -> "EncodedHistory":
         if e_cap < self.events.shape[0]:
@@ -97,13 +99,21 @@ class EncodedHistory:
         ev[:, 0] = EV_PAD
         ev[: self.events.shape[0]] = self.events
         return EncodedHistory(ev, self.n_events, self.n_ops, self.k_slots,
-                              self.max_pending)
+                              self.max_pending, self.max_value)
 
 
 def _encode_value(v: Any) -> int:
     if v is None:
         return NIL
-    return int(v)
+    v = int(v)
+    if v < 0:
+        # NIL (-1) is the reserved "key missing" sentinel; admitting negative
+        # payloads would both collide with it and corrupt the packed-key
+        # dedup (uint32 wraparound). Reject loudly instead of mis-checking.
+        raise EncodeError(
+            f"negative history values are unsupported (got {v}); "
+            f"-1 is the NIL sentinel")
+    return v
 
 
 def pair_history(history: Sequence[Op]) -> list[Invocation]:
@@ -206,11 +216,82 @@ def encode_events(invocations: Sequence[Invocation], k_slots: int = 32
 
     events = np.asarray(rows, dtype=np.int32).reshape(-1, EVENT_WIDTH)
     n_ops = sum(1 for _, r, _i in points if not r)
+    max_value = int(events[:, 3:6].max()) if len(rows) else 0
     return EncodedHistory(events=events, n_events=len(rows), n_ops=n_ops,
-                          k_slots=k_slots, max_pending=max_pending)
+                          k_slots=k_slots, max_pending=max_pending,
+                          max_value=max_value)
 
 
 def encode_register_history(history: Sequence[Op], k_slots: int = 32
                             ) -> EncodedHistory:
     """History of register ops (read/write/cas) -> padded event tensor."""
     return encode_events(pair_history(history), k_slots=k_slots)
+
+
+@dataclass
+class ReturnSteps:
+    """Return-event-major encoding: one row per EV_RETURN, with a full
+    pending-slot snapshot.
+
+    The WGL search only does real work at returns (closure + prune); invokes
+    are just slot-table bookkeeping. Precomputing the slot table per return
+    on the host gives the device kernel a scan whose every step does
+    identical work — no invoke/return branching, which matters enormously
+    under vmap (a lax.cond over batch-varying event kinds becomes a select
+    that executes BOTH branches for every lane).
+
+    slot_tabs[i] is the snapshot just before processing return i: every op
+    invoked earlier (in history order) and not yet returned is active,
+    including the returning op itself."""
+
+    slot_tabs: np.ndarray    # [R, K, 4] int32 (f, a1, a2, rv)
+    slot_active: np.ndarray  # [R, K] bool
+    targets: np.ndarray      # [R] int32 slot of the returning op; -1 = pad
+    n_steps: int             # real (non-pad) returns
+    n_ops: int
+    k_slots: int
+    max_pending: int
+    max_value: int = 0
+
+    def padded_to(self, r_cap: int) -> "ReturnSteps":
+        r = self.slot_tabs.shape[0]
+        if r_cap < r:
+            raise EncodeError(f"cannot pad {r} return steps to {r_cap}")
+        tabs = np.zeros((r_cap,) + self.slot_tabs.shape[1:], np.int32)
+        act = np.zeros((r_cap, self.k_slots), bool)
+        tgt = np.full((r_cap,), -1, np.int32)
+        tabs[:r] = self.slot_tabs
+        act[:r] = self.slot_active
+        tgt[:r] = self.targets
+        return ReturnSteps(tabs, act, tgt, self.n_steps, self.n_ops,
+                           self.k_slots, self.max_pending, self.max_value)
+
+
+def encode_return_steps(enc: EncodedHistory) -> ReturnSteps:
+    """Derive the return-major encoding from the event encoding."""
+    k = enc.k_slots
+    slot_tab = np.zeros((k, 4), np.int32)
+    slot_active = np.zeros((k,), bool)
+    tabs, actives, targets = [], [], []
+    for i in range(enc.n_events):
+        kind, slot, f, a1, a2, rv = (int(x) for x in enc.events[i])
+        if kind == EV_INVOKE:
+            slot_tab[slot] = (f, a1, a2, rv)
+            slot_active[slot] = True
+        elif kind == EV_RETURN:
+            tabs.append(slot_tab.copy())
+            actives.append(slot_active.copy())
+            targets.append(slot)
+            slot_active[slot] = False
+    r = len(targets)
+    return ReturnSteps(
+        slot_tabs=(np.stack(tabs) if r else np.zeros((0, k, 4), np.int32)),
+        slot_active=(np.stack(actives) if r else np.zeros((0, k), bool)),
+        targets=np.asarray(targets, np.int32),
+        n_steps=r, n_ops=enc.n_ops, k_slots=k, max_pending=enc.max_pending,
+        max_value=enc.max_value)
+
+
+def encode_register_history_steps(history: Sequence[Op], k_slots: int = 32
+                                  ) -> ReturnSteps:
+    return encode_return_steps(encode_register_history(history, k_slots))

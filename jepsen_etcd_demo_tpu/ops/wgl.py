@@ -61,6 +61,11 @@ class WGLConfig:
     k_slots: int = 32       # pending-op slot capacity (bitmask width)
     f_cap: int = 256        # frontier capacity (configs kept after dedup)
     max_expand_rounds: int | None = None  # closure depth bound; default k_slots
+    # >0 enables the packed single-uint32 dedup in the v2 kernel: every
+    # reachable model state must fit in `state_bits` bits after the model's
+    # state_offset. Derive from the HISTORY's actual values
+    # (model.pack_bits(enc.max_value)) — never assume a value range.
+    state_bits: int = 0
 
     @property
     def words(self) -> int:
@@ -118,13 +123,21 @@ def make_step_fn(model: Model, cfg: WGLConfig):
         # masks u32[F, W] -> {0,1}[F, K]: is each slot's bit set?
         return (masks[:, word_of] >> bit_of) & jnp.uint32(1)
 
-    def expand_once(states, masks, valid, slot_tab, slot_active):
+    def expand_once(states, masks, valid, slot_tab, slot_active, t_word,
+                    t_bit):
         f = slot_tab[:, 0]
         a1 = slot_tab[:, 1]
         a2 = slot_tab[:, 2]
         rv = slot_tab[:, 3]
         legal, nxt = jax.vmap(lambda s: model.step(s, f, a1, a2, rv))(states)
-        cand_valid = (valid[:, None] & slot_active[None, :]
+        # Just-in-time linearization (Lowe; knossos :linear): only expand
+        # configs that have NOT yet fired the returning op. Once the target
+        # is fired a config is banked as-is — anything reachable beyond it
+        # is regenerable at the next return's closure, so storing only the
+        # boundary keeps the frontier minimal.
+        not_done = ((masks[:, t_word] >> t_bit) & jnp.uint32(1)) == 0  # [F]
+        cand_valid = (valid[:, None] & not_done[:, None]
+                      & slot_active[None, :]
                       & (bits_set(masks) == 0) & legal)          # [F, K]
         cand_masks = masks[:, None, :] | slot_bitmask[None, :, :]  # [F, K, W]
         all_states = jnp.concatenate([states, nxt.reshape(-1)])
@@ -133,7 +146,8 @@ def make_step_fn(model: Model, cfg: WGLConfig):
         all_valid = jnp.concatenate([valid, cand_valid.reshape(-1)])
         return _dedup(all_states, all_masks, all_valid, f_cap)
 
-    def closure(states, masks, valid, slot_tab, slot_active, overflow):
+    def closure(states, masks, valid, slot_tab, slot_active, overflow,
+                t_word, t_bit):
         n0 = jnp.sum(valid.astype(jnp.int32))
 
         def cond(st):
@@ -142,7 +156,8 @@ def make_step_fn(model: Model, cfg: WGLConfig):
 
         def body(st):
             s, m, v, n_prev, _c, o, it = st
-            s2, m2, v2, n_unique = expand_once(s, m, v, slot_tab, slot_active)
+            s2, m2, v2, n_unique = expand_once(s, m, v, slot_tab,
+                                               slot_active, t_word, t_bit)
             o = o | (n_unique > f_cap)
             n_now = jnp.minimum(n_unique, f_cap)
             return (s2, m2, v2, n_now, n_now > n_prev, o, it + 1)
@@ -164,7 +179,7 @@ def make_step_fn(model: Model, cfg: WGLConfig):
         def on_return(c: _Carry) -> _Carry:
             s, m, v, n, overflow = closure(
                 c.states, c.masks, c.valid, c.slot_tab, c.slot_active,
-                c.overflow)
+                c.overflow, word_of[slot], bit_of[slot])
             bit_word = jnp.take(m, word_of[slot], axis=-1)
             has_bit = ((bit_word >> bit_of[slot]) & jnp.uint32(1)) == 1
             keep = v & has_bit

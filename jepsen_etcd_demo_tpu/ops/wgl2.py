@@ -1,0 +1,263 @@
+"""WGL linearizability search v2: return-major scan (the production kernel).
+
+Same search as ops/wgl.py (frontier of (state, linearized-bitmask) configs,
+sort-dedup compaction, just-in-time linearization) but scanning the
+`ReturnSteps` encoding (encode.py): one scan step per RETURN event, with the
+pending-slot table precomputed host-side as scan inputs.
+
+Why this shape wins on TPU (vs the event-major v1 kernel):
+  * every scan step does identical work — no EV_INVOKE/EV_RETURN lax.cond.
+    Under vmap, a batch-varying cond lowers to a select that executes BOTH
+    branches for every lane; here the batch path does exactly the work the
+    single path does;
+  * half the scan steps (invokes contribute no steps);
+  * the slot table leaves the loop carry (scan input instead), shrinking the
+    state XLA threads through the loop.
+
+The closure is a lax.while_loop; under vmap it runs until every lane's
+frontier reaches fixpoint, which costs max-rounds-over-lanes — fine, since
+rounds ≈ longest firing chain ending at the returning op (usually 1-2).
+
+Replaces the reference's knossos hot loop (src/jepsen/etcdemo.clj:117);
+soundness-under-overflow argument as in ops/wgl.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.base import Model
+from .encode import EncodedHistory, ReturnSteps, encode_return_steps
+from .wgl import WGLConfig, _dedup, _slot_constants
+
+
+class _Carry2(NamedTuple):
+    states: jax.Array       # i32[F]
+    masks: jax.Array        # u32[F, W]
+    valid: jax.Array        # bool[F]
+    dead: jax.Array         # bool
+    overflow: jax.Array     # bool
+    dead_step: jax.Array    # i32 (return-step index, -1 if alive)
+    max_frontier: jax.Array  # i32
+
+
+PACKED_INVALID = np.uint32(0xFFFFFFFF)
+
+
+def packable(model: Model, cfg: WGLConfig) -> bool:
+    """Can (state, mask) live in one uint32 sort key? Needs a bounded model
+    state space (cfg.state_bits, derived from the history's values) and a
+    single mask word with headroom."""
+    return (cfg.state_bits > 0 and model.packable_states
+            and cfg.state_bits + cfg.k_slots <= 31)
+
+
+def _dedup_packed(keys, f_cap):
+    """Single-key dedup: sort uint32 config keys (invalid = all-ones sorts
+    last), blank neighbor duplicates, and compact with a SECOND sort —
+    duplicates become PACKED_INVALID which sorts last, so the unique keys
+    land in the first n_unique slots, still ascending. Two cheap sorts beat
+    one scatter: vmapped scatter lowers very badly on TPU."""
+    s = jnp.sort(keys)
+    eq_prev = jnp.concatenate([jnp.array([False]), s[1:] == s[:-1]])
+    unique = (s != PACKED_INVALID) & ~eq_prev
+    n_unique = jnp.sum(unique.astype(jnp.int32))
+    out = jnp.sort(jnp.where(unique, s, PACKED_INVALID))[:f_cap]
+    return out, n_unique
+
+
+def make_step_fn2(model: Model, cfg: WGLConfig):
+    word_of, bit_of, slot_bitmask = _slot_constants(cfg)
+    f_cap, k = cfg.f_cap, cfg.k_slots
+    use_packed = packable(model, cfg)
+    sbits = cfg.state_bits
+    soff = model.state_offset
+
+    def bits_set(masks):
+        return (masks[:, word_of] >> bit_of) & jnp.uint32(1)
+
+    def pack(states, mask_word, valid):
+        key = ((states + soff).astype(jnp.uint32)
+               | (mask_word << jnp.uint32(sbits)))
+        return jnp.where(valid, key, PACKED_INVALID)
+
+    def unpack(keys):
+        valid = keys != PACKED_INVALID
+        states = (keys & jnp.uint32((1 << sbits) - 1)).astype(jnp.int32) - soff
+        masks = (keys >> jnp.uint32(sbits))[:, None]
+        return jnp.where(valid, states, 0), \
+            jnp.where(valid[:, None], masks, jnp.uint32(0)), valid
+
+    def step(carry: _Carry2, xs):
+        slot_tab, slot_active, target, idx = xs
+        is_pad = target < 0
+        tgt = jnp.maximum(target, 0)
+        t_word, t_bit = word_of[tgt], bit_of[tgt]
+        f = slot_tab[:, 0]
+        a1 = slot_tab[:, 1]
+        a2 = slot_tab[:, 2]
+        rv = slot_tab[:, 3]
+
+        def candidates(states, masks, valid):
+            legal, nxt = jax.vmap(
+                lambda s: model.step(s, f, a1, a2, rv))(states)
+            # JIT linearization: don't expand configs that already fired the
+            # returning op (ops/wgl.py expand_once for the argument).
+            not_done = ((masks[:, t_word] >> t_bit) & jnp.uint32(1)) == 0
+            cand_valid = (valid[:, None] & not_done[:, None] & ~is_pad
+                          & slot_active[None, :]
+                          & (bits_set(masks) == 0) & legal)
+            return nxt, cand_valid
+
+        def expand_once(states, masks, valid):
+            nxt, cand_valid = candidates(states, masks, valid)
+            if use_packed:
+                cand_words = masks[:, None, 0] | slot_bitmask[None, :, 0]
+                all_keys = jnp.concatenate([
+                    pack(states, masks[:, 0], valid),
+                    pack(nxt.reshape(-1), cand_words.reshape(-1),
+                         cand_valid.reshape(-1))])
+                keys, n_unique = _dedup_packed(all_keys, f_cap)
+                s2, m2, v2 = unpack(keys)
+                return s2, m2, v2, n_unique
+            cand_masks = masks[:, None, :] | slot_bitmask[None, :, :]
+            all_states = jnp.concatenate([states, nxt.reshape(-1)])
+            all_masks = jnp.concatenate(
+                [masks, cand_masks.reshape(-1, cfg.words)])
+            all_valid = jnp.concatenate([valid, cand_valid.reshape(-1)])
+            return _dedup(all_states, all_masks, all_valid, f_cap)
+
+        def cond(st):
+            _s, _m, _v, _n, changed, _o, it = st
+            return changed & (it < cfg.rounds)
+
+        def body(st):
+            s, m, v, n_prev, _c, o, it = st
+            s2, m2, v2, n_unique = expand_once(s, m, v)
+            o = o | (n_unique > f_cap)
+            n_now = jnp.minimum(n_unique, f_cap)
+            return (s2, m2, v2, n_now, n_now > n_prev, o, it + 1)
+
+        n0 = jnp.sum(carry.valid.astype(jnp.int32))
+        init = (carry.states, carry.masks, carry.valid, n0, ~is_pad,
+                carry.overflow, jnp.int32(0))
+        s, m, v, n, _c, overflow = jax.lax.while_loop(cond, body, init)[:6]
+
+        bit_word = jnp.take(m, t_word, axis=-1)
+        has_bit = ((bit_word >> t_bit) & jnp.uint32(1)) == 1
+        keep = v & jnp.where(is_pad, True, has_bit)
+        cleared = jnp.where(is_pad, m, m & ~slot_bitmask[tgt][None, :])
+        died = ~is_pad & ~carry.dead & ~jnp.any(keep)
+        dead = carry.dead | died
+        return _Carry2(
+            states=s, masks=cleared, valid=keep & ~jnp.bool_(dead),
+            dead=dead, overflow=overflow,
+            dead_step=jnp.where(died & (carry.dead_step < 0), idx,
+                                carry.dead_step),
+            max_frontier=jnp.maximum(carry.max_frontier, n)), None
+
+    return step
+
+
+def _init_carry2(model: Model, cfg: WGLConfig) -> _Carry2:
+    f_cap, w = cfg.f_cap, cfg.words
+    return _Carry2(
+        states=jnp.zeros((f_cap,), jnp.int32).at[0].set(model.init_state()),
+        masks=jnp.zeros((f_cap, w), jnp.uint32),
+        valid=jnp.zeros((f_cap,), bool).at[0].set(True),
+        dead=jnp.bool_(False),
+        overflow=jnp.bool_(False),
+        dead_step=jnp.int32(-1),
+        max_frontier=jnp.int32(1),
+    )
+
+
+def _check_one_fn(model: Model, cfg: WGLConfig):
+    step = make_step_fn2(model, cfg)
+
+    def check(slot_tabs, slot_active, targets):
+        carry = _init_carry2(model, cfg)
+        idxs = jnp.arange(targets.shape[0], dtype=jnp.int32)
+        final, _ = jax.lax.scan(
+            step, carry, (slot_tabs, slot_active, targets, idxs))
+        return {
+            "survived": ~final.dead,
+            "overflow": final.overflow,
+            "dead_step": final.dead_step,
+            "max_frontier": final.max_frontier,
+        }
+
+    return check
+
+
+def make_checker2(model: Model, cfg: WGLConfig = WGLConfig()):
+    """jitted check(slot_tabs[R,K,4], slot_active[R,K], targets[R])."""
+    return jax.jit(_check_one_fn(model, cfg))
+
+
+def make_batch_checker2(model: Model, cfg: WGLConfig = WGLConfig()):
+    """jitted check over a batch: slot_tabs[B,R,K,4], ... -> [B] results."""
+    return jax.jit(jax.vmap(_check_one_fn(model, cfg)))
+
+
+_CACHE: dict[tuple, Any] = {}
+
+
+def cached_checker2(model: Model, cfg: WGLConfig):
+    key = ("single2", model.cache_key(), cfg)
+    if key not in _CACHE:
+        _CACHE[key] = make_checker2(model, cfg)
+    return _CACHE[key]
+
+
+def cached_batch_checker2(model: Model, cfg: WGLConfig):
+    key = ("batch2", model.cache_key(), cfg)
+    if key not in _CACHE:
+        _CACHE[key] = make_batch_checker2(model, cfg)
+    return _CACHE[key]
+
+
+def steps_arrays(rs: ReturnSteps):
+    return (jnp.asarray(rs.slot_tabs), jnp.asarray(rs.slot_active),
+            jnp.asarray(rs.targets))
+
+
+def make_config(model: Model, k_slots: int, f_cap: int,
+                max_value: int) -> WGLConfig:
+    """WGLConfig with packing bits derived from the history's real values.
+
+    Bits are rounded up to a multiple of 4 (when headroom allows) so nearby
+    value ranges share one jit cache entry."""
+    bits = model.pack_bits(max_value)
+    if bits:
+        rounded = (bits + 3) // 4 * 4
+        if rounded + k_slots <= 31:
+            bits = rounded
+    return WGLConfig(k_slots, f_cap, state_bits=bits)
+
+
+def config_for(rs: ReturnSteps, model: Model, f_cap: int) -> WGLConfig:
+    return make_config(model, rs.k_slots, f_cap, rs.max_value)
+
+
+def check_steps(rs: ReturnSteps, model: Model | None = None,
+                f_cap: int = 256) -> dict[str, Any]:
+    """Single-history entry point over the return-major encoding."""
+    from .wgl import verdict
+
+    if model is None:
+        from ..models import CASRegister
+        model = CASRegister()
+    check = cached_checker2(model, config_for(rs, model, f_cap))
+    out = {k: np.asarray(v) for k, v in check(*steps_arrays(rs)).items()}
+    out["valid"] = verdict(out)
+    return out
+
+
+def check_encoded2(enc: EncodedHistory, model: Model | None = None,
+                   f_cap: int = 256) -> dict[str, Any]:
+    return check_steps(encode_return_steps(enc), model, f_cap)

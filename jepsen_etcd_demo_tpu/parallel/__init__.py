@@ -1,0 +1,20 @@
+"""Device-mesh execution of the checker kernels.
+
+The reference's parallelism axes map onto the TPU mesh like this
+(SURVEY.md §2.4):
+  * independent-key / corpus axis (embarrassingly parallel histories) →
+    data-parallel sharding of the [B, E, 6] event batch over mesh axis
+    "batch" (`batch.py`) — configs[2]/[4] of BASELINE.json;
+  * checker search axis (knossos's JVM search threads) → the WGL frontier
+    sharded over mesh axis "frontier" with shard_map + all_gather compaction
+    (`frontier.py`) — configs[3], the 10k-op north star.
+
+Collectives ride ICI inside a slice; the corpus axis is the DCN axis across
+slices (§2.5).
+"""
+
+from .mesh import make_mesh, device_count  # noqa: F401
+from .batch import sharded_corpus_checker, check_corpus  # noqa: F401
+from .frontier import (  # noqa: F401
+    make_frontier_sharded_checker, make_grid_sharded_checker,
+)

@@ -1,0 +1,228 @@
+"""Frontier-sharded WGL search: one huge history, many devices.
+
+This is the TPU analogue of knossos's multithreaded search (reference hot
+loop #2, SURVEY.md §3.4) and the build's answer to the "sequence
+parallelism" requirement (§5.7): history length is the sequence axis, and the
+search frontier — the per-step state — is sharded across mesh axis
+"frontier" the way ring attention shards KV state.
+
+Per EV_RETURN expansion round (inside a lax.while_loop inside lax.scan):
+  1. LOCAL expand: each device steps its F/D configs against all K pending
+     slots (vmapped model step) and sort-dedups its F/D·(K+1) candidates down
+     to F/D survivors. This is the compute-heavy part and scales 1/D.
+  2. GLOBAL merge: all_gather the survivors (F rows total) over ICI, dedup
+     the gathered frontier (replicated computation), and have each device
+     keep its F/D slice of the compacted result. This both deduplicates
+     globally and REBALANCES, so no shard starves while another overflows.
+
+Soundness: the local stage can drop configs when one shard locally exceeds
+F/D uniques even though global room exists; that is recorded as overflow, and
+overflow only ever converts a would-be "invalid" verdict into "unknown"
+(dropping configs can only lose linearization witnesses — same argument as
+ops/wgl.py). A surviving run is a genuine proof.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..models.base import Model
+from ..ops.encode import EV_INVOKE, EV_RETURN
+from ..ops.wgl import WGLConfig, _dedup, _slot_constants, _Carry
+
+
+def _build_local_check(model: Model, cfg: WGLConfig, axis: str, d: int):
+    """The per-device search body (runs inside shard_map): local expansion +
+    all_gather global compaction over mesh axis `axis` (size d)."""
+    f_loc = cfg.f_cap // d
+    k = cfg.k_slots
+    word_of, bit_of, slot_bitmask = _slot_constants(cfg)
+
+    def bits_set(masks):
+        return (masks[:, word_of] >> bit_of) & jnp.uint32(1)
+
+    def expand_once(states, masks, valid, slot_tab, slot_active, t_word,
+                    t_bit):
+        f = slot_tab[:, 0]
+        a1 = slot_tab[:, 1]
+        a2 = slot_tab[:, 2]
+        rv = slot_tab[:, 3]
+        legal, nxt = jax.vmap(lambda s: model.step(s, f, a1, a2, rv))(states)
+        # Just-in-time linearization: see ops/wgl.py expand_once.
+        not_done = ((masks[:, t_word] >> t_bit) & jnp.uint32(1)) == 0
+        cand_valid = (valid[:, None] & not_done[:, None]
+                      & slot_active[None, :]
+                      & (bits_set(masks) == 0) & legal)
+        cand_masks = masks[:, None, :] | slot_bitmask[None, :, :]
+        all_states = jnp.concatenate([states, nxt.reshape(-1)])
+        all_masks = jnp.concatenate([masks, cand_masks.reshape(-1, cfg.words)])
+        all_valid = jnp.concatenate([valid, cand_valid.reshape(-1)])
+        # 1. local compaction (scales 1/D)
+        s2, m2, v2, n_loc = _dedup(all_states, all_masks, all_valid, f_loc)
+        local_overflow = n_loc > f_loc
+        # 2. global merge + rebalance over ICI
+        gs = jax.lax.all_gather(s2, axis, tiled=True)       # [F]
+        gm = jax.lax.all_gather(m2, axis, tiled=True)       # [F, W]
+        gv = jax.lax.all_gather(v2, axis, tiled=True)       # [F]
+        cs, cm, cv, n_glob = _dedup(gs, gm, gv, cfg.f_cap)
+        # Deal compacted configs ROUND-ROBIN across shards. Dedup packs the
+        # survivors to the front, so a contiguous slice would concentrate
+        # every config on device 0 whenever the frontier is smaller than
+        # f_loc — collapsing effective capacity to f_cap/D and wasting the
+        # other devices. Strided dealing keeps shards balanced.
+        dev = jax.lax.axis_index(axis)
+        mine = jnp.arange(f_loc) * d + dev
+        return (cs[mine], cm[mine], cv[mine], n_glob, local_overflow)
+
+    def closure(states, masks, valid, slot_tab, slot_active, overflow,
+                t_word, t_bit):
+        n0 = jax.lax.psum(jnp.sum(valid.astype(jnp.int32)), axis)
+
+        def cond(st):
+            _s, _m, _v, _n, changed, _o, it = st
+            return changed & (it < cfg.rounds)
+
+        def body(st):
+            s, m, v, n_prev, _c, o, it = st
+            s2, m2, v2, n_glob, loc_of = expand_once(
+                s, m, v, slot_tab, slot_active, t_word, t_bit)
+            o = o | (jax.lax.psum(loc_of.astype(jnp.int32), axis) > 0)
+            return (s2, m2, v2, n_glob, n_glob > n_prev, o, it + 1)
+
+        init = (states, masks, valid, n0, jnp.bool_(True), overflow,
+                jnp.int32(0))
+        s, m, v, n, _c, o, _it = jax.lax.while_loop(cond, body, init)
+        return s, m, v, n, o
+
+    def step(carry: _Carry, ev_and_idx):
+        ev, idx = ev_and_idx
+        kind, slot = ev[0], ev[1]
+
+        def on_invoke(c: _Carry) -> _Carry:
+            slot_tab = c.slot_tab.at[slot].set(ev[2:6])
+            slot_active = c.slot_active.at[slot].set(True)
+            return c._replace(slot_tab=slot_tab, slot_active=slot_active)
+
+        def on_return(c: _Carry) -> _Carry:
+            s, m, v, n, overflow = closure(
+                c.states, c.masks, c.valid, c.slot_tab, c.slot_active,
+                c.overflow, word_of[slot], bit_of[slot])
+            bit_word = jnp.take(m, word_of[slot], axis=-1)
+            has_bit = ((bit_word >> bit_of[slot]) & jnp.uint32(1)) == 1
+            keep = v & has_bit
+            cleared = m & ~slot_bitmask[slot][None, :]
+            slot_active = c.slot_active.at[slot].set(False)
+            alive = jax.lax.psum(jnp.any(keep).astype(jnp.int32), axis) > 0
+            died = ~alive
+            return c._replace(
+                states=s, masks=cleared, valid=keep,
+                slot_active=slot_active,
+                dead=died, overflow=overflow,
+                dead_event=jnp.where(died & (c.dead_event < 0), idx,
+                                     c.dead_event),
+                max_frontier=jnp.maximum(c.max_frontier, n))
+
+        def active_step(c: _Carry) -> _Carry:
+            return jax.lax.cond(kind == EV_INVOKE, on_invoke, on_return, c)
+
+        skip = carry.dead | (kind != EV_INVOKE) & (kind != EV_RETURN)
+        carry = jax.lax.cond(skip, lambda c: c, active_step, carry)
+        return carry, None
+
+    def init_carry() -> _Carry:
+        dev = jax.lax.axis_index(axis)
+        seed = (jnp.arange(f_loc) == 0) & (dev == 0)
+        return _Carry(
+            states=jnp.where(seed, model.init_state(), 0).astype(jnp.int32),
+            masks=jnp.zeros((f_loc, cfg.words), jnp.uint32),
+            valid=seed,
+            slot_tab=jnp.zeros((k, 4), jnp.int32),
+            slot_active=jnp.zeros((k,), bool),
+            dead=jnp.bool_(False),
+            overflow=jnp.bool_(False),
+            dead_event=jnp.int32(-1),
+            max_frontier=jnp.int32(1),
+        )
+
+    def check_local(events):
+        carry = init_carry()
+        idxs = jnp.arange(events.shape[0], dtype=jnp.int32)
+        final, _ = jax.lax.scan(step, carry, (events, idxs))
+        overflow = jax.lax.psum(final.overflow.astype(jnp.int32), axis) > 0
+        return {
+            "survived": ~final.dead,
+            "overflow": overflow,
+            "dead_event": final.dead_event,
+            "max_frontier": final.max_frontier,
+        }
+
+    return check_local
+
+
+def _shard_map(fn, **specs):
+    try:  # jax>=0.8 names the replication check check_vma; older check_rep
+        return shard_map(fn, check_vma=False, **specs)
+    except TypeError:
+        return shard_map(fn, check_rep=False, **specs)
+
+
+def make_frontier_sharded_checker(model: Model, cfg: WGLConfig, mesh: Mesh,
+                                  axis: str = "frontier"):
+    """Returns jitted check(events[E, 6]) -> dict of replicated scalars.
+
+    cfg.f_cap is the GLOBAL frontier capacity; each device holds
+    f_cap / axis_size configs. Requires f_cap % axis_size == 0."""
+    d = mesh.shape[axis]
+    if cfg.f_cap % d != 0:
+        raise ValueError(f"f_cap {cfg.f_cap} not divisible by axis size {d}")
+    check_local = _build_local_check(model, cfg, axis, d)
+    sharded = _shard_map(
+        check_local, mesh=mesh,
+        in_specs=(P(*(None,) * 2),),
+        out_specs={"survived": P(), "overflow": P(), "dead_event": P(),
+                   "max_frontier": P()})
+    return jax.jit(sharded)
+
+
+def make_grid_sharded_checker(model: Model, cfg: WGLConfig, mesh: Mesh,
+                              batch_axis: str = "batch",
+                              frontier_axis: str = "frontier"):
+    """2D-sharded corpus check: histories data-parallel over `batch_axis`,
+    each history's frontier sharded over `frontier_axis`.
+
+    check(events[B, E, 6]) -> dict of [B] vectors. B must be a multiple of
+    the batch axis size. This is the full production sharding — the corpus
+    axis rides DCN across slices, the frontier axis rides ICI within one
+    (SURVEY.md §2.5)."""
+    d = mesh.shape[frontier_axis]
+    if cfg.f_cap % d != 0:
+        raise ValueError(f"f_cap {cfg.f_cap} not divisible by axis size {d}")
+    check_local = _build_local_check(model, cfg, frontier_axis, d)
+    body = jax.vmap(check_local)  # over the local batch shard
+    sharded = _shard_map(
+        body, mesh=mesh,
+        in_specs=(P(batch_axis, None, None),),
+        out_specs={"survived": P(batch_axis), "overflow": P(batch_axis),
+                   "dead_event": P(batch_axis),
+                   "max_frontier": P(batch_axis)})
+    return jax.jit(sharded)
+
+
+_CACHE: dict[tuple, Any] = {}
+
+
+def cached_frontier_checker(model: Model, cfg: WGLConfig, mesh: Mesh):
+    key = (model.cache_key(), cfg, id(mesh))
+    if key not in _CACHE:
+        _CACHE[key] = make_frontier_sharded_checker(model, cfg, mesh)
+    return _CACHE[key]

@@ -1,0 +1,26 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axes: Sequence[str] = ("batch",),
+              shape: Optional[Sequence[int]] = None) -> Mesh:
+    """Mesh over the first n devices. 1-axis by default ("batch"); pass
+    axes=("batch", "frontier") with a shape to split ICI between the corpus
+    axis and the frontier axis."""
+    devs = jax.devices()[: (n_devices or len(jax.devices()))]
+    if shape is None:
+        shape = [len(devs)] + [1] * (len(axes) - 1)
+    arr = np.array(devs).reshape(tuple(shape))
+    return Mesh(arr, tuple(axes))

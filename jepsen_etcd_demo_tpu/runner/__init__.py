@@ -1,0 +1,9 @@
+"""The core run loop — jepsen.core/run! equivalent.
+
+Orchestrates: node setup (OS + DB), concurrent client workers + nemesis
+interpreting the generator, history recording, phased shutdown, teardown,
+checking, and store persistence (reference flow: SURVEY.md §3.1).
+"""
+
+from .history import HistoryRecorder  # noqa: F401
+from .core import run_test, interpret_generators  # noqa: F401

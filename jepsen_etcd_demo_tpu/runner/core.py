@@ -1,0 +1,254 @@
+"""run_test: setup → interpret generators → teardown → check → store.
+
+Mirrors jepsen.core/run!'s structure (reference call stack SURVEY.md §3.1):
+  1. OS + DB setup per node, concurrently (reference src/jepsen/etcdemo.clj:161,34-55)
+  2. Worker tasks — `concurrency` clients + 1 nemesis — pull ops from the
+     generator, invoke them, and record invoke/completion pairs
+  3. DB teardown per node (:57-60), log collection (db/LogFiles, :62-64)
+  4. checker.check over the recorded history (:115-119,165-167)
+  5. persist everything under store/<name>/<ts>/ (§1 L1)
+
+Worker/process model (jepsen semantics the checker depends on): each worker
+thread runs one logical *process*. A process that completes an op :info is
+considered crashed — it never invokes again; the worker reincarnates as
+process + concurrency with a freshly opened client. This is what makes
+:info ops "open forever" in the history.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from typing import Any, Optional
+
+from ..clients.base import Client
+from ..generators.core import (Gen, GenContext, Pending, Phases, NEMESIS,
+                               SECOND)
+from ..nemesis.base import Nemesis
+from ..ops.op import Op, INVOKE
+from ..control.runner import runner_for
+from .history import HistoryRecorder
+
+log = logging.getLogger(__name__)
+
+
+class _RunState:
+    """Shared state of the interpreter loop (single event loop — no locks)."""
+
+    def __init__(self, recorder: HistoryRecorder, rng: random.Random):
+        self.recorder = recorder
+        self.rng = rng
+        self.in_flight = 0
+        self.wake = asyncio.Condition()
+
+    async def notify(self):
+        async with self.wake:
+            self.wake.notify_all()
+
+
+async def _worker(test: dict, gen: Gen, state: _RunState,
+                  worker_id: int, concurrency: int,
+                  client_proto: Optional[Client], nemesis: Optional[Nemesis]):
+    """One worker: repeatedly ask the generator, invoke, record."""
+    is_nemesis = worker_id < 0
+    process: Any = NEMESIS if is_nemesis else worker_id
+    client: Optional[Client] = None
+    nodes = test["nodes"]
+
+    async def ensure_client():
+        nonlocal client
+        if not is_nemesis and client is None:
+            node = nodes[int(process) % len(nodes)]
+            client = await client_proto.open(test, node)
+
+    try:
+        while True:
+            ctx = GenContext(state.recorder.now(), process, state.rng, test)
+            out = gen.next_for(ctx)
+            if out is None:
+                # Exhausted for us. A pending phase barrier may still open a
+                # new phase; Phases returns Pending in that window, so a plain
+                # None is final.
+                return
+            if isinstance(out, Pending):
+                await _wait(state, out.wake, ctx.time)
+                _maybe_open_barrier(gen, state)
+                continue
+            op: Op = out
+            if op.type == "log":
+                log.info("%s", op.value)
+                continue
+            op.process = process
+            state.in_flight += 1
+            state.recorder.append(op)
+            try:
+                if is_nemesis:
+                    completion = await nemesis.invoke(test, op)
+                else:
+                    await ensure_client()
+                    completion = await client.invoke(test, op)
+            except Exception as e:  # client bug or unexpected edge: crash op
+                log.exception("invoke crashed for %s", op)
+                completion = Op(type="info", f=op.f, value=op.value,
+                                process=process, error=f"crash: {e}")
+            finally:
+                state.in_flight -= 1
+            completion.process = process
+            state.recorder.append(completion)
+            if not is_nemesis and completion.type == "info":
+                # Process crashed (indeterminate op): reincarnate.
+                if client is not None:
+                    try:
+                        await client.close(test)
+                    except Exception:
+                        pass
+                    client = None
+                process = int(process) + concurrency
+            _maybe_open_barrier(gen, state)
+            await state.notify()
+    finally:
+        if client is not None:
+            try:
+                await client.close(test)
+            except Exception:
+                pass
+
+
+async def _wait(state: _RunState, wake: Optional[int], now: int):
+    """Sleep until `wake` (relative ns) or until some completion/barrier
+    changes the world."""
+    if wake is not None:
+        delay = max(0.0, (wake - now) / SECOND)
+        await asyncio.sleep(min(delay, 0.5) if delay else 0.001)
+        return
+    async with state.wake:
+        try:
+            await asyncio.wait_for(state.wake.wait(), timeout=0.2)
+        except asyncio.TimeoutError:
+            pass
+
+
+def _maybe_open_barrier(gen: Gen, state: _RunState):
+    """Phase barrier: flip to the next phase once nothing is in flight
+    (jepsen: all workers must finish phase N before N+1 starts)."""
+    if isinstance(gen, Phases) and gen.barrier_pending() \
+            and state.in_flight == 0:
+        gen.barrier_done()
+
+
+async def interpret_generators(test: dict, recorder: HistoryRecorder
+                               ) -> list[Op]:
+    """Run the generator interpreter loop to exhaustion; returns history."""
+    concurrency = int(test.get("concurrency", 10))
+    rng = random.Random(test.get("seed", 0))
+    state = _RunState(recorder, rng)
+    gen = test["generator"]
+    client_proto = test.get("client")
+    nemesis = test.get("nemesis")
+
+    tasks = [asyncio.create_task(
+        _worker(test, gen, state, i, concurrency, client_proto, nemesis))
+        for i in range(concurrency)]
+    if nemesis is not None:
+        tasks.append(asyncio.create_task(
+            _worker(test, gen, state, -1, concurrency, None, nemesis)))
+    await asyncio.gather(*tasks)
+    return recorder.history
+
+
+async def _setup_nodes(test: dict):
+    db = test.get("db")
+    os_setup = test.get("os_setup")
+
+    async def setup_one(node):
+        r = runner_for(test, node)
+        if os_setup is not None:
+            await os_setup(r, node)
+        if db is not None:
+            await db.setup(test, r, node)
+
+    await asyncio.gather(*(setup_one(n) for n in test["nodes"]))
+
+
+async def _teardown_nodes(test: dict, store_dir=None):
+    db = test.get("db")
+    if db is None:
+        return
+    async def teardown_one(node):
+        r = runner_for(test, node)
+        if store_dir is not None:
+            for remote in db.log_files(test, node):
+                local = store_dir / f"{node}-{remote.rsplit('/', 1)[-1]}"
+                dl = getattr(r, "download", None)
+                if dl is not None:
+                    await dl(remote, str(local))
+        await db.teardown(test, r, node)
+    await asyncio.gather(*(teardown_one(n) for n in test["nodes"]))
+
+
+async def run_test(test: dict) -> dict:
+    """Execute a full test; returns the result map (with "valid")."""
+    from ..store import Store
+
+    store = None
+    if test.get("store_root") is not None:
+        store = Store(test["store_root"]).new_run(test.get("name", "test"))
+        _attach_file_log(store.path)
+
+    log.info("=== %s: setting up %d nodes", test.get("name"),
+             len(test["nodes"]))
+    t0 = time.monotonic()
+    await _setup_nodes(test)
+
+    # Client/nemesis data-plane setup (reference Client.setup!, set.clj:15-16)
+    client_proto: Optional[Client] = test.get("client")
+    if client_proto is not None:
+        c = await client_proto.open(test, test["nodes"][0])
+        await c.setup(test)
+        await c.close(test)
+    nemesis: Optional[Nemesis] = test.get("nemesis")
+    if nemesis is not None:
+        await nemesis.setup(test)
+
+    log.info("=== running workload")
+    recorder = HistoryRecorder()
+    try:
+        history = await interpret_generators(test, recorder)
+    finally:
+        if nemesis is not None:
+            await nemesis.teardown(test)
+        if client_proto is not None:
+            c = await client_proto.open(test, test["nodes"][0])
+            await c.teardown(test)
+            await c.close(test)
+        await _teardown_nodes(test, store.path if store else None)
+
+    run_s = time.monotonic() - t0
+    log.info("=== run complete: %d history entries in %.1fs; checking",
+             len(history), run_s)
+
+    checker = test.get("checker")
+    opts = {"store_dir": str(store.path)} if store else {}
+    result = (checker.check(test, history, opts)
+              if checker is not None else {"valid": True})
+    result.setdefault("op_count",
+                      sum(1 for o in history if o.type == INVOKE))
+    result["run_seconds"] = run_s
+
+    if store is not None:
+        store.write_run(test, history, result)
+        log.info("=== stored run at %s", store.path)
+    log.info("=== valid: %s", result.get("valid"))
+    return result
+
+
+def _attach_file_log(store_path):
+    """Tee the framework log into the run dir (reference: logback writes
+    jepsen.log into the store [dep], SURVEY.md §5.5)."""
+    root = logging.getLogger()
+    handler = logging.FileHandler(store_path / "jepsen.log")
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+    root.addHandler(handler)

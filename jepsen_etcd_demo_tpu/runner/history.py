@@ -1,0 +1,35 @@
+"""History recorder: the single synchronization point of a run.
+
+The recorded history is jepsen's central artifact — an ordered vector of
+invoke/complete entries with process ids and timestamps [dep: jepsen core
+recorder]. Append assigns the index and relative-time fields. All appends
+happen on the one event loop, so ordering is the loop's scheduling order —
+the same "real time" order a concurrent checker needs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..ops.op import Op
+
+
+class HistoryRecorder:
+    def __init__(self, start_ns: Optional[int] = None):
+        self.start_ns = start_ns if start_ns is not None else time.monotonic_ns()
+        self.entries: list[Op] = []
+
+    def now(self) -> int:
+        """Relative ns since test start."""
+        return time.monotonic_ns() - self.start_ns
+
+    def append(self, op: Op) -> Op:
+        op.index = len(self.entries)
+        op.time = self.now()
+        self.entries.append(op)
+        return op
+
+    @property
+    def history(self) -> list[Op]:
+        return self.entries

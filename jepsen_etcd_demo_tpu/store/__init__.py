@@ -1,0 +1,13 @@
+"""On-disk run persistence — jepsen.store equivalent.
+
+Layout mirrors the reference's store (evidenced by store/ symlinks in tree,
+SURVEY.md §2.1 #7): store/<test-name>/<timestamp>/ holding the test config,
+the full history, results, charts and logs, with `latest` and `current`
+symlinks per test name and at the root. The reference serializes history with
+fressian [dep]; this build uses JSONL for the host artifact plus .npz for the
+encoded tensor form the TPU checker consumes (check is re-runnable from a
+stored history without re-running the cluster — the corpus-replay workflow,
+BASELINE.json configs[4]).
+"""
+
+from .store import Store, RunDir  # noqa: F401

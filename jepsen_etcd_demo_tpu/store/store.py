@@ -1,0 +1,111 @@
+"""Store implementation: run dirs, symlinks, (de)serialization."""
+
+from __future__ import annotations
+
+import json
+import os
+from datetime import datetime, timezone
+from pathlib import Path
+from typing import Any, Optional
+
+import numpy as np
+
+from ..ops.op import Op, history_to_jsonl, history_from_jsonl
+
+TEST_FILE = "test.json"
+HISTORY_FILE = "history.jsonl"
+HISTORY_TENSOR_FILE = "history.npz"
+RESULTS_FILE = "results.json"
+
+
+def _jsonable_test(test: dict) -> dict:
+    """The test map holds live objects (client, checker, generator); persist
+    the data fields and the repr of the rest, like jepsen prunes its test map
+    before serialization."""
+    out = {}
+    for k, v in test.items():
+        if isinstance(v, (str, int, float, bool, type(None), list, dict)):
+            out[k] = v
+        else:
+            out[k] = repr(v)
+    return out
+
+
+class RunDir:
+    def __init__(self, path: Path):
+        self.path = Path(path)
+
+    # -- writing ----------------------------------------------------------
+    def write_run(self, test: dict, history: list[Op], result: dict) -> None:
+        self.write_test(test)
+        self.write_history(history)
+        self.write_results(result)
+
+    def write_test(self, test: dict) -> None:
+        (self.path / TEST_FILE).write_text(
+            json.dumps(_jsonable_test(test), indent=2, default=str))
+
+    def write_history(self, history: list[Op]) -> None:
+        (self.path / HISTORY_FILE).write_text(history_to_jsonl(history))
+
+    def write_results(self, result: dict) -> None:
+        (self.path / RESULTS_FILE).write_text(
+            json.dumps(result, indent=2, default=str))
+
+    def write_history_tensor(self, name: str, events: np.ndarray,
+                             **meta) -> None:
+        """Persist an encoded event tensor (corpus-replay input)."""
+        np.savez_compressed(self.path / f"{name}.npz", events=events,
+                            **{k: np.asarray(v) for k, v in meta.items()})
+
+    # -- reading ----------------------------------------------------------
+    def read_history(self) -> list[Op]:
+        return history_from_jsonl((self.path / HISTORY_FILE).read_text())
+
+    def read_results(self) -> dict:
+        return json.loads((self.path / RESULTS_FILE).read_text())
+
+    def read_test(self) -> dict:
+        return json.loads((self.path / TEST_FILE).read_text())
+
+
+class Store:
+    def __init__(self, root: str | Path = "store"):
+        self.root = Path(root)
+
+    def new_run(self, test_name: str) -> RunDir:
+        ts = datetime.now(timezone.utc).strftime("%Y%m%dT%H%M%S.%f")[:-3] + "Z"
+        path = self.root / test_name / ts
+        path.mkdir(parents=True, exist_ok=True)
+        self._symlink(self.root / test_name / "latest", ts)
+        self._symlink(self.root / "latest", Path(test_name) / ts)
+        self._symlink(self.root / "current", Path(test_name) / ts)
+        return RunDir(path)
+
+    @staticmethod
+    def _symlink(link: Path, target) -> None:
+        link.parent.mkdir(parents=True, exist_ok=True)
+        if link.is_symlink() or link.exists():
+            link.unlink()
+        os.symlink(str(target), str(link))
+
+    def latest(self, test_name: Optional[str] = None) -> Optional[RunDir]:
+        link = (self.root / test_name / "latest" if test_name
+                else self.root / "latest")
+        if not link.exists():
+            return None
+        return RunDir(link.parent / os.readlink(str(link))
+                      if not Path(os.readlink(str(link))).is_absolute()
+                      else Path(os.readlink(str(link))))
+
+    def runs(self) -> list[RunDir]:
+        out = []
+        if not self.root.exists():
+            return out
+        for test_dir in sorted(self.root.iterdir()):
+            if not test_dir.is_dir() or test_dir.name in ("latest", "current"):
+                continue
+            for run in sorted(test_dir.iterdir()):
+                if run.is_dir() and not run.is_symlink():
+                    out.append(RunDir(run))
+        return out

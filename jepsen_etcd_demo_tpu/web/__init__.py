@@ -1,0 +1,4 @@
+"""Web browser over the results store — the reference's `serve` command
+(ring/jetty directory browser, src/jepsen/etcdemo.clj:198)."""
+
+from .server import serve, make_handler  # noqa: F401

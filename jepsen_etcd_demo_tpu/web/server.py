@@ -1,0 +1,78 @@
+"""Tiny stdlib HTTP server over store/ — `lein run serve` equivalent.
+
+The reference serves its store with ring/jetty + a directory browser
+(src/jepsen/etcdemo.clj:198; deps jepsen.etcdemo.iml:82-99). Same capability
+on http.server: an index of runs with verdicts, and static file serving of
+each run dir (charts, timelines, logs, history)."""
+
+from __future__ import annotations
+
+import html
+import json
+from functools import partial
+from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+from ..store import Store
+
+
+def _index_html(store: Store) -> str:
+    rows = []
+    for run in reversed(store.runs()):
+        rel = run.path.relative_to(store.root)
+        try:
+            valid = run.read_results().get("valid")
+        except Exception:
+            valid = "?"
+        color = {True: "#2a9d43", False: "#d43a2a"}.get(valid, "#e9a820")
+        rows.append(
+            f"<tr><td><a href='/files/{html.escape(str(rel))}/'>"
+            f"{html.escape(str(rel))}</a></td>"
+            f"<td style='color:{color};font-weight:bold'>{valid}</td></tr>")
+    return (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        "<title>jepsen-tpu store</title>"
+        "<style>body{font-family:sans-serif}td{padding:4px 12px}</style>"
+        "</head><body><h2>test runs</h2>"
+        f"<table><tr><th>run</th><th>valid</th></tr>{''.join(rows)}</table>"
+        "</body></html>")
+
+
+class StoreHandler(SimpleHTTPRequestHandler):
+    """/ -> run index; /files/... -> static serving rooted at the store."""
+
+    def __init__(self, *args, store_root: str = "store", **kw):
+        self.store = Store(store_root)
+        super().__init__(*args, directory=str(store_root), **kw)
+
+    def do_GET(self):
+        if self.path in ("/", "/index.html"):
+            body = _index_html(self.store).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if self.path.startswith("/files/"):
+            self.path = self.path[len("/files"):]
+        return super().do_GET()
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+
+def make_handler(store_root: str):
+    return partial(StoreHandler, store_root=store_root)
+
+
+def serve(store_root: str = "store", host: str = "127.0.0.1",
+          port: int = 8080):
+    httpd = ThreadingHTTPServer((host, port), make_handler(store_root))
+    print(f"serving {store_root} on http://{host}:{port}")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()

@@ -1,16 +1,28 @@
 """Test config: force JAX onto a virtual 8-device CPU platform.
 
-Must run before the first `import jax` anywhere in the test process
-(SURVEY.md §4: CPU-backend jit tests + 8 simulated devices for mesh tests).
+(SURVEY.md §4: CPU-backend jit tests + 8 simulated devices for mesh tests.)
+
+The environment may pre-import jax with a TPU backend via sitecustomize, so
+setting JAX_PLATFORMS in os.environ here can be too late — also use
+jax.config.update, which works as long as no backend has been initialized
+yet (i.e. before the first jax.devices() call).
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass  # older jax: XLA_FLAGS above covers it
 
 import random  # noqa: E402
 

@@ -46,7 +46,7 @@ class TestLinearizable:
         h = _h((INVOKE, "read", None, 0), (OK, "read", 4, 0))
         res = Linearizable(backend="jax").check({}, h)
         assert res["valid"] is False
-        assert res["dead_event"] == 1
+        assert res["dead_step"] == 0  # dies at the first (and only) return
 
 
 class TestCompose:

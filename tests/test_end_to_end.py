@@ -1,0 +1,117 @@
+"""Hermetic end-to-end pipeline tests: generator → client → history →
+checker → store, over the in-process fake cluster — the reference's whole
+flow (SURVEY.md §3.1) with no SSH/etcd (§4 "fake backend")."""
+
+import asyncio
+import json
+
+import pytest
+
+from jepsen_etcd_demo_tpu.compose import fake_test
+from jepsen_etcd_demo_tpu.runner import run_test
+from jepsen_etcd_demo_tpu.store import Store
+
+
+def run(test):
+    return asyncio.run(run_test(test))
+
+
+def fast_opts(tmp_path, **kw):
+    opts = {
+        "time_limit": 1.5,
+        "rate": 200.0,
+        "ops_per_key": 40,
+        "concurrency": 10,
+        "recovery_wait": 0.1,
+        "nemesis_interval": 0.3,
+        "store_root": str(tmp_path / "store"),
+        "seed": 1,
+    }
+    opts.update(kw)
+    return opts
+
+
+def test_register_run_healthy_is_linearizable(tmp_path):
+    test = fake_test(fast_opts(tmp_path, workload="register",
+                               no_nemesis=True))
+    result = run(test)
+    assert result["valid"] is True
+    assert result["indep"]["key_count"] >= 1
+    assert result["op_count"] > 50
+
+
+def test_register_run_with_partitions_is_linearizable(tmp_path):
+    """The fake store IS linearizable (timeouts are indeterminate, not
+    wrong), so even under partitions the checker must agree."""
+    test = fake_test(fast_opts(tmp_path, workload="register", seed=2))
+    result = run(test)
+    assert result["valid"] is True
+    # Partitions actually fired: some ops must have timed out as :info.
+    hist = Store(test["store_root"]).latest().read_history()
+    assert any(o.type == "info" and o.error for o in hist)
+
+
+def test_register_run_detects_stale_reads(tmp_path):
+    """Injected stale reads (non-quorum) must produce a linearizability
+    violation — proof the full pipeline can actually FAIL (SURVEY.md §4)."""
+    test = fake_test(fast_opts(tmp_path, workload="register",
+                               stale_read_prob=0.8, no_nemesis=True,
+                               time_limit=2.0, seed=3))
+    result = run(test)
+    assert result["valid"] is False
+
+
+def test_set_run_healthy(tmp_path):
+    test = fake_test(fast_opts(tmp_path, workload="set", no_nemesis=True))
+    result = run(test)
+    assert result["valid"] is True
+    assert result["indep"]["ok_count"] > 10
+    assert result["indep"]["lost_count"] == 0
+
+
+def test_set_run_detects_lost_writes(tmp_path):
+    test = fake_test(fast_opts(tmp_path, workload="set",
+                               lost_write_prob=0.3, no_nemesis=True, seed=4))
+    result = run(test)
+    assert result["valid"] is False
+    assert result["indep"]["lost_count"] > 0
+
+
+def test_store_artifacts_written(tmp_path):
+    test = fake_test(fast_opts(tmp_path, workload="register",
+                               no_nemesis=True))
+    run(test)
+    store = Store(test["store_root"])
+    latest = store.latest()
+    assert latest is not None
+    files = {p.name for p in latest.path.iterdir()}
+    assert {"test.json", "history.jsonl", "results.json",
+            "jepsen.log"} <= files
+    # Perf charts + per-key timelines landed too.
+    assert "latency-raw.png" in files
+    assert any(f.startswith("timeline-") for f in files)
+    # results.json round-trips with the verdict.
+    res = json.loads((latest.path / "results.json").read_text())
+    assert res["valid"] is True
+    # History round-trips through the store.
+    hist = latest.read_history()
+    assert len(hist) > 0 and hist[0].index == 0
+
+
+def test_history_is_well_formed(tmp_path):
+    """Every invoke has at most one completion; completions follow invokes;
+    indices are dense; nemesis ops recorded as :info pairs."""
+    test = fake_test(fast_opts(tmp_path, workload="register", seed=5))
+    run(test)
+    hist = Store(test["store_root"]).latest().read_history()
+    assert [o.index for o in hist] == list(range(len(hist)))
+    pending = set()
+    for op in hist:
+        if op.type == "invoke":
+            assert op.process not in pending
+            pending.add(op.process)
+        else:
+            assert op.process in pending
+            pending.discard(op.process)
+    times = [o.time for o in hist]
+    assert times == sorted(times)

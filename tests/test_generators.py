@@ -1,0 +1,191 @@
+"""Generator combinator tests: semantics + determinism under seeds
+(SURVEY.md §4)."""
+
+import random
+
+import pytest
+
+from jepsen_etcd_demo_tpu import generators as gen
+from jepsen_etcd_demo_tpu.generators.core import GenContext, Pending, NEMESIS
+from jepsen_etcd_demo_tpu.ops.op import Op
+
+SECOND = 1_000_000_000
+
+
+def ctx(t=0, process=0, seed=0):
+    return GenContext(t, process, random.Random(seed))
+
+
+def drain(g, process=0, seed=0, max_steps=10_000, t_step=SECOND // 100):
+    """Drive a generator with a fake advancing clock; collect emitted ops."""
+    rng = random.Random(seed)
+    t = 0
+    out = []
+    for _ in range(max_steps):
+        res = g.next_for(GenContext(t, process, rng))
+        if res is None:
+            return out
+        if isinstance(res, Pending):
+            t = res.wake if res.wake is not None else t + t_step
+            continue
+        out.append(res)
+        t += 1  # ns per op: time advances monotonically
+    raise AssertionError("generator did not exhaust")
+
+
+def test_limit_counts_ops():
+    g = gen.limit(5, lambda c: {"f": "read", "value": None})
+    assert len(drain(g)) == 5
+
+
+def test_once_is_limit_one():
+    g = gen.once({"f": "stop", "value": None})
+    ops = drain(g)
+    assert len(ops) == 1 and ops[0].f == "stop"
+
+
+def test_mix_draws_from_all_and_is_seed_deterministic():
+    def a(c):
+        return {"f": "a"}
+
+    def b(c):
+        return {"f": "b"}
+
+    fs1 = [o.f for o in drain(gen.limit(100, gen.mix([a, b])), seed=7)]
+    fs2 = [o.f for o in drain(gen.limit(100, gen.mix([a, b])), seed=7)]
+    fs3 = [o.f for o in drain(gen.limit(100, gen.mix([a, b])), seed=8)]
+    assert fs1 == fs2           # deterministic under seed
+    assert fs1 != fs3           # seed actually matters
+    assert {"a", "b"} == set(fs1)
+
+
+def test_mix_exhausts_when_all_exhaust():
+    g = gen.mix([gen.limit(2, lambda c: {"f": "a"}),
+                 gen.limit(3, lambda c: {"f": "b"})])
+    ops = drain(g)
+    assert sorted(o.f for o in ops) == ["a", "a", "b", "b", "b"]
+
+
+def test_stagger_spaces_ops_at_mean_rate():
+    g = gen.time_limit(10.0, gen.stagger(0.1, lambda c: {"f": "r"}))
+    ops = drain(g)
+    # mean gap 0.1s over 10s => ~100 ops; uniform[0, 0.2) gives wide but
+    # bounded variance.
+    assert 60 <= len(ops) <= 140
+
+
+def test_time_limit_cuts_off():
+    g = gen.time_limit(1.0, lambda c: {"f": "r"})
+    rng = random.Random(0)
+    assert isinstance(g.next_for(GenContext(0, 0, rng)), Op)
+    assert g.next_for(GenContext(2 * SECOND, 0, rng)) is None
+
+
+def test_sleep_pends_then_exhausts():
+    g = gen.sleep(1.0)
+    rng = random.Random(0)
+    res = g.next_for(GenContext(0, 0, rng))
+    assert isinstance(res, Pending) and res.wake == SECOND
+    assert g.next_for(GenContext(SECOND, 0, rng)) is None
+
+
+def test_log_emits_once():
+    g = gen.log("hello")
+    rng = random.Random(0)
+    op = g.next_for(GenContext(0, 0, rng))
+    assert op.type == "log" and op.value == "hello"
+    assert g.next_for(GenContext(0, 0, rng)) is None
+
+
+def test_nemesis_routing():
+    g = gen.nemesis_gen(gen.once({"f": "start"}))
+    rng = random.Random(0)
+    assert isinstance(g.next_for(GenContext(0, 3, rng)), Pending)
+    op = g.next_for(GenContext(0, NEMESIS, rng))
+    assert op.f == "start"
+
+
+def test_clients_routing():
+    g = gen.clients_gen(gen.once({"f": "read"}))
+    rng = random.Random(0)
+    assert isinstance(g.next_for(GenContext(0, NEMESIS, rng)), Pending)
+    assert g.next_for(GenContext(0, 2, rng)).f == "read"
+
+
+def test_cycle_rebuilds_nemesis_schedule():
+    """The reference's nemesis loop: sleep 5 / start / sleep 5 / stop, forever
+    (src/jepsen/etcdemo.clj:138-143)."""
+    g = gen.cycle(lambda: [gen.sleep(5), gen.once({"f": "start"}),
+                           gen.sleep(5), gen.once({"f": "stop"})])
+    rng = random.Random(0)
+    t = 0
+    seen = []
+    for _ in range(200):
+        res = g.next_for(GenContext(t, NEMESIS, rng))
+        if isinstance(res, Pending):
+            t = res.wake
+        elif isinstance(res, Op):
+            seen.append((res.f, t))
+        if len(seen) == 4:
+            break
+    assert [f for f, _ in seen] == ["start", "stop", "start", "stop"]
+    assert seen[0][1] == 5 * SECOND
+    assert seen[1][1] == 10 * SECOND
+    assert seen[2][1] == 15 * SECOND
+
+
+def test_phases_barrier_protocol():
+    g = gen.phases(gen.limit(2, lambda c: {"f": "a"}),
+                   gen.limit(1, lambda c: {"f": "b"}))
+    rng = random.Random(0)
+    c = GenContext(0, 0, rng)
+    assert g.next_for(c).f == "a"
+    assert g.next_for(c).f == "a"
+    # Phase 1 exhausted: generator signals a barrier, pends until runner
+    # confirms all in-flight ops done.
+    res = g.next_for(c)
+    assert isinstance(res, Pending) and g.barrier_pending()
+    g.barrier_done()
+    assert g.next_for(c).f == "b"
+    assert g.next_for(c) is None
+
+
+def test_concurrent_generator_rotates_keys_per_group():
+    """independent/concurrent-generator semantics: 2 threads per key, groups
+    rotate to fresh keys as each key's budget exhausts
+    (reference src/jepsen/etcdemo.clj:120-125)."""
+    g = gen.concurrent_generator(
+        2, iter(range(100)), lambda k: gen.limit(3, lambda c: {"f": "read",
+                                                               "value": None}))
+    rng = random.Random(0)
+    # Workers 0,1 form group 0; workers 2,3 group 1.
+    ops_g0 = [g.next_for(GenContext(0, p, rng)) for p in (0, 1, 0)]
+    ops_g1 = [g.next_for(GenContext(0, 2, rng))]
+    keys_g0 = {o.value[0] for o in ops_g0}
+    keys_g1 = {o.value[0] for o in ops_g1}
+    assert keys_g0 == {0}
+    assert keys_g1 == {1}
+    # Group 0 exhausted its key (3 ops) -> next op draws a fresh key.
+    nxt = g.next_for(GenContext(0, 0, rng))
+    assert nxt.value[0] == 2
+    # Values are (key, value) tuples.
+    assert isinstance(nxt.value, tuple)
+
+
+def test_concurrent_generator_nemesis_sees_pending():
+    g = gen.concurrent_generator(2, iter([1]), lambda k: gen.Gen())
+    assert isinstance(g.next_for(GenContext(0, NEMESIS, random.Random(0))),
+                      Pending)
+
+
+def test_full_schedule_determinism():
+    """The whole composed schedule is deterministic under a seed."""
+    def build(seed):
+        g = gen.time_limit(5.0, gen.stagger(
+            0.05, gen.mix([lambda c: {"f": "read", "value": None},
+                           lambda c: {"f": "write",
+                                      "value": c.rng.randrange(5)}])))
+        return [(o.f, o.value) for o in drain(g, seed=seed)]
+
+    assert build(3) == build(3)
+    assert build(3) != build(4)

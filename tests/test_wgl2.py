@@ -1,0 +1,212 @@
+"""v2 (return-major) kernel: differential tests vs oracle and v1 kernel."""
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_etcd_demo_tpu.checkers.oracle import (brute_force_check,
+                                                  check_events_oracle)
+from jepsen_etcd_demo_tpu.models import CASRegister, Register
+from jepsen_etcd_demo_tpu.ops.encode import (encode_register_history,
+                                             encode_return_steps)
+from jepsen_etcd_demo_tpu.ops.wgl import check_encoded
+from jepsen_etcd_demo_tpu.ops.wgl2 import (check_encoded2,
+                                           cached_batch_checker2,
+                                           steps_arrays)
+from jepsen_etcd_demo_tpu.ops.wgl import WGLConfig
+from jepsen_etcd_demo_tpu.utils.fuzz import gen_register_history, \
+    mutate_history
+from golden import GOLDEN
+
+
+def test_return_steps_encoding_roundtrip():
+    h = gen_register_history(random.Random(0), n_ops=30, n_procs=4)
+    enc = encode_register_history(h, k_slots=16)
+    rs = encode_return_steps(enc)
+    n_returns = int((enc.events[: enc.n_events, 0] == 1).sum())
+    assert rs.n_steps == n_returns
+    assert rs.slot_tabs.shape == (n_returns, 16, 4)
+    # Every target slot is active in its own snapshot.
+    for i in range(rs.n_steps):
+        assert rs.slot_active[i, rs.targets[i]]
+    # Padding keeps verdicts identical.
+    padded = rs.padded_to(rs.n_steps + 13)
+    assert check_encoded2(enc, CASRegister())["valid"] == \
+        check_steps_valid(padded)
+
+
+def check_steps_valid(rs):
+    from jepsen_etcd_demo_tpu.ops.wgl2 import check_steps
+    return check_steps(rs, CASRegister())["valid"]
+
+
+@pytest.mark.parametrize("name,hist,expected", GOLDEN)
+def test_golden_histories_v2(name, hist, expected):
+    enc = encode_register_history(hist, k_slots=8)
+    out = check_encoded2(enc, CASRegister(), f_cap=128)
+    assert out["valid"] == expected, name
+
+
+def test_v2_matches_oracle_fuzzed():
+    rng = random.Random(0xF2)
+    model = CASRegister()
+    disagreements = 0
+    n_invalid = 0
+    for i in range(60):
+        h = gen_register_history(rng, n_ops=rng.randrange(5, 60),
+                                 n_procs=rng.randrange(2, 7))
+        if i % 2 == 0:
+            h = mutate_history(rng, h)
+        enc = encode_register_history(h, k_slots=32)
+        expected = check_events_oracle(enc, model).valid
+        n_invalid += (not expected)
+        got = check_encoded2(enc, model, f_cap=256)
+        if got["valid"] == "unknown":
+            # Sound overflow: must carry the overflow flag, and must resolve
+            # exactly at higher capacity (the production checker escalates).
+            assert got["overflow"]
+            got = check_encoded2(enc, model, f_cap=2048)
+        if got["valid"] != expected:
+            disagreements += 1
+    assert disagreements == 0
+    assert n_invalid >= 5
+
+
+def test_v2_matches_v1():
+    rng = random.Random(0xF3)
+    model = CASRegister()
+    for i in range(20):
+        h = gen_register_history(rng, n_ops=40, n_procs=5)
+        if i % 3 == 0:
+            h = mutate_history(rng, h)
+        enc = encode_register_history(h, k_slots=32)
+        assert check_encoded2(enc, model)["valid"] == \
+            check_encoded(enc, model)["valid"]
+
+
+def test_v2_matches_brute_force_tiny():
+    rng = random.Random(0xF4)
+    model = CASRegister()
+    for i in range(40):
+        h = gen_register_history(rng, n_ops=rng.randrange(3, 10),
+                                 n_procs=rng.randrange(2, 4))
+        if i % 2 == 0:
+            h = mutate_history(rng, h)
+        enc = encode_register_history(h, k_slots=16)
+        bf = brute_force_check(enc, model)
+        assert bf is not None
+        assert check_encoded2(enc, model, f_cap=128)["valid"] == bf
+
+
+def test_v2_batched_matches_single():
+    rng = random.Random(0xF5)
+    model = CASRegister()
+    steps, singles = [], []
+    for i in range(9):
+        h = gen_register_history(rng, n_ops=30, n_procs=4)
+        if i % 2 == 0:
+            h = mutate_history(rng, h)
+        enc = encode_register_history(h, k_slots=32)
+        singles.append(check_encoded2(enc, model, f_cap=128)["valid"])
+        steps.append(encode_return_steps(enc))
+    r_cap = max(s.slot_tabs.shape[0] for s in steps)
+    padded = [s.padded_to(r_cap) for s in steps]
+    import jax.numpy as jnp
+    tabs = jnp.asarray(np.stack([s.slot_tabs for s in padded]))
+    act = jnp.asarray(np.stack([s.slot_active for s in padded]))
+    tgt = jnp.asarray(np.stack([s.targets for s in padded]))
+    check = cached_batch_checker2(model, WGLConfig(32, 128))
+    out = check(tabs, act, tgt)
+    from jepsen_etcd_demo_tpu.ops.wgl import verdict
+    got = [verdict({k: np.asarray(v)[i] for k, v in out.items()})
+           for i in range(9)]
+    assert got == singles
+
+
+def test_large_values_do_not_corrupt_packed_keys():
+    """Regression: any int32 value is legal in a history (encode.py); the
+    packed-dedup path must not assume a value range. write(10); read->10 was
+    reported invalid when state bits were hardcoded to 3."""
+    from jepsen_etcd_demo_tpu.checkers import Linearizable
+    from jepsen_etcd_demo_tpu.ops.op import Op
+    for v in (10, 1000, 2**20, 2**30):
+        h = [Op(type="invoke", f="write", value=v, process=0),
+             Op(type="ok", f="write", value=v, process=0),
+             Op(type="invoke", f="read", value=None, process=1),
+             Op(type="ok", f="read", value=v, process=1)]
+        assert Linearizable(backend="jax").check({}, h)["valid"] is True
+        bad = list(h)
+        bad[3] = Op(type="ok", f="read", value=v - 1, process=1)
+        assert Linearizable(backend="jax").check({}, bad)["valid"] is False
+
+
+def test_batched_independent_ragged_k_slots():
+    """Regression: per-key k_slots escalation must not crash the batched
+    stack (one key with >k_slots pending infos, one without)."""
+    from jepsen_etcd_demo_tpu.checkers import (Compose, IndependentChecker,
+                                               Linearizable)
+    from jepsen_etcd_demo_tpu.ops.op import Op
+    h = []
+    # key 0: 30 concurrent :info writes (each pends forever -> needs k>24)
+    for p in range(30):
+        h.append(Op(type="invoke", f="write", value=(0, p % 5), process=p))
+    for p in range(30):
+        h.append(Op(type="info", f="write", value=(0, p % 5), process=p,
+                    error="timeout"))
+    # key 1: trivial little history
+    h.append(Op(type="invoke", f="write", value=(1, 3), process=100))
+    h.append(Op(type="ok", f="write", value=(1, 3), process=100))
+    h.append(Op(type="invoke", f="read", value=(1, None), process=101))
+    h.append(Op(type="ok", f="read", value=(1, 3), process=101))
+    checker = IndependentChecker(Linearizable(backend="jax"))
+    res = checker.check({}, h)
+    assert res["valid"] is True
+    assert res["key_count"] == 2
+
+
+def test_oracle_backend_result_schema_matches_jax():
+    """Regression: every backend exposes dead_step (return-step index)."""
+    from jepsen_etcd_demo_tpu.checkers import Linearizable
+    from jepsen_etcd_demo_tpu.ops.op import Op
+    h = [Op(type="invoke", f="write", value=1, process=0),
+         Op(type="ok", f="write", value=1, process=0),
+         Op(type="invoke", f="read", value=None, process=1),
+         Op(type="ok", f="read", value=4, process=1)]
+    for backend in ("jax", "oracle"):
+        res = Linearizable(backend=backend).check({}, h)
+        assert res["valid"] is False
+        assert res["dead_step"] == 1, backend  # dies at the 2nd return
+
+
+def test_large_initial_state_disables_packing_soundly():
+    """Regression (reproduced soundness bug): a model initial state far above
+    every history value must not wrap into the mask bits of the packed key.
+    CASRegister(initial=1000) + write(5)/read->8 is NOT linearizable."""
+    from jepsen_etcd_demo_tpu.checkers import Linearizable
+    from jepsen_etcd_demo_tpu.ops.op import Op
+    h = [Op(type="invoke", f="write", value=5, process=0),
+         Op(type="invoke", f="read", value=None, process=1),
+         Op(type="ok", f="read", value=8, process=1),
+         Op(type="ok", f="write", value=5, process=0)]
+    for backend in ("jax", "oracle"):
+        res = Linearizable(CASRegister(initial=1000),
+                           backend=backend).check({}, h)
+        assert res["valid"] is False, backend
+    # and the initial state is actually readable
+    ok = [Op(type="invoke", f="read", value=None, process=1),
+          Op(type="ok", f="read", value=1000, process=1)]
+    assert Linearizable(CASRegister(initial=1000),
+                        backend="jax").check({}, ok)["valid"] is True
+
+
+def test_negative_values_rejected_at_encode():
+    """Regression: -1 is the NIL sentinel; negative payloads must raise
+    EncodeError instead of silently corrupting verdicts."""
+    from jepsen_etcd_demo_tpu.checkers import Linearizable
+    from jepsen_etcd_demo_tpu.ops.encode import EncodeError
+    from jepsen_etcd_demo_tpu.ops.op import Op
+    h = [Op(type="invoke", f="write", value=-5, process=0),
+         Op(type="ok", f="write", value=-5, process=0)]
+    with pytest.raises(EncodeError):
+        Linearizable(backend="jax").check({}, h)
