@@ -1,19 +1,23 @@
 """Benchmark: WGL linearizability checking throughput, TPU kernel vs CPU oracle.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
 
 The reference publishes no benchmark numbers (BASELINE.md): its checker is
-knossos's JVM search, which this build replaces with the JAX/XLA kernel. The
+knossos's JVM search, which this build replaces with the JAX/XLA kernels. The
 baseline stand-in is therefore this repo's pure-Python oracle WGL checker
 (checkers/oracle.py — same algorithm, same event encoding, host CPU), playing
 the role of the JVM hot loop. vs_baseline = kernel events/sec ÷ oracle
 events/sec on the same histories.
 
-Workload: a corpus of fuzzed single-register histories (valid by
-construction — the checker must run to completion, the worst case for the
-search) checked by the vmapped batch kernel on one chip, plus one long
-history through the single-history kernel.
+Workloads:
+  * corpus — 64 fuzzed 150-op histories (valid by construction: the checker
+    must run to completion, the worst case for the search), checked in ONE
+    batched launch of the dense lattice kernel (ops/wgl3.py) on one chip.
+    This is BASELINE.json configs[2] (independent keys as one vmap).
+  * long history — 1k-op and 10k-op single-register histories through the
+    single-history dense kernel (BASELINE.json configs[3]; north star:
+    10k ops < 60 s where knossos-CPU DNFs).
 """
 
 from __future__ import annotations
@@ -24,77 +28,102 @@ import time
 
 import numpy as np
 
-
 N_OPS = 150           # ops per history (tutorial run scale, BASELINE configs[0])
 N_PROCS = 10          # concurrency, matching the reference's 10 threads/key
-K_SLOTS = 24          # pending-op slot capacity (<=28 enables packed dedup)
-F_CAP = 2048          # frontier capacity (dense 10-proc frontiers reach ~2k)
 CORPUS = 64           # histories per batched launch
 REPEATS = 3
+LONG_OPS = (1_000, 10_000)
+
+
+def _fetch(out):
+    # NB np.asarray (a real device fetch): block_until_ready does not
+    # reliably block under the tunneled TPU backend.
+    return {k: np.asarray(v) for k, v in out.items()}
 
 
 def build_corpus():
-    from jepsen_etcd_demo_tpu.ops.encode import (encode_register_history,
-                                                 encode_return_steps)
+    from jepsen_etcd_demo_tpu.ops.encode import encode_register_history
     from jepsen_etcd_demo_tpu.utils.fuzz import gen_register_history
 
     rng = random.Random(0xBE7C)
     # p_info low: every :info op stays pending forever and occupies a slot
     # for the rest of the history (knossos semantics), so long histories
     # need them rare (or a wide slot table).
-    encs = [encode_register_history(
+    return [encode_register_history(
         gen_register_history(rng, n_ops=N_OPS, n_procs=N_PROCS,
-                             p_info=0.002), k_slots=K_SLOTS)
+                             p_info=0.002), k_slots=32)
         for _ in range(CORPUS)]
-    steps = [encode_return_steps(e) for e in encs]
-    r_cap = max(s.slot_tabs.shape[0] for s in steps)
-    padded = [s.padded_to(r_cap) for s in steps]
-    tabs = np.stack([p.slot_tabs for p in padded])
-    act = np.stack([p.slot_active for p in padded])
-    tgt = np.stack([p.targets for p in padded])
-    return encs, (tabs, act, tgt)
 
 
-def main():
-    import jax
-    import jax.numpy as jnp
-
+def bench_corpus(model):
     from jepsen_etcd_demo_tpu.checkers.oracle import check_events_oracle
-    from jepsen_etcd_demo_tpu.models import CASRegister
-    from jepsen_etcd_demo_tpu.ops import wgl
+    from jepsen_etcd_demo_tpu.ops import wgl3
 
-    from jepsen_etcd_demo_tpu.ops import wgl2
-
-    model = CASRegister()
-    encs, (tabs, act, tgt) = build_corpus()
+    encs = build_corpus()
     total_events = int(sum(e.n_events for e in encs))
-
-    # --- TPU (or whatever jax.devices() gives) batched v2 kernel ---
-    max_value = max(e.max_value for e in encs)
-    cfg = wgl2.make_config(model, K_SLOTS, F_CAP, max_value)
-    check = wgl2.make_batch_checker2(model, cfg)
-    args = tuple(jax.device_put(jnp.asarray(a)) for a in (tabs, act, tgt))
-    out = check(*args)  # compile + warmup
-    survived = np.asarray(out["survived"])
-    assert survived.all(), "bench corpus must be valid by construction"
+    cfg, arrays, _steps = wgl3.batch_arrays3(encs, model)
+    check = wgl3.cached_batch_checker3(model, cfg)
+    out = _fetch(check(*arrays))  # compile + warmup
+    assert out["survived"].all(), "bench corpus must be valid by construction"
     best = float("inf")
     for _ in range(REPEATS):
         t0 = time.perf_counter()
-        out = check(*args)
-        # NB np.asarray (a real device fetch): block_until_ready does not
-        # reliably block under the tunneled TPU backend.
-        np.asarray(out["survived"])
+        out = _fetch(check(*arrays))
         best = min(best, time.perf_counter() - t0)
-    kernel_eps = total_events / best
 
-    # --- CPU oracle baseline (the JVM-checker stand-in) ---
     t0 = time.perf_counter()
     for enc in encs:
         res = check_events_oracle(enc, model)
         assert res.valid
     oracle_s = time.perf_counter() - t0
-    oracle_eps = total_events / oracle_s
+    return {
+        "events": total_events,
+        "kernel_s": best,
+        "oracle_s": oracle_s,
+        "k_slots": cfg.k_slots,
+        "table_cells": cfg.n_states * cfg.n_masks,
+        "histories_per_sec": CORPUS / best,
+    }
 
+
+def bench_long(model, n_ops: int, oracle_too: bool):
+    """One long single-register history through the single dense kernel."""
+    from jepsen_etcd_demo_tpu.checkers.oracle import check_events_oracle
+    from jepsen_etcd_demo_tpu.ops import wgl3
+    from jepsen_etcd_demo_tpu.ops.encode import encode_register_history
+    from jepsen_etcd_demo_tpu.utils.fuzz import gen_register_history
+
+    rng = random.Random(0x10C0 + n_ops)
+    h = gen_register_history(rng, n_ops=n_ops, n_procs=N_PROCS, p_info=0.0005)
+    enc = encode_register_history(h, k_slots=64)
+
+    t0 = time.perf_counter()
+    out = wgl3.check_encoded3(enc, model)   # includes compile (cold)
+    cold_s = time.perf_counter() - t0
+    assert out["valid"] is True
+    t0 = time.perf_counter()
+    out = wgl3.check_encoded3(enc, model)
+    warm_s = time.perf_counter() - t0
+    d = {"ops": n_ops, "kernel_s": warm_s, "kernel_cold_s": cold_s}
+    if oracle_too:
+        t0 = time.perf_counter()
+        res = check_events_oracle(enc, model)
+        assert res.valid
+        d["oracle_s"] = time.perf_counter() - t0
+    return d
+
+
+def main():
+    import jax
+
+    from jepsen_etcd_demo_tpu.models import CASRegister
+
+    model = CASRegister()
+    corpus = bench_corpus(model)
+    longs = [bench_long(model, n, oracle_too=(n <= 1000)) for n in LONG_OPS]
+
+    kernel_eps = corpus["events"] / corpus["kernel_s"]
+    oracle_eps = corpus["events"] / corpus["oracle_s"]
     print(json.dumps({
         "metric": "wgl_check_throughput",
         "value": round(kernel_eps, 1),
@@ -104,9 +133,15 @@ def main():
             "device": str(jax.devices()[0]),
             "corpus": CORPUS,
             "ops_per_history": N_OPS,
-            "batch_wall_s": round(best, 4),
-            "oracle_wall_s": round(oracle_s, 4),
-            "histories_per_sec": round(CORPUS / best, 2),
+            "batch_wall_s": round(corpus["kernel_s"], 4),
+            "oracle_wall_s": round(corpus["oracle_s"], 4),
+            "histories_per_sec": round(corpus["histories_per_sec"], 2),
+            "kernel": "wgl3-dense",
+            "k_slots": corpus["k_slots"],
+            "table_cells": corpus["table_cells"],
+            "long_history": [
+                {k: (round(v, 4) if isinstance(v, float) else v)
+                 for k, v in d.items()} for d in longs],
         },
     }))
 

@@ -115,16 +115,42 @@ class IndependentChecker(Checker):
 def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]]
                           ) -> dict[Any, dict]:
     """Encode every key's history into the return-major form, pad to one
-    step count, run one vmapped kernel launch over the key batch."""
-    from ..ops import wgl, wgl2
+    step count, run one vmapped kernel launch over the key batch.
+
+    Prefers the dense lattice kernel (wgl3) — exact, no overflow — whenever
+    the shared config table is feasible; falls back to the sort kernel."""
+    from ..ops import wgl, wgl2, wgl3
     from ..ops.encode import (encode_return_steps, encode_register_history,
-                              ReturnSteps)
+                              reslot_events, ReturnSteps)
     import jax.numpy as jnp
 
     event_encs = {k: lin.encode(h) for k, h in keyed.items()}
-    # One kernel serves the whole batch, so every key must share k_slots:
-    # re-encode any key whose per-key escalation picked a smaller table
-    # (ragged [R,K,4] tensors cannot stack).
+    max_value = max(e.max_value for e in event_encs.values())
+
+    # Dense path: one table geometry serves the whole batch — mask width =
+    # the largest key's real concurrency.
+    tight = max(wgl3.tight_k_slots(e) for e in event_encs.values())
+    cfg3 = wgl3.dense_config(lin.model, tight, max_value)
+    if cfg3 is not None:
+        keys = list(event_encs)
+        batch = wgl3.check_batch_encoded3(
+            [event_encs[k] for k in keys], lin.model)
+        return {
+            k: {
+                "valid": one["valid"],
+                "backend": "jax-dense-batched",
+                "op_count": one["op_count"],
+                "dead_step": one["dead_step"],
+                "max_frontier": one["max_frontier"],
+                "overflow": False,
+                "f_cap": one["table_cells"],
+            }
+            for k, one in zip(keys, batch)
+        }
+
+    # Sort-kernel path: every key must share k_slots (ragged [R,K,4]
+    # tensors cannot stack); re-encode any key whose per-key escalation
+    # picked a smaller table.
     k_slots = max(e.k_slots for e in event_encs.values())
     encs: dict[Any, ReturnSteps] = {}
     for k, e in event_encs.items():
@@ -137,7 +163,6 @@ def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]]
     tabs = jnp.asarray(np.stack([p.slot_tabs for p in padded]))
     act = jnp.asarray(np.stack([p.slot_active for p in padded]))
     tgt = jnp.asarray(np.stack([p.targets for p in padded]))
-    max_value = max(e.max_value for e in encs.values())
     check = wgl2.cached_batch_checker2(
         lin.model, wgl2.make_config(lin.model, k_slots, lin.f_cap,
                                     max_value))
@@ -145,11 +170,15 @@ def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]]
     results = {}
     for i, k in enumerate(keys):
         one = {name: out[name][i].item() for name in out}
+        # Keys mirror the single-history jax path's normalized schema
+        # (linearizable.py) so consumers see one shape whatever path ran.
         results[k] = {
             "valid": wgl.verdict(one),
             "backend": "jax-batched",
             "op_count": encs[k].n_ops,
             "dead_step": one["dead_step"],
             "max_frontier": one["max_frontier"],
+            "overflow": one["overflow"],
+            "f_cap": lin.f_cap,
         }
     return results

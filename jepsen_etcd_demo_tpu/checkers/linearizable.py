@@ -68,8 +68,23 @@ class Linearizable(Checker):
         return self._check_jax(enc)
 
     def _check_jax(self, enc: EncodedHistory) -> dict[str, Any]:
-        from ..ops import wgl, wgl2
+        from ..ops import wgl, wgl2, wgl3
         from ..ops.encode import encode_return_steps
+
+        # Preferred path: the dense subset-lattice kernel (wgl3) — viable
+        # whenever the whole (state × mask) config space fits a dense table,
+        # i.e. for any realistic concurrency. Exact by construction: no
+        # frontier capacity, no overflow, no escalation ladder.
+        cfg3 = wgl3.dense_config(self.model, wgl3.tight_k_slots(enc),
+                                 enc.max_value)
+        if cfg3 is not None:
+            out = wgl3.check_encoded3(enc, self.model, cfg3)
+            return {"valid": out["valid"], "backend": "jax-dense",
+                    "op_count": enc.n_ops,
+                    "dead_step": int(out["dead_step"]),
+                    "max_frontier": int(out["max_frontier"]),
+                    "overflow": False,
+                    "f_cap": cfg3.n_states * cfg3.n_masks}
 
         rs = encode_return_steps(enc)
         f_cap = self.f_cap

@@ -29,8 +29,7 @@ import asyncio
 import random
 from typing import Any, Optional
 
-from ..ops.op import Op
-from .base import Client, NotFound, Timeout
+from .base import NotFound, Timeout
 
 
 class FakeKVStore:
@@ -140,19 +139,3 @@ class FakeKVStore:
         raise Timeout("swap retry budget exhausted")
 
 
-class FakeKVClient(Client):
-    """Value-level client over FakeKVStore; register/set clients layer the
-    op-semantics (error mapping) on top of this, exactly like the reference
-    clients layer over verschlimmbesserung."""
-
-    def __init__(self, store: FakeKVStore):
-        self.store = store
-        self.node: Optional[str] = None
-
-    async def open(self, test: dict, node: str) -> "FakeKVClient":
-        c = FakeKVClient(self.store)
-        c.node = node
-        return c
-
-    async def invoke(self, test: dict, op: Op) -> Op:  # pragma: no cover
-        raise NotImplementedError("use RegisterClient/SetClient over a store")

@@ -29,7 +29,7 @@ wall clock, so generators are unit-testable with a fake clock (SURVEY.md §4).
 from .core import (  # noqa: F401
     Gen, GenContext, Pending, NEMESIS,
     fn_gen, lift, Mix, Limit, Once, TimeLimit, Stagger, Sleep, Log, Seq,
-    Cycle, Repeat, OnNemesis, OnClients, Phases, Synchronize,
+    Cycle, Repeat, OnNemesis, OnClients, Phases,
     mix, limit, once, time_limit, stagger, sleep, log, seq, cycle, repeat,
     nemesis_gen, clients_gen, phases,
 )

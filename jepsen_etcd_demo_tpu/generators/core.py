@@ -316,18 +316,6 @@ class OnClients(Gen):
         return self.gen.next_for(ctx)
 
 
-class Synchronize(Gen):
-    """Marker: the runner must wait for all in-flight ops to complete before
-    asking the wrapped generator (jepsen's synchronize / phase barrier)."""
-
-    def __init__(self, gen):
-        self.gen = lift(gen)
-        self.barrier_passed = False
-
-    def next_for(self, ctx: GenContext) -> NextResult:
-        return self.gen.next_for(ctx)
-
-
 class Phases(Gen):
     """Sequential phases with a full barrier between them — gen/phases
     (reference src/jepsen/etcdemo.clj:168-174). The runner detects the

@@ -32,19 +32,26 @@ class Model(abc.ABC):
     packable_states: bool = False
     state_offset: int = 0
 
-    def pack_bits(self, max_value: int) -> int:
-        """Bits needed to pack any reachable state, given the largest value
-        encoded in the history; 0 = not packable.
+    def state_bound(self, max_value: int) -> int:
+        """Largest shifted state index reachable, given the largest value
+        encoded in the history (shift = state_offset, so the result is the
+        top ROW index of a dense state table / top packed-key value).
 
         The reachable range is {init_state()} ∪ history values — the initial
         state counts even when no history value comes near it (a large
         `initial` that silently wrapped into mask bits was a reproduced
         soundness bug). Negative values never reach here: the encoder
-        rejects them (NIL=-1 is a reserved sentinel, encode.py)."""
+        rejects them (NIL=-1 is a reserved sentinel, encode.py). Single
+        source of truth for BOTH the packed sort-key dedup (wgl2) and the
+        dense lattice table (wgl3)."""
+        return max(int(max_value), int(self.init_state())) + self.state_offset
+
+    def pack_bits(self, max_value: int) -> int:
+        """Bits needed to pack any reachable state, given the largest value
+        encoded in the history; 0 = not packable."""
         if not self.packable_states:
             return 0
-        top = max(int(max_value), int(self.init_state())) + self.state_offset
-        return max(1, top.bit_length())
+        return max(1, self.state_bound(max_value).bit_length())
 
     def cache_key(self) -> tuple:
         """Hashable identity for jit-compilation caches. Two models with equal

@@ -228,6 +228,35 @@ def encode_register_history(history: Sequence[Op], k_slots: int = 32
     return encode_events(pair_history(history), k_slots=k_slots)
 
 
+def reslot_events(enc: EncodedHistory, k_slots: int) -> EncodedHistory:
+    """Remap slot ids into a smaller slot table (k_slots >= max_pending).
+
+    Uses the same greedy lowest-free assignment as encode_events over the
+    same event order, so the result is exactly what encoding with the
+    smaller k_slots would have produced. Lets the dense lattice kernel
+    (wgl3) shrink its 2^K mask axis to the history's REAL concurrency after
+    a conservative first encoding."""
+    if k_slots < enc.max_pending:
+        raise EncodeError(
+            f"cannot reslot to {k_slots} slots: history has "
+            f"{enc.max_pending} simultaneously pending ops")
+    ev = enc.events[: enc.n_events].copy()
+    free = list(range(k_slots - 1, -1, -1))
+    mapping: dict[int, int] = {}
+    for row in ev:
+        if row[0] == EV_INVOKE:
+            new = free.pop()
+            mapping[int(row[1])] = new
+            row[1] = new
+        elif row[0] == EV_RETURN:
+            new = mapping.pop(int(row[1]))
+            row[1] = new
+            free.append(new)
+    return EncodedHistory(events=ev, n_events=enc.n_events, n_ops=enc.n_ops,
+                          k_slots=k_slots, max_pending=enc.max_pending,
+                          max_value=enc.max_value)
+
+
 @dataclass
 class ReturnSteps:
     """Return-event-major encoding: one row per EV_RETURN, with a full
@@ -268,28 +297,46 @@ class ReturnSteps:
 
 
 def encode_return_steps(enc: EncodedHistory) -> ReturnSteps:
-    """Derive the return-major encoding from the event encoding."""
+    """Derive the return-major encoding from the event encoding.
+
+    Vectorized (no per-return [K,4] snapshot loop): for each return event at
+    position p, slot k's table row is the fields of the last EV_INVOKE of
+    slot k before p, and slot k is active iff its invokes before p outnumber
+    its returns strictly before p (the returning op itself counts active).
+    """
     k = enc.k_slots
-    slot_tab = np.zeros((k, 4), np.int32)
-    slot_active = np.zeros((k,), bool)
-    tabs, actives, targets = [], [], []
-    for i in range(enc.n_events):
-        kind, slot, f, a1, a2, rv = (int(x) for x in enc.events[i])
-        if kind == EV_INVOKE:
-            slot_tab[slot] = (f, a1, a2, rv)
-            slot_active[slot] = True
-        elif kind == EV_RETURN:
-            tabs.append(slot_tab.copy())
-            actives.append(slot_active.copy())
-            targets.append(slot)
-            slot_active[slot] = False
-    r = len(targets)
+    n = enc.n_events
+    ev = np.asarray(enc.events[:n])
+    if n == 0 or not (ev[:, 0] == EV_RETURN).any():
+        return ReturnSteps(
+            slot_tabs=np.zeros((0, k, 4), np.int32),
+            slot_active=np.zeros((0, k), bool),
+            targets=np.zeros((0,), np.int32),
+            n_steps=0, n_ops=enc.n_ops, k_slots=k,
+            max_pending=enc.max_pending, max_value=enc.max_value)
+    kinds, slots = ev[:, 0], ev[:, 1]
+    slot_ids = np.arange(k)
+    inv_onehot = (kinds == EV_INVOKE)[:, None] & (slots[:, None] == slot_ids)
+    ret_onehot = (kinds == EV_RETURN)[:, None] & (slots[:, None] == slot_ids)
+    inv_cum = np.cumsum(inv_onehot, axis=0)   # invokes in events[0..p]
+    ret_cum = np.cumsum(ret_onehot, axis=0)   # returns in events[0..p]
+    # Last invoke position of each slot at-or-before each event position.
+    last_inv = np.maximum.accumulate(
+        np.where(inv_onehot, np.arange(n)[:, None], -1), axis=0)
+
+    ret_pos = np.nonzero(kinds == EV_RETURN)[0]
+    # Event p is a return, so "invokes before p" == inv_cum[p]; "returns
+    # strictly before p" excludes p's own return.
+    active = inv_cum[ret_pos] > (ret_cum[ret_pos] - ret_onehot[ret_pos])
+    last = last_inv[ret_pos]                   # [R, K]
+    tabs = np.where(last[:, :, None] >= 0,
+                    ev[np.maximum(last, 0)][:, :, 2:6], 0).astype(np.int32)
     return ReturnSteps(
-        slot_tabs=(np.stack(tabs) if r else np.zeros((0, k, 4), np.int32)),
-        slot_active=(np.stack(actives) if r else np.zeros((0, k), bool)),
-        targets=np.asarray(targets, np.int32),
-        n_steps=r, n_ops=enc.n_ops, k_slots=k, max_pending=enc.max_pending,
-        max_value=enc.max_value)
+        slot_tabs=tabs,
+        slot_active=active,
+        targets=slots[ret_pos].astype(np.int32),
+        n_steps=len(ret_pos), n_ops=enc.n_ops, k_slots=k,
+        max_pending=enc.max_pending, max_value=enc.max_value)
 
 
 def encode_register_history_steps(history: Sequence[Op], k_slots: int = 32

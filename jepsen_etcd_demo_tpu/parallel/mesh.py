@@ -19,8 +19,21 @@ def make_mesh(n_devices: Optional[int] = None,
     """Mesh over the first n devices. 1-axis by default ("batch"); pass
     axes=("batch", "frontier") with a shape to split ICI between the corpus
     axis and the frontier axis."""
-    devs = jax.devices()[: (n_devices or len(jax.devices()))]
+    all_devs = jax.devices()
+    want = n_devices or len(all_devs)
+    if want > len(all_devs):
+        raise ValueError(
+            f"make_mesh: need {want} devices, have {len(all_devs)} "
+            f"({all_devs[0].platform}). Hint: force a virtual CPU mesh "
+            f"before any backend init — JAX_PLATFORMS=cpu plus "
+            f"jax.config.update('jax_num_cpu_devices', {want}) (see "
+            f"tests/conftest.py / __graft_entry__.dryrun_multichip).")
+    devs = all_devs[:want]
     if shape is None:
         shape = [len(devs)] + [1] * (len(axes) - 1)
+    if int(np.prod(shape)) != len(devs):
+        raise ValueError(
+            f"make_mesh: shape {tuple(shape)} needs {int(np.prod(shape))} "
+            f"devices but {len(devs)} were selected")
     arr = np.array(devs).reshape(tuple(shape))
     return Mesh(arr, tuple(axes))

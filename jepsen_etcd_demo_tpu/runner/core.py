@@ -193,10 +193,21 @@ async def run_test(test: dict) -> dict:
     from ..store import Store
 
     store = None
+    log_handler = None
     if test.get("store_root") is not None:
         store = Store(test["store_root"]).new_run(test.get("name", "test"))
-        _attach_file_log(store.path)
+        log_handler = _attach_file_log(store.path)
+    try:
+        return await _run_test_inner(test, store)
+    finally:
+        # Detach per-run file handler so later runs in the same process
+        # (--test-count > 1) don't keep appending to this run's jepsen.log.
+        if log_handler is not None:
+            logging.getLogger().removeHandler(log_handler)
+            log_handler.close()
 
+
+async def _run_test_inner(test: dict, store) -> dict:
     log.info("=== %s: setting up %d nodes", test.get("name"),
              len(test["nodes"]))
     t0 = time.monotonic()
@@ -244,11 +255,12 @@ async def run_test(test: dict) -> dict:
     return result
 
 
-def _attach_file_log(store_path):
+def _attach_file_log(store_path) -> logging.Handler:
     """Tee the framework log into the run dir (reference: logback writes
-    jepsen.log into the store [dep], SURVEY.md §5.5)."""
+    jepsen.log into the store [dep], SURVEY.md §5.5). Caller must detach."""
     root = logging.getLogger()
     handler = logging.FileHandler(store_path / "jepsen.log")
     handler.setFormatter(logging.Formatter(
         "%(asctime)s %(levelname)s %(name)s: %(message)s"))
     root.addHandler(handler)
+    return handler

@@ -1,0 +1,45 @@
+"""Force JAX onto a virtual n-device CPU platform (tests + dryruns).
+
+Single source of the forcing recipe used by tests/conftest.py and
+__graft_entry__.dryrun_multichip (SURVEY.md §4: mesh tests run on simulated
+devices). Must run BEFORE any JAX backend initialization — the environment
+may pre-import jax with a TPU backend via sitecustomize, so setting
+JAX_PLATFORMS in os.environ alone can be too late; jax.config.update works
+as long as no backend has been initialized yet (i.e. before the first
+jax.devices() call).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_virtual_cpu(n_devices: int) -> None:
+    """Best-effort: point JAX at a virtual CPU platform with n devices.
+
+    Raises RuntimeError (with the observed device count) when the forcing
+    didn't take — a backend was already initialized, or a conflicting
+    xla_force_host_platform_device_count was inherited from the
+    environment.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except Exception:
+        pass  # older jax: XLA_FLAGS above covers it
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"force_virtual_cpu({n_devices}): only {len(jax.devices())} "
+            f"device(s) visible. Either a JAX backend was initialized "
+            f"before this call (use a fresh process), or the environment "
+            f"carried a conflicting XLA_FLAGS="
+            f"{os.environ.get('XLA_FLAGS')!r}")

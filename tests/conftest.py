@@ -1,28 +1,13 @@
 """Test config: force JAX onto a virtual 8-device CPU platform.
 
 (SURVEY.md §4: CPU-backend jit tests + 8 simulated devices for mesh tests.)
-
-The environment may pre-import jax with a TPU backend via sitecustomize, so
-setting JAX_PLATFORMS in os.environ here can be too late — also use
-jax.config.update, which works as long as no backend has been initialized
-yet (i.e. before the first jax.devices() call).
+The forcing recipe lives in jepsen_etcd_demo_tpu.utils.platform (shared with
+__graft_entry__.dryrun_multichip).
 """
 
-import os
+from jepsen_etcd_demo_tpu.utils.platform import force_virtual_cpu
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-try:
-    jax.config.update("jax_num_cpu_devices", 8)
-except Exception:
-    pass  # older jax: XLA_FLAGS above covers it
+force_virtual_cpu(8)
 
 import random  # noqa: E402
 

@@ -126,13 +126,14 @@ class TestIndependent:
         assert res["valid"] is True
         for k in ("0", "1", "2"):
             assert res["results"][k]["probe"]["probed"]
-            assert res["results"][k]["linear"]["backend"] == "jax-batched"
+            assert res["results"][k]["linear"]["backend"] \
+                == "jax-dense-batched"
         assert len(calls) == 3
 
     def test_single_key_unbatched(self, rng):
         h = _keyed("only", gen_register_history(rng, n_ops=10))
         res = IndependentChecker(Linearizable(backend="jax")).check({}, h)
-        assert res["results"]["only"]["backend"] == "jax"
+        assert res["results"]["only"]["backend"] == "jax-dense"
 
 
 class TestSetChecker:
