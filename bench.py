@@ -23,14 +23,17 @@ Workloads:
 from __future__ import annotations
 
 import json
+import os
 import random
+import sys
 import time
 
 import numpy as np
 
 N_OPS = 150           # ops per history (tutorial run scale, BASELINE configs[0])
 N_PROCS = 10          # concurrency, matching the reference's 10 threads/key
-CORPUS = 64           # histories per batched launch
+CORPUS = 256          # histories per batched launch (corpus-replay scale,
+#                       BASELINE configs[4] reads 1024 stored histories)
 REPEATS = 3
 LONG_OPS = (1_000, 10_000)
 
@@ -119,7 +122,19 @@ def main():
     from jepsen_etcd_demo_tpu.models import CASRegister
 
     model = CASRegister()
-    corpus = bench_corpus(model)
+    # SURVEY.md §5.1: jax.profiler traces for the checker kernel itself.
+    # Opt-in (BENCH_PROFILE=<dir> or --profile <dir>) so the driver's plain
+    # `python bench.py` stays fast; view with tensorboard/xprof.
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if "--profile" in sys.argv:
+        profile_dir = sys.argv[sys.argv.index("--profile") + 1]
+    if profile_dir:
+        with jax.profiler.trace(profile_dir):
+            corpus = bench_corpus(model)
+        print(f"# profiler trace written to {profile_dir}",
+              file=sys.stderr)
+    else:
+        corpus = bench_corpus(model)
     longs = [bench_long(model, n, oracle_too=(n <= 1000)) for n in LONG_OPS]
 
     kernel_eps = corpus["events"] / corpus["kernel_s"]

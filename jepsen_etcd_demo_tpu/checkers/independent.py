@@ -98,8 +98,12 @@ class IndependentChecker(Checker):
         opts["key"] = key  # sub-checkers emit per-key artifacts (timeline)
 
         def pick(name, checker):
+            # A batched result settles the key only when valid: invalid keys
+            # re-run the single-history path, which reconstructs and stores
+            # the counterexample witness (linear-<key>.json/svg); "unknown"
+            # re-runs for the escalation ladder.
             pre = batched.get(name, {}).get(key)
-            if pre is not None and pre["valid"] != "unknown":
+            if pre is not None and pre["valid"] is True:
                 return pre
             return checker.check(test, sub_history, opts)
 

@@ -64,11 +64,31 @@ class Linearizable(Checker):
             res["dead_step"] = _event_to_step(enc, res.pop("dead_event"))
             res["backend"] = "oracle"
             res["op_count"] = enc.n_ops
-            return res
-        return self._check_jax(enc)
+        else:
+            res = self._check_jax(enc)
+        if res.get("valid") is False:
+            self._explain(res, enc, history, opts)
+        return res
+
+    def _explain(self, res: dict, enc: EncodedHistory,
+                 history: Sequence[Op], opts: dict | None) -> None:
+        """Counterexample extraction (knossos linear.svg parity): write the
+        witness artifacts into the store and name the unexplainable op in
+        the result."""
+        from .witness import reconstruct_witness, write_witness
+
+        w = reconstruct_witness(enc, self.model, history)
+        if w is None:
+            return
+        res["failed_op"] = w["op"]
+        res["witness"] = w["explanation"]
+        store_dir = (opts or {}).get("store_dir")
+        if store_dir:
+            res["witness_file"] = write_witness(
+                store_dir, (opts or {}).get("key"), w)
 
     def _check_jax(self, enc: EncodedHistory) -> dict[str, Any]:
-        from ..ops import wgl, wgl2, wgl3
+        from ..ops import wgl2, wgl3
         from ..ops.encode import encode_return_steps
 
         # Preferred path: the dense subset-lattice kernel (wgl3) — viable
@@ -86,28 +106,22 @@ class Linearizable(Checker):
                     "overflow": False,
                     "f_cap": cfg3.n_states * cfg3.n_masks}
 
+        # General path (huge values / extreme pending counts): the sort
+        # kernel, run chunk-by-chunk with host-checkpointed frontier carry.
+        # Overflow escalates capacity and RESUMES from the last chunk
+        # boundary — exact native verdicts, no Python-oracle fallback
+        # (SURVEY.md §5.4/§5.7). Tighten the slot table first: a smaller
+        # mask width shrinks the sort and often re-enables packed dedup.
+        from ..ops.encode import reslot_events
+
+        tight = max(8, (enc.max_pending + 3) // 4 * 4)
+        if tight < enc.k_slots:
+            enc = reslot_events(enc, tight)
         rs = encode_return_steps(enc)
-        f_cap = self.f_cap
-        for attempt in range(3):
-            check = wgl2.cached_checker2(
-                self.model, wgl2.config_for(rs, self.model, f_cap))
-            out = {k: v.item() if hasattr(v, "item") else v
-                   for k, v in check(*wgl2.steps_arrays(rs)).items()}
-            valid = wgl.verdict(out)
-            if valid != "unknown":
-                break
-            f_cap *= 4  # overflow killed the frontier; retry bigger
-        if valid == "unknown":
-            # Exact fallback: the oracle has no capacity limit. Result keys
-            # are normalized to the jax schema (dead_step = return-step
-            # index) so consumers see one shape whatever the path.
-            res = check_events_oracle(enc, self.model).to_dict()
-            res["dead_step"] = _event_to_step(enc, res.pop("dead_event"))
-            res.update(backend="jax+oracle-fallback", op_count=enc.n_ops,
-                       overflow=False, f_cap=None)
-            return res
-        return {"valid": valid, "backend": "jax", "op_count": enc.n_ops,
+        out = wgl2.check_steps_resumable(rs, self.model, f_cap=self.f_cap)
+        return {"valid": out["valid"], "backend": "jax", "op_count": enc.n_ops,
                 "dead_step": out["dead_step"],
                 "max_frontier": out["max_frontier"],
-                "overflow": out["overflow"],
-                "f_cap": f_cap}
+                "overflow": False,
+                "f_cap": out["f_cap"],
+                "escalations": out["escalations"]}

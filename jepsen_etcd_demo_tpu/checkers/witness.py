@@ -1,0 +1,203 @@
+"""Counterexample extraction: explain WHY a history is not linearizable.
+
+Parity with knossos, which returns the unexplainable op and renders a
+`linear.svg` into the store dir when the linearizable checker fails
+(reference call site src/jepsen/etcdemo.clj:117 [dep]; SURVEY.md hard-part
+#3). The TPU kernels report only the fatal return step (masked tensors keep
+no lineage); this module reconstructs a human-readable witness HOST-SIDE by
+replaying the oracle search WITH parent tracking up to the death point:
+
+  * the failed operation (the return no reachable config had linearized),
+  * one maximal linearization of the prefix (the firing order of a config
+    that survived longest — concrete evidence the prefix IS linearizable),
+  * the final reachable configurations (state + still-pending ops).
+
+Artifacts: `linear.json` (machine-readable) and `linear.svg` (rendering),
+`linear-<key>.{json,svg}` under the independent wrapper — matching the
+timeline checker's per-key naming.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+from pathlib import Path
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..models.base import Model
+from ..ops.encode import (EncodedHistory, EV_INVOKE, EV_RETURN, F_READ,
+                          F_WRITE, F_CAS, NIL, Invocation, event_sources,
+                          pair_history)
+from ..ops.op import Op
+
+# Reconstruction is exponential-ish like the search itself; witnesses are
+# for humans, so cap the effort rather than DNF on adversarial histories.
+MAX_WITNESS_EVENTS = 200_000
+
+
+def describe_op(f: int, a1: int, a2: int, rv: int) -> str:
+    if f == F_READ:
+        return f"read -> {'nil' if rv == NIL else rv}"
+    if f == F_WRITE:
+        return f"write({a1})"
+    if f == F_CAS:
+        return f"cas({a1} -> {a2})"
+    return f"op({f}, {a1}, {a2}, {rv})"
+
+
+def _inv_info(inv: Optional[Invocation]) -> dict[str, Any]:
+    if inv is None:
+        return {}
+    return {"process": inv.process, "invoke_index": inv.invoke_index,
+            "complete_index": inv.complete_index}
+
+
+def reconstruct_witness(enc: EncodedHistory, model: Model,
+                        history: Sequence[Op] | None = None
+                        ) -> Optional[dict[str, Any]]:
+    """Replay the WGL search with lineage; returns the witness dict for an
+    invalid history, None when the history is actually linearizable (or the
+    effort cap was hit)."""
+    events = np.asarray(enc.events)
+    sources: list[Optional[Invocation]] = []
+    if history is not None:
+        sources = list(event_sources(pair_history(history)))
+
+    def src(i: int) -> Optional[Invocation]:
+        return sources[i] if i < len(sources) else None
+
+    slots: dict[int, tuple[int, int, int, int]] = {}
+    slot_event: dict[int, int] = {}           # slot -> invoke event index
+    # lineage: config -> tuple of fired (event_index, state_after)
+    frontier: dict[tuple[int, int], tuple] = {
+        (int(model.init_state()), 0): ()}
+    effort = 0
+
+    for i in range(enc.n_events):
+        kind, slot, f, a1, a2, rv = (int(x) for x in events[i])
+        if kind == EV_INVOKE:
+            slots[slot] = (f, a1, a2, rv)
+            slot_event[slot] = i
+        elif kind == EV_RETURN:
+            tbit = 1 << slot
+            seen = dict(frontier)
+            stack = [c for c in frontier if not c[1] & tbit]
+            while stack:
+                state, mask = stack.pop()
+                lin = seen[(state, mask)]
+                for s, (sf, sa1, sa2, srv) in slots.items():
+                    if mask >> s & 1:
+                        continue
+                    legal, nxt = model.step_py(state, sf, sa1, sa2, srv)
+                    effort += 1
+                    if legal:
+                        cfg = (int(nxt), mask | (1 << s))
+                        if cfg not in seen:
+                            seen[cfg] = lin + ((slot_event[s], int(nxt)),)
+                            if not cfg[1] & tbit:
+                                stack.append(cfg)
+                if effort > MAX_WITNESS_EVENTS:
+                    return None
+            survivors = {(s, m & ~tbit): lin
+                         for (s, m), lin in seen.items() if m & tbit}
+            if not survivors:
+                return _build_witness(enc, model, i, slot, slots,
+                                      slot_event, seen, src)
+            frontier = survivors
+            del slots[slot]
+            del slot_event[slot]
+    return None
+
+
+def _build_witness(enc, model, event_index, slot, slots, slot_event,
+                   seen, src):
+    f, a1, a2, rv = slots[slot]
+    # The best explanation: a reachable config that linearized the MOST ops
+    # (its lineage is a concrete maximal linearization of the prefix).
+    best_cfg = max(seen, key=lambda c: bin(c[1]).count("1"))
+    prefix = [{
+        "event_index": ev_i,
+        "op": describe_op(*_op_at(enc, ev_i)),
+        "state_after": state,
+        **_inv_info(src(ev_i)),
+    } for ev_i, state in seen[best_cfg]]
+    final_configs = sorted(
+        {(s, _pending_desc(m, slots, enc, slot_event)) for s, m in seen},
+        key=str)[:16]
+    ret = int((np.asarray(enc.events[:event_index, 0]) == EV_RETURN).sum())
+    return {
+        "valid": False,
+        "op": describe_op(f, a1, a2, rv),
+        **_inv_info(src(slot_event[slot])),
+        "event_index": event_index,
+        "dead_step": ret,
+        "maximal_linearization": prefix,
+        "final_state": best_cfg[0],
+        "final_configs": [
+            {"state": s, "pending_unfired": list(p)}
+            for s, p in final_configs],
+        "explanation": (
+            f"no reachable configuration could linearize "
+            f"{describe_op(f, a1, a2, rv)} by the time it returned"),
+    }
+
+
+def _op_at(enc, event_index: int) -> tuple[int, int, int, int]:
+    _, _, f, a1, a2, rv = (int(x) for x in enc.events[event_index])
+    return f, a1, a2, rv
+
+
+def _pending_desc(mask: int, slots, enc, slot_event) -> tuple:
+    return tuple(describe_op(*op) for s, op in sorted(slots.items())
+                 if not mask >> s & 1)
+
+
+SVG_STYLE = ("font-family:sans-serif;font-size:12px")
+
+
+def render_witness_svg(w: dict[str, Any]) -> str:
+    """Minimal knossos-linear.svg-style rendering: the maximal linearization
+    as a chain of state transitions, then the stuck op in red."""
+    rows = []
+    y = 28
+    rows.append(f'<text x="10" y="{y}" font-weight="bold">'
+                f'not linearizable: {html.escape(w["op"])}</text>')
+    y += 22
+    rows.append(f'<text x="10" y="{y}" fill="#555">'
+                f'{html.escape(w["explanation"])}</text>')
+    y += 28
+    x = 10
+    for stepd in w["maximal_linearization"]:
+        label = f'{stepd["op"]} ⇒ {stepd["state_after"]}'
+        wpx = 9 * len(label) + 16
+        rows.append(
+            f'<rect x="{x}" y="{y - 16}" width="{wpx}" height="22" rx="4" '
+            f'fill="#e8f5e9" stroke="#66bb6a"/>'
+            f'<text x="{x + 8}" y="{y}">{html.escape(label)}</text>')
+        x += wpx + 10
+        if x > 760:
+            x = 10
+            y += 30
+    wpx = 9 * len(w["op"]) + 16
+    rows.append(
+        f'<rect x="{x}" y="{y - 16}" width="{wpx}" height="22" rx="4" '
+        f'fill="#ffebee" stroke="#e53935"/>'
+        f'<text x="{x + 8}" y="{y}" fill="#b71c1c">'
+        f'{html.escape(w["op"])}</text>')
+    height = y + 30
+    return (f'<svg xmlns="http://www.w3.org/2000/svg" width="980" '
+            f'height="{height}" style="{SVG_STYLE}">'
+            f'<rect width="100%" height="100%" fill="white"/>'
+            + "".join(rows) + "</svg>")
+
+
+def write_witness(store_dir: str, key: Any, w: dict[str, Any]) -> str:
+    """Persist a reconstructed witness as linear.json + linear.svg
+    (per-key suffix under the independent wrapper); returns the json name."""
+    suffix = f"-{key}" if key is not None else ""
+    jname = f"linear{suffix}.json"
+    Path(store_dir, jname).write_text(json.dumps(w, indent=2, default=str))
+    Path(store_dir, f"linear{suffix}.svg").write_text(render_witness_svg(w))
+    return jname

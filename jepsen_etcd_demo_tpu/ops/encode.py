@@ -173,16 +173,14 @@ def _make_invocation(inv: Op, comp: Optional[Op], inv_idx: int,
                       process=inv.process)
 
 
-def encode_events(invocations: Sequence[Invocation], k_slots: int = 32
-                  ) -> EncodedHistory:
-    """Build the (kind, slot, f, a1, a2, rv) event stream with slot assignment.
+def _timeline_points(invocations: Sequence[Invocation]
+                     ) -> list[tuple[int, int, Invocation]]:
+    """(history_index, is_return, invocation) per event, in event order.
 
-    Events are emitted in history order: each included invocation contributes
-    an EV_INVOKE at its invoke position and, when status == ok, an EV_RETURN at
-    its completion position. `fail` ops and `info` reads are excluded (see
-    module docstring).
-    """
-    # Collect timeline points: (history_index, is_return, invocation).
+    Single source of the event-ordering rule shared by encode_events and
+    event_sources: each included invocation contributes an invoke point and,
+    when status == ok, a return point; `fail` ops and `info` reads are
+    excluded (see module docstring)."""
     points: list[tuple[int, int, Invocation]] = []
     for inv in invocations:
         if inv.status == FAIL:
@@ -193,6 +191,27 @@ def encode_events(invocations: Sequence[Invocation], k_slots: int = 32
         if inv.status == OK:
             points.append((inv.complete_index, 1, inv))
     points.sort(key=lambda p: (p[0], p[1]))
+    return points
+
+
+def event_sources(invocations: Sequence[Invocation]) -> list[Invocation]:
+    """The invocation behind each encoded event row, in event order —
+    row i of encode_events(invocations).events describes event_sources[i].
+    Used by the witness reconstructor to map kernel/oracle event indices
+    back to concrete history operations."""
+    return [inv for _, _, inv in _timeline_points(invocations)]
+
+
+def encode_events(invocations: Sequence[Invocation], k_slots: int = 32
+                  ) -> EncodedHistory:
+    """Build the (kind, slot, f, a1, a2, rv) event stream with slot assignment.
+
+    Events are emitted in history order: each included invocation contributes
+    an EV_INVOKE at its invoke position and, when status == ok, an EV_RETURN at
+    its completion position. `fail` ops and `info` reads are excluded (see
+    module docstring).
+    """
+    points = _timeline_points(invocations)
 
     free = list(range(k_slots - 1, -1, -1))  # pop() yields lowest slot first
     slot_of: dict[int, int] = {}             # invoke_index -> slot

@@ -231,12 +231,16 @@ def make_config(model: Model, k_slots: int, f_cap: int,
     """WGLConfig with packing bits derived from the history's real values.
 
     Bits are rounded up to a multiple of 4 (when headroom allows) so nearby
-    value ranges share one jit cache entry."""
+    value ranges share one jit cache entry; when the key cannot be packed at
+    all (bits + k_slots > 31) the bits are canonicalized to 0 — they would
+    be unused, and distinct values must not force spurious recompiles."""
     bits = model.pack_bits(max_value)
     if bits:
         rounded = (bits + 3) // 4 * 4
         if rounded + k_slots <= 31:
             bits = rounded
+        elif bits + k_slots > 31:
+            bits = 0  # unpackable: state_bits is dead config
     return WGLConfig(k_slots, f_cap, state_bits=bits)
 
 
@@ -256,6 +260,99 @@ def check_steps(rs: ReturnSteps, model: Model | None = None,
     out = {k: np.asarray(v) for k, v in check(*steps_arrays(rs)).items()}
     out["valid"] = verdict(out)
     return out
+
+
+# --- resumable / checkpointed search (SURVEY.md §5.4, §5.7) ---------------
+#
+# lax.scan cannot early-exit, so an overflow mid-history used to force a
+# full restart (and ultimately a Python-oracle fallback — the exact DNF the
+# framework exists to avoid, VERDICT round-1 item 4). Instead: scan the
+# return steps in CHUNKS, checkpointing the frontier carry on the host at
+# every chunk boundary. When a chunk overflows, migrate the pre-chunk
+# checkpoint into a larger frontier capacity and re-run JUST that chunk.
+# Verdicts are exact: a chunk's output is only accepted when it completed
+# without overflow (or died — death is sound regardless, because dropping
+# configs can only make death MORE likely... dropping cannot create
+# death-free runs; a died+overflowed chunk is re-run too).
+
+def _chunk_fn(model: Model, cfg: WGLConfig):
+    step = make_step_fn2(model, cfg)
+
+    def run(carry, slot_tabs, slot_active, targets, idxs):
+        final, _ = jax.lax.scan(
+            step, carry, (slot_tabs, slot_active, targets, idxs))
+        return final
+
+    return jax.jit(run)
+
+
+def cached_chunk2(model: Model, cfg: WGLConfig):
+    key = ("chunk2", model.cache_key(), cfg)
+    if key not in _CACHE:
+        _CACHE[key] = _chunk_fn(model, cfg)
+    return _CACHE[key]
+
+
+def _migrate_carry(carry: _Carry2, f_new: int) -> _Carry2:
+    """Grow the frontier capacity of a host checkpoint (overflow retry)."""
+    f_old = carry.states.shape[0]
+    pad = f_new - f_old
+    return _Carry2(
+        states=jnp.pad(carry.states, (0, pad)),
+        masks=jnp.pad(carry.masks, ((0, pad), (0, 0))),
+        valid=jnp.pad(carry.valid, (0, pad)),
+        dead=carry.dead, overflow=carry.overflow,
+        dead_step=carry.dead_step, max_frontier=carry.max_frontier)
+
+
+def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
+                          f_cap: int = 256, chunk: int = 256,
+                          f_cap_max: int = 1 << 20) -> dict[str, Any]:
+    """Exact verdict via chunked scan + checkpointed capacity escalation.
+
+    Never falls back to the Python oracle: capacity grows 4x per overflow,
+    resuming from the last good chunk boundary, until the frontier fits or
+    f_cap_max is exceeded (at which point the search genuinely does not fit
+    device memory and raises)."""
+    if model is None:
+        from ..models import CASRegister
+        model = CASRegister()
+    r = rs.n_steps
+    padded = rs.padded_to(((r + chunk - 1) // chunk or 1) * chunk)
+    tabs, act, tgt = steps_arrays(padded)
+    cfg = config_for(rs, model, f_cap)
+    carry = _init_carry2(model, cfg)
+    escalations = 0
+    for c0 in range(0, padded.targets.shape[0], chunk):
+        sl = slice(c0, c0 + chunk)
+        idxs = jnp.arange(c0, c0 + chunk, dtype=jnp.int32)
+        while True:
+            out = cached_chunk2(model, cfg)(
+                carry, tabs[sl], act[sl], tgt[sl], idxs)
+            if not bool(out.overflow):
+                carry = out
+                break
+            # Overflow: escalate capacity, resume from the checkpoint.
+            f_cap *= 4
+            escalations += 1
+            if f_cap > f_cap_max:
+                raise MemoryError(
+                    f"WGL frontier exceeds f_cap_max={f_cap_max} at return "
+                    f"step {c0}; history needs a bigger device or sharded "
+                    f"frontier (parallel/frontier.py)")
+            cfg = config_for(rs, model, f_cap)
+            carry = _migrate_carry(carry, f_cap)
+        if bool(out.dead):
+            break
+    return {
+        "survived": not bool(carry.dead),
+        "overflow": False,
+        "dead_step": int(carry.dead_step),
+        "max_frontier": int(carry.max_frontier),
+        "f_cap": f_cap,
+        "escalations": escalations,
+        "valid": not bool(carry.dead),
+    }
 
 
 def check_encoded2(enc: EncodedHistory, model: Model | None = None,

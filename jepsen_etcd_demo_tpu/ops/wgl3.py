@@ -70,8 +70,10 @@ class DenseConfig:
 
 
 # Largest table (S * 2^K cells) the dense kernel will build per history.
-# 2^20 bool cells = 1 MiB; a 64-history batch stays ~64 MiB of HBM.
-DENSE_CELL_BUDGET = 1 << 20
+# Cells are BITS (32 packed per uint32 word): 2^26 cells = 8 MiB of table,
+# so even a 64-history batch stays ~512 MiB of HBM at the extreme; typical
+# jepsen concurrency (K ~ 12, S ~ 8) is a 4 KiB table.
+DENSE_CELL_BUDGET = 1 << 26
 
 
 def dense_config(model: Model, k_slots: int, max_value: int,
@@ -79,83 +81,134 @@ def dense_config(model: Model, k_slots: int, max_value: int,
     """DenseConfig for this (model, history) — or None when infeasible.
 
     Feasible iff the model's states are boundable from the history's values
-    (same precondition as the packed sort-key dedup) and the table fits the
-    cell budget. S is rounded up (multiple of 4) so nearby value ranges share
-    one jit cache entry, mirroring wgl2.make_config."""
-    if not model.packable_states:
+    (same precondition as the packed sort-key dedup), S <= 32 (the packed
+    kernel unrolls its state OR-reduce), K >= 5 (the mask axis is packed 32
+    configs per uint32 word), and the table fits the cell budget. S is
+    rounded up (multiple of 4) so nearby value ranges share one jit cache
+    entry, mirroring wgl2.make_config."""
+    if not model.packable_states or k_slots < 5:
         return None
     s = model.state_bound(max_value) + 1
     s = (s + 3) // 4 * 4
-    if s * (1 << k_slots) > budget:
+    if s > 32 or s * (1 << k_slots) > budget:
         return None
     return DenseConfig(k_slots=k_slots, n_states=s,
                        state_offset=model.state_offset)
 
 
 class _Carry3(NamedTuple):
-    table: jax.Array        # bool[S, M]
+    table: jax.Array        # u32[S, W]: bit b of word w = mask (w*32 + b)
     dead: jax.Array         # bool
     dead_step: jax.Array    # i32 (return-step index, -1 if alive)
     max_frontier: jax.Array  # i32 (popcount high-water mark)
 
 
+# LO_MASK[j] (j < 5): bits p in 0..31 whose index has bit j CLEAR — the
+# in-word "mask bit j not yet fired" positions.
+_LO_MASK = tuple(
+    np.uint32(sum(1 << p for p in range(32) if not (p >> j) & 1))
+    for j in range(5))
+
+
 def make_step_fn3(model: Model, cfg: DenseConfig):
-    K, S, off, M = cfg.k_slots, cfg.n_states, cfg.state_offset, cfg.n_masks
+    """Scan body over the bit-packed table.
+
+    The mask axis is packed 32 configs/word: masks' low 5 bits index bits
+    inside a uint32, the high K-5 bits index words. Every set operation
+    becomes word-wise bit algebra (32x less memory traffic than a bool
+    table, no bool->f32 conversions, no MXU needed):
+      * expanding slot j<5  = in-word shift:  (src & LO_MASK[j]) << 2^j
+      * expanding slot j>=5 = word-axis reshape exposing word-bit j-5
+      * state transition    = OR-reduce over source states (S unrolled,
+        S <= 32 guaranteed by dense_config)
+      * pruning at return t = word gather + in-word shift, then mask
+      * frontier size       = population_count
+    """
+    K, S, off = cfg.k_slots, cfg.n_states, cfg.state_offset
+    assert K >= 5 and S <= 32
+    W = 1 << (K - 5)
     state_vals = jnp.arange(S, dtype=jnp.int32) - off
     s_ids = jnp.arange(S, dtype=jnp.int32)
-    m_idx = jnp.arange(M, dtype=jnp.int32)
+    w_idx = jnp.arange(W, dtype=jnp.int32)
+    lo_masks = jnp.asarray(np.array(_LO_MASK, dtype=np.uint32))
+    full = jnp.uint32(0xFFFFFFFF)
 
-    def step(carry: _Carry3, xs):
-        slot_tab, slot_active, target, idx = xs
-        is_pad = target < 0
-        t = jnp.maximum(target, 0)
+    def allowed_mask(t):
+        """uint32[W]: per-word mask of config positions with mask-bit t
+        CLEAR (not-yet-fired-t). Serves both banking and prune."""
+        in_word = lo_masks[jnp.minimum(t, 4)]
+        word_level = jnp.where(
+            ((w_idx >> jnp.maximum(t - 5, 0)) & 1) == 0, full, jnp.uint32(0))
+        return jnp.where(t < 5, jnp.broadcast_to(in_word, (W,)), word_level)
 
-        # Per-slot transition matrices over the state axis: trans[j, s, s'].
+    def or_reduce(tj, src):
+        """OR over source states: out[s', ...] = OR_s tj[s, s'] & src[s].
+        S is small (<=32): unrolled selects, no matmul."""
+        acc = jnp.zeros_like(src)
+        for s in range(S):
+            sel = tj[s].reshape((S,) + (1,) * (src.ndim - 1))
+            acc = acc | jnp.where(sel, src[s][None], jnp.uint32(0))
+        return acc
+
+    def transitions(slot_tab, slot_active):
+        """Per-slot transition matrices over the state axis: [K, S, S'].
+        Pure function of the scan inputs — computed for ALL steps in one
+        vectorized shot before the scan (keeps the sequential per-step
+        critical path to pure bit algebra)."""
         legal, nxt = jax.vmap(
             lambda row: model.step(state_vals, row[0], row[1], row[2],
                                    row[3]))(slot_tab)
         nxt_row = nxt + off
         ok = legal & (nxt_row >= 0) & (nxt_row < S) & slot_active[:, None]
-        trans = (ok[:, :, None]
-                 & (nxt_row[:, :, None] == s_ids[None, None, :])
-                 ).astype(jnp.float32)                      # [K, S, S']
+        return (ok[:, :, None]
+                & (nxt_row[:, :, None] == s_ids[None, None, :]))
+
+    def step(carry: _Carry3, xs):
+        trans, target, idx = xs
+        is_pad = target < 0
+        t = jnp.maximum(target, 0)
 
         # JIT-linearization banking: configs that already fired the target
-        # are kept but never expanded (column mask over the mask axis).
-        not_banked = (((m_idx >> t) & 1) == 0)              # [M]
+        # are kept but never expanded.
+        allowed = allowed_mask(t)                            # u32[W]
 
         def body(st):
             T, n_prev, _changed, rounds = st
-            # Gauss-Seidel sweep: fire each slot once, updating T in place so
-            # same-round chains propagate. Static python loop — K is small
-            # and each j needs its own static reshape exposing bit j.
+            # Gauss-Seidel sweep: fire each slot once, updating T in place
+            # so same-round chains propagate. Static python loop — K is
+            # small and each j needs its own static bit/word addressing.
             for j in range(K):
-                lo, hi = 1 << j, M >> (j + 1)
-                Tr = T.reshape(S, hi, 2, lo)
-                src = (Tr[:, :, 0, :]
-                       & not_banked.reshape(hi, 2, lo)[None, :, 0, :])
-                fired = jnp.tensordot(
-                    trans[j], src.astype(jnp.float32).reshape(S, -1),
-                    axes=[[0], [0]]) > 0                    # [S', hi*lo]
-                hi_half = Tr[:, :, 1, :] | fired.reshape(S, hi, lo)
-                T = jnp.stack([Tr[:, :, 0, :], hi_half], axis=2
-                              ).reshape(S, M)
-            n_now = jnp.sum(T, dtype=jnp.int32)
+                src = T & allowed[None, :]
+                if j < 5:
+                    fired = or_reduce(trans[j], src & _LO_MASK[j])
+                    T = T | (fired << np.uint32(1 << j))
+                else:
+                    lo_w, hi = 1 << (j - 5), W >> (j - 4)
+                    Tr = T.reshape(S, hi, 2, lo_w)
+                    srcj = src.reshape(S, hi, 2, lo_w)[:, :, 0, :]
+                    fired = or_reduce(trans[j], srcj)
+                    T = jnp.stack([Tr[:, :, 0, :], Tr[:, :, 1, :] | fired],
+                                  axis=2).reshape(S, W)
+            n_now = jnp.sum(jax.lax.population_count(T), dtype=jnp.int32)
             return T, n_now, n_now > n_prev, rounds + 1
 
         def cond(st):
             return st[2] & (st[3] < cfg.rounds)
 
-        n0 = jnp.sum(carry.table, dtype=jnp.int32)
+        n0 = jnp.sum(jax.lax.population_count(carry.table), dtype=jnp.int32)
         T, n, _c, _r = jax.lax.while_loop(
             cond, body, (carry.table, n0, ~is_pad, jnp.int32(0)))
 
-        # Prune: keep configs that linearized the target, with its bit
-        # cleared — a single gather re-addressing m|bit -> m.
-        pruned = T[:, m_idx | (jnp.int32(1) << t)] & not_banked[None, :]
+        # Prune: keep configs that linearized the target, re-addressed with
+        # its bit cleared. t<5: in-word shift down; t>=5: word gather.
+        shift = jnp.where(t < 5, jnp.uint32(1) << jnp.minimum(
+            t.astype(jnp.uint32), jnp.uint32(4)), jnp.uint32(0))
+        wsel = jnp.where(t < 5, w_idx,
+                         w_idx | (jnp.int32(1) << jnp.maximum(t - 5, 0)))
+        pruned = (T[:, wsel] >> shift) & allowed[None, :]
         T_new = jnp.where(is_pad, T, pruned)
-        n_after = jnp.sum(T_new, dtype=jnp.int32)
-        died = ~is_pad & ~carry.dead & (n_after == 0)
+        alive = jnp.any(T_new != 0)
+        died = ~is_pad & ~carry.dead & ~alive
         dead = carry.dead | died
         T_new = jnp.where(dead, jnp.zeros_like(T_new), T_new)
         return _Carry3(
@@ -164,25 +217,26 @@ def make_step_fn3(model: Model, cfg: DenseConfig):
                                 carry.dead_step),
             max_frontier=jnp.maximum(carry.max_frontier, n)), None
 
-    return step
+    return step, transitions
 
 
 def _init_carry3(model: Model, cfg: DenseConfig) -> _Carry3:
     row = int(model.init_state()) + cfg.state_offset
-    table = jnp.zeros((cfg.n_states, cfg.n_masks), bool
-                      ).at[row, 0].set(True)
+    table = jnp.zeros((cfg.n_states, 1 << (cfg.k_slots - 5)), jnp.uint32
+                      ).at[row, 0].set(jnp.uint32(1))
     return _Carry3(table=table, dead=jnp.bool_(False),
                    dead_step=jnp.int32(-1), max_frontier=jnp.int32(1))
 
 
 def _check_one_fn(model: Model, cfg: DenseConfig):
-    step = make_step_fn3(model, cfg)
+    step, transitions = make_step_fn3(model, cfg)
 
     def check(slot_tabs, slot_active, targets):
         carry = _init_carry3(model, cfg)
         idxs = jnp.arange(targets.shape[0], dtype=jnp.int32)
+        trans_all = jax.vmap(transitions)(slot_tabs, slot_active)
         final, _ = jax.lax.scan(
-            step, carry, (slot_tabs, slot_active, targets, idxs))
+            step, carry, (trans_all, targets, idxs))
         return {
             "survived": ~final.dead,
             # The dense table is the whole config space: exact by
@@ -225,16 +279,20 @@ def cached_batch_checker3(model: Model, cfg: DenseConfig):
 
 def tight_k_slots(enc: EncodedHistory) -> int:
     """Smallest mask width serving this history, rounded up to even so
-    nearby concurrencies share one jit cache entry."""
-    return max(2, (enc.max_pending + 1) // 2 * 2)
+    nearby concurrencies share one jit cache entry; floor 6 because the
+    packed table needs K >= 5 (and 2^6 masks = 2 words is already tiny)."""
+    return max(6, (enc.max_pending + 1) // 2 * 2)
 
 
 def step_bucket(n_steps: int, floor: int = 64) -> int:
-    """Pad scan lengths to power-of-two buckets: bounded recompiles across a
-    corpus of varying history lengths, ≤2x padded steps (pads are cheap —
-    the closure while_loop exits immediately on a pad step)."""
+    """Pad scan lengths to {2^k, 1.5*2^k} buckets: bounded recompiles
+    across a corpus of varying history lengths, ≤33% padded steps (pads are
+    cheap — the closure while_loop exits immediately on a pad step — but
+    the scan still walks them)."""
     r = floor
     while r < n_steps:
+        if r + r // 2 >= n_steps:
+            return r + r // 2
         r *= 2
     return r
 

@@ -1,0 +1,205 @@
+"""DCN multi-slice corpus sharding (BASELINE configs[4]; SURVEY.md §2.5).
+
+The reference's only inter-machine planes are SSH + HTTP; the TPU build adds
+a device-collective plane. Within a slice, the frontier/batch axes ride ICI
+(parallel/frontier.py, parallel/batch.py). ACROSS slices — separate hosts,
+each running one JAX process — the corpus axis rides DCN:
+
+  * every process calls `init_multislice` (jax.distributed.initialize) so
+    all slices form one global device set;
+  * `multislice_mesh` builds a ("slice", "batch") mesh whose OUTER axis is
+    process-major — exactly the axis that crosses DCN;
+  * `check_corpus_multislice` shards the history batch over both axes with
+    a NamedSharding: each slice checks its shard of the stored corpus, and
+    the per-history verdict scalars are gathered back to every host.
+
+The whole path is simulatable on one machine: N local processes, each with
+M virtual CPU devices (`dryrun_multislice`), which is how the tests and the
+driver exercise it without a pod.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+
+def init_multislice(coordinator: str, num_processes: int, process_id: int,
+                    local_devices: Optional[int] = None) -> None:
+    """Join the global JAX distributed system. Must run before any backend
+    initialization. `local_devices` forces a virtual CPU platform with that
+    many devices (simulation on one machine / CI)."""
+    if local_devices is not None:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{local_devices}").strip()
+    import jax
+
+    if local_devices is not None:
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", local_devices)
+        except Exception:
+            pass
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def multislice_mesh(slice_axis: str = "slice", batch_axis: str = "batch"):
+    """2D mesh over ALL global devices: [processes, devices-per-process].
+    The outer (process-major) axis is the DCN axis."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n_proc = jax.process_count()
+    per = len(devs) // n_proc
+    order = sorted(devs, key=lambda d: (d.process_index, d.id))
+    arr = np.array(order).reshape(n_proc, per)
+    return Mesh(arr, (slice_axis, batch_axis))
+
+
+def check_corpus_multislice(encs: Sequence, model, mesh=None
+                            ) -> list[dict[str, Any]]:
+    """Check a corpus of EncodedHistory across every slice in ONE launch.
+
+    Every process passes the SAME corpus (each host reads the same store);
+    the mesh sharding assigns each device its shard. Returns the full
+    per-history result list, identical on every process (gathered over
+    DCN)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..ops import wgl3
+    from ..ops.wgl import verdict
+
+    if mesh is None:
+        mesh = multislice_mesh()
+    cfg, arrays, steps = wgl3.batch_arrays3(encs, model)
+    axes = tuple(mesh.axis_names)
+    total = int(np.prod([mesh.shape[a] for a in axes]))
+    b = arrays[0].shape[0]
+    b_pad = ((b + total - 1) // total) * total
+    tabs, act, tgt = (np.asarray(a) for a in arrays)
+    if b_pad != b:
+        # Pad with empty histories: target -1 = pad step, trivially valid.
+        extra = b_pad - b
+        tabs = np.concatenate(
+            [tabs, np.zeros((extra,) + tabs.shape[1:], tabs.dtype)])
+        act = np.concatenate(
+            [act, np.zeros((extra,) + act.shape[1:], act.dtype)])
+        tgt = np.concatenate(
+            [tgt, np.full((extra,) + tgt.shape[1:], -1, tgt.dtype)])
+    global_arrays = tuple(
+        jax.make_array_from_callback(
+            a.shape,
+            NamedSharding(mesh, P(axes, *(None,) * (a.ndim - 1))),
+            lambda idx, a=a: a[idx])
+        for a in (tabs, act, tgt))
+    check = wgl3.cached_batch_checker3(model, cfg)
+    out_spec = NamedSharding(mesh, P(axes))
+    fn = jax.jit(check, out_shardings={
+        "survived": out_spec, "overflow": out_spec,
+        "dead_step": out_spec, "max_frontier": out_spec})
+    out = fn(*global_arrays)
+    gathered = {k: np.asarray(multihost_utils.process_allgather(
+        v, tiled=True)) for k, v in out.items()}
+    results = []
+    for i, s in enumerate(steps):
+        one = {k: gathered[k][i].item() for k in gathered}
+        one["valid"] = verdict(one)
+        one["op_count"] = s.n_ops
+        results.append(one)
+    return results
+
+
+# --- one-machine simulation / dryrun ---------------------------------------
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def dryrun_multislice(n_procs: int = 2, devices_per_proc: int = 2,
+                      timeout_s: float = 600.0) -> None:
+    """Spawn n_procs local JAX processes (virtual CPU devices), form the
+    distributed system, and run one multi-slice corpus check. Raises on any
+    disagreement or failure."""
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "jepsen_etcd_demo_tpu.parallel.multislice",
+             coord, str(n_procs), str(pid), str(devices_per_proc)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for pid in range(n_procs)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 or "MULTISLICE_OK" not in out:
+            raise RuntimeError(
+                f"multislice worker {pid} failed (rc={p.returncode}):\n"
+                f"{out[-2000:]}")
+    lines = [next(ln for ln in out.splitlines()
+                  if ln.startswith("MULTISLICE_OK")) for out in outs]
+    if len(set(lines)) != 1:
+        raise RuntimeError(f"workers disagree: {lines}")
+    print(f"dryrun_multislice({n_procs}x{devices_per_proc}): ok — {lines[0]}")
+
+
+def _worker(coord: str, n: int, pid: int, local_devices: int) -> None:
+    """Subprocess entry: join the cluster, check a deterministic corpus,
+    print the verdict summary (identical across processes)."""
+    init_multislice(coord, n, pid, local_devices=local_devices)
+    import random
+
+    from ..models import CASRegister
+    from ..ops.encode import encode_register_history
+    from ..utils.fuzz import gen_register_history, mutate_history
+
+    rng = random.Random(0xDC4)
+    encs = []
+    expect = []
+    for i in range(2 * n * local_devices + 1):   # ragged on purpose
+        h = gen_register_history(rng, n_ops=30, n_procs=4)
+        if i % 3 == 0:
+            h = mutate_history(rng, h)
+        encs.append(encode_register_history(h, k_slots=16))
+    model = CASRegister()
+    results = check_corpus_multislice(encs, model)
+    # Cross-check against the oracle locally (small corpus).
+    from ..checkers.oracle import check_events_oracle
+
+    for enc, res in zip(encs, results):
+        want = check_events_oracle(enc, model).valid
+        assert res["valid"] is want, (res, want)
+    summary = "".join("T" if r["valid"] else "F" for r in results)
+    print(f"MULTISLICE_OK {summary}")
+
+
+if __name__ == "__main__":
+    _worker(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+            int(sys.argv[4]))

@@ -203,8 +203,7 @@ async def run_test(test: dict) -> dict:
         # Detach per-run file handler so later runs in the same process
         # (--test-count > 1) don't keep appending to this run's jepsen.log.
         if log_handler is not None:
-            logging.getLogger().removeHandler(log_handler)
-            log_handler.close()
+            _detach_file_log(log_handler)
 
 
 async def _run_test_inner(test: dict, store) -> dict:
@@ -257,10 +256,24 @@ async def _run_test_inner(test: dict, store) -> dict:
 
 def _attach_file_log(store_path) -> logging.Handler:
     """Tee the framework log into the run dir (reference: logback writes
-    jepsen.log into the store [dep], SURVEY.md §5.5). Caller must detach."""
+    jepsen.log into the store [dep], SURVEY.md §5.5). Caller must detach
+    with _detach_file_log. The run log always captures INFO regardless of
+    the embedding app's root level (the artifact must be useful even when
+    the host process never configured logging)."""
     root = logging.getLogger()
     handler = logging.FileHandler(store_path / "jepsen.log")
     handler.setFormatter(logging.Formatter(
         "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+    handler.setLevel(logging.INFO)
+    handler._prev_root_level = root.level  # restored on detach
+    if root.getEffectiveLevel() > logging.INFO:
+        root.setLevel(logging.INFO)
     root.addHandler(handler)
     return handler
+
+
+def _detach_file_log(handler: logging.Handler) -> None:
+    root = logging.getLogger()
+    root.removeHandler(handler)
+    root.setLevel(handler._prev_root_level)
+    handler.close()

@@ -8,10 +8,9 @@ each run dir (charts, timelines, logs, history)."""
 from __future__ import annotations
 
 import html
-import json
+import urllib.parse
 from functools import partial
 from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
-from pathlib import Path
 
 from ..store import Store
 
@@ -25,8 +24,9 @@ def _index_html(store: Store) -> str:
         except Exception:
             valid = "?"
         color = {True: "#2a9d43", False: "#d43a2a"}.get(valid, "#e9a820")
+        href = urllib.parse.quote(f"/files/{rel}/")
         rows.append(
-            f"<tr><td><a href='/files/{html.escape(str(rel))}/'>"
+            f"<tr><td><a href='{href}'>"
             f"{html.escape(str(rel))}</a></td>"
             f"<td style='color:{color};font-weight:bold'>{valid}</td></tr>")
     return (

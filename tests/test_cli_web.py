@@ -1,0 +1,143 @@
+"""CLI + web server tests (VERDICT round-1 item 7): the jepsen exit-code
+contract (0 valid / 1 invalid), the analyze re-check round-trip, argparse
+validation parity with the reference's cli-opts
+(/root/reference/src/jepsen/etcdemo.clj:177-190), and a web smoke test."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from jepsen_etcd_demo_tpu.cli.main import build_parser, main
+from jepsen_etcd_demo_tpu.store import Store
+from jepsen_etcd_demo_tpu.web.server import make_handler
+
+
+def _run_cli(tmp_path, *extra, workload="register", time_limit="1.5"):
+    return main(["test", "-w", workload, "--fake",
+                 "--time-limit", time_limit, "--rate", "150",
+                 "--store", str(tmp_path / "store"), "--seed", "11",
+                 *extra])
+
+
+class TestParser:
+    def test_workload_is_required(self, capsys):
+        with pytest.raises(SystemExit) as e:
+            build_parser().parse_args(["test"])
+        assert e.value.code == 2
+        assert "--workload" in capsys.readouterr().err
+
+    def test_workload_validated_against_registry(self, capsys):
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(["test", "-w", "nope"])
+        assert "invalid choice" in capsys.readouterr().err
+
+    def test_rate_must_be_positive(self, capsys):
+        # reference validator: "must be a positive number" (:183)
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(["test", "-w", "register",
+                                       "-r", "-3"])
+        assert "positive" in capsys.readouterr().err
+
+    def test_ops_per_key_must_be_positive_int(self):
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(["test", "-w", "register",
+                                       "--ops-per-key", "0"])
+
+    def test_defaults_match_reference(self):
+        a = build_parser().parse_args(["test", "-w", "register"])
+        assert a.quorum is False          # :179
+        assert a.rate == 10.0             # :180
+        assert a.ops_per_key == 100       # :184
+        assert a.nodes == "n1,n2,n3,n4,n5"  # noop-test defaults [dep]
+
+
+class TestExitContract:
+    def test_valid_run_exits_zero_and_stores(self, tmp_path, capsys):
+        rc = _run_cli(tmp_path)
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        assert rc == 0
+        assert json.loads(out)["valid"] is True
+        runs = Store(str(tmp_path / "store")).runs()
+        assert len(runs) == 1
+        assert (runs[0].path / "history.jsonl").exists()
+        assert (runs[0].path / "jepsen.log").exists()
+
+    def test_invalid_run_exits_one(self, tmp_path, capsys):
+        rc = _run_cli(tmp_path, "--stale-read-prob", "0.8", "--no-nemesis",
+                      time_limit="1.0")
+        assert rc == 1
+        assert json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])["valid"] \
+            is False
+
+    def test_test_count_runs_n_and_keeps_separate_logs(self, tmp_path,
+                                                       capsys):
+        rc = _run_cli(tmp_path, "--test-count", "2", time_limit="1.0")
+        assert rc == 0
+        runs = Store(str(tmp_path / "store")).runs()
+        assert len(runs) == 2
+        # Regression (round-1 advisor): the log handler must be detached
+        # per run — run 1's log must not contain run 2's lines.
+        log1 = (runs[0].path / "jepsen.log").read_text()
+        log2 = (runs[1].path / "jepsen.log").read_text()
+        assert "setting up" in log1 and "setting up" in log2
+        assert log1.count("=== valid:") == 1
+        assert log2.count("=== valid:") == 1
+
+
+class TestAnalyze:
+    def test_analyze_roundtrip_agrees(self, tmp_path, capsys):
+        assert _run_cli(tmp_path) == 0
+        run_dir = Store(str(tmp_path / "store")).runs()[0].path
+        capsys.readouterr()
+        rc = main(["analyze", str(run_dir), "-w", "register"])
+        assert rc == 0
+        assert json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])["valid"] \
+            is True
+
+    def test_analyze_flags_corruption(self, tmp_path, capsys):
+        rc = _run_cli(tmp_path, "--stale-read-prob", "0.8", "--no-nemesis",
+                      time_limit="1.0")
+        assert rc == 1
+        run_dir = Store(str(tmp_path / "store")).runs()[0].path
+        capsys.readouterr()
+        assert main(["analyze", str(run_dir), "-w", "register"]) == 1
+        # analyze re-writes results + witness artifacts into the run dir
+        assert list(run_dir.glob("linear-*.json"))
+
+    def test_analyze_oracle_backend(self, tmp_path, capsys):
+        assert _run_cli(tmp_path, time_limit="1.0") == 0
+        run_dir = Store(str(tmp_path / "store")).runs()[0].path
+        assert main(["analyze", str(run_dir), "-w", "register",
+                     "--backend", "oracle"]) == 0
+
+
+class TestWebServer:
+    def test_index_and_static_serving(self, tmp_path, capsys):
+        assert _run_cli(tmp_path, time_limit="1.0") == 0
+        store_root = str(tmp_path / "store")
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                    make_handler(store_root))
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        port = httpd.server_address[1]
+        try:
+            idx = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/").read().decode()
+            assert "test runs" in idx
+            assert "True" in idx       # verdict rendered
+            rel = Store(store_root).runs()[0].path.relative_to(
+                Store(store_root).root)
+            quoted = urllib.parse.quote(str(rel))
+            hist = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/files/{quoted}/history.jsonl"
+            ).read().decode()
+            assert '"invoke"' in hist
+        finally:
+            httpd.shutdown()

@@ -53,12 +53,21 @@ def test_register_run_with_partitions_is_linearizable(tmp_path):
 
 def test_register_run_detects_stale_reads(tmp_path):
     """Injected stale reads (non-quorum) must produce a linearizability
-    violation — proof the full pipeline can actually FAIL (SURVEY.md §4)."""
+    violation — proof the full pipeline can actually FAIL (SURVEY.md §4) —
+    AND a stored counterexample witness naming a corrupted read (knossos
+    linear.svg parity)."""
     test = fake_test(fast_opts(tmp_path, workload="register",
                                stale_read_prob=0.8, no_nemesis=True,
                                time_limit=2.0, seed=3))
     result = run(test)
     assert result["valid"] is False
+    run_dir = Store(test["store_root"]).latest().path
+    witnesses = sorted(run_dir.glob("linear-*.json"))
+    assert witnesses, "invalid run must store a linear-<key>.json witness"
+    import json
+    w = json.loads(witnesses[0].read_text())
+    assert w["op"].startswith("read -> "), w["op"]
+    assert (run_dir / witnesses[0].name.replace(".json", ".svg")).exists()
 
 
 def test_set_run_healthy(tmp_path):

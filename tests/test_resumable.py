@@ -1,0 +1,98 @@
+"""Resumable chunked search: exact verdicts with NO oracle fallback
+(VERDICT round-1 item 4; SURVEY.md §5.4/§5.7 checkpoint/spill)."""
+
+import random
+
+import pytest
+
+from jepsen_etcd_demo_tpu.checkers import Linearizable
+from jepsen_etcd_demo_tpu.checkers.oracle import check_events_oracle
+from jepsen_etcd_demo_tpu.models import CASRegister
+from jepsen_etcd_demo_tpu.ops.encode import (encode_register_history,
+                                             encode_return_steps)
+from jepsen_etcd_demo_tpu.ops.wgl2 import check_steps_resumable
+from jepsen_etcd_demo_tpu.ops.wgl3 import dense_config, tight_k_slots
+from jepsen_etcd_demo_tpu.utils.fuzz import gen_register_history, \
+    mutate_history
+
+
+def _big_value_history(rng, n_ops, n_procs, p_info=0.05):
+    """Values up to ~1000: S > 32 makes the dense kernel infeasible, so
+    these histories exercise the general (sort-kernel) path."""
+    h = gen_register_history(rng, n_ops=n_ops, n_procs=n_procs,
+                             p_info=p_info)
+    for op in h:
+        if isinstance(op.value, int):
+            op.value = op.value * 211          # spread into 0..~1000
+        elif isinstance(op.value, tuple):
+            op.value = tuple(v * 211 for v in op.value)
+    return h
+
+
+def test_resumable_matches_oracle_with_tiny_start_capacity():
+    rng = random.Random(0xE5C)
+    model = CASRegister()
+    n_escalated = n_invalid = 0
+    for i in range(8):
+        # Oracle-tractable scale (the oracle, like knossos, blows up on
+        # info-rich frontiers — which is exactly why the native path
+        # exists; its own correctness at that scale is covered by
+        # test_resumable_dead_step_matches_full_scan's self-consistency).
+        h = _big_value_history(rng, n_ops=rng.randrange(20, 50), n_procs=6,
+                               p_info=0.02)
+        if i % 2 == 0:
+            h = mutate_history(rng, h)
+        enc = encode_register_history(h, k_slots=16)
+        assert dense_config(model, tight_k_slots(enc), enc.max_value) \
+            is None, "test must exercise the sort path"
+        expected = check_events_oracle(enc, model).valid
+        out = check_steps_resumable(encode_return_steps(enc), model,
+                                    f_cap=4, chunk=16)
+        assert out["valid"] is expected
+        n_escalated += out["escalations"] > 0
+        n_invalid += (not expected)
+    assert n_escalated >= 3, "tiny f_cap must force checkpointed escalation"
+    assert n_invalid >= 2
+
+
+def test_checker_never_falls_back_to_oracle():
+    """A frontier-heavy (info-rich, 10-proc) big-value history must check
+    to an exact verdict with backend == jax (the round-1 ladder ended in
+    the Python oracle here — which DNFs on exactly this shape, so no
+    oracle comparison: the assertion is the backend tag + an exact
+    tri-state-free verdict, cross-checked at small scale elsewhere)."""
+    rng = random.Random(0xE5D)
+    model = CASRegister()
+    h = _big_value_history(rng, n_ops=120, n_procs=10, p_info=0.05)
+    res = Linearizable(backend="jax", f_cap=8).check({}, h)
+    assert res["backend"] == "jax"
+    assert res["valid"] in (True, False)   # exact: never "unknown"
+    assert res["overflow"] is False
+
+
+def test_resumable_dead_step_matches_full_scan():
+    rng = random.Random(0xE5E)
+    model = CASRegister()
+    checked = 0
+    for _ in range(10):
+        h = mutate_history(rng, _big_value_history(
+            rng, n_ops=rng.randrange(20, 60), n_procs=5, p_info=0.0))
+        enc = encode_register_history(h, k_slots=16)
+        rs = encode_return_steps(enc)
+        big = check_steps_resumable(rs, model, f_cap=4096, chunk=8)
+        small = check_steps_resumable(rs, model, f_cap=4, chunk=8)
+        assert small["valid"] == big["valid"]
+        if big["valid"] is False:
+            assert small["dead_step"] == big["dead_step"]
+            checked += 1
+    assert checked >= 2
+
+
+def test_resumable_raises_at_capacity_ceiling():
+    rng = random.Random(0xE5F)
+    model = CASRegister()
+    h = _big_value_history(rng, n_ops=60, n_procs=10, p_info=0.2)
+    enc = encode_register_history(h, k_slots=32)
+    rs = encode_return_steps(enc)
+    with pytest.raises(MemoryError):
+        check_steps_resumable(rs, model, f_cap=2, chunk=16, f_cap_max=4)

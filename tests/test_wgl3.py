@@ -162,4 +162,8 @@ def test_independent_batched_dense_detects_bad_key():
     assert res["valid"] is False
     assert res["results"]["2"]["valid"] is False
     assert res["results"]["0"]["valid"] is True
-    assert res["results"]["2"]["backend"] == "jax-dense-batched"
+    # Healthy keys settle in the batched launch; the invalid key re-runs
+    # through the single-history path (which reconstructs its witness).
+    assert res["results"]["0"]["backend"] == "jax-dense-batched"
+    assert res["results"]["2"]["backend"] == "jax-dense"
+    assert res["results"]["2"]["failed_op"] == "read -> 4"
