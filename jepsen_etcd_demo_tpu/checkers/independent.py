@@ -136,8 +136,10 @@ def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]]
     tight = max(wgl3.tight_k_slots(e) for e in event_encs.values())
     cfg3 = wgl3.dense_config(lin.model, tight, max_value)
     if cfg3 is not None:
+        from ..ops import wgl3_pallas
+
         keys = list(event_encs)
-        batch = wgl3.check_batch_encoded3(
+        batch, _kernel = wgl3_pallas.check_batch_encoded_auto(
             [event_encs[k] for k in keys], lin.model)
         return {
             k: {
@@ -146,6 +148,7 @@ def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]]
                 "op_count": one["op_count"],
                 "dead_step": one["dead_step"],
                 "max_frontier": one["max_frontier"],
+                "configs_explored": one["configs_explored"],
                 "overflow": False,
                 "f_cap": one["table_cells"],
             }

@@ -98,11 +98,20 @@ class Linearizable(Checker):
         cfg3 = wgl3.dense_config(self.model, wgl3.tight_k_slots(enc),
                                  enc.max_value)
         if cfg3 is not None:
-            out = wgl3.check_encoded3(enc, self.model, cfg3)
-            return {"valid": out["valid"], "backend": "jax-dense",
+            from ..ops import wgl3_pallas
+
+            # Routed dispatch: fused pallas kernel on a live TPU (whole
+            # scan on-chip, one launch, one fetch), XLA kernel elsewhere.
+            results, kernel = wgl3_pallas.check_batch_encoded_auto(
+                [enc], self.model)
+            out = results[0]
+            backend = ("jax-dense-pallas" if kernel.endswith("pallas")
+                       else "jax-dense")
+            return {"valid": out["valid"], "backend": backend,
                     "op_count": enc.n_ops,
                     "dead_step": int(out["dead_step"]),
                     "max_frontier": int(out["max_frontier"]),
+                    "configs_explored": int(out["configs_explored"]),
                     "overflow": False,
                     "f_cap": cfg3.n_states * cfg3.n_masks}
 

@@ -215,7 +215,11 @@ def make_step_fn3(model: Model, cfg: DenseConfig):
             table=T_new, dead=dead,
             dead_step=jnp.where(died & (carry.dead_step < 0), idx,
                                 carry.dead_step),
-            max_frontier=jnp.maximum(carry.max_frontier, n)), None
+            max_frontier=jnp.maximum(carry.max_frontier, n)), jnp.where(
+                is_pad, 0, n)  # pads do no search work: keep the
+        #                        configs-explored metric padding-invariant
+        #                        (scan buckets here, chunk alignment in the
+        #                        pallas kernel — both must agree exactly)
 
     return step, transitions
 
@@ -235,7 +239,7 @@ def _check_one_fn(model: Model, cfg: DenseConfig):
         carry = _init_carry3(model, cfg)
         idxs = jnp.arange(targets.shape[0], dtype=jnp.int32)
         trans_all = jax.vmap(transitions)(slot_tabs, slot_active)
-        final, _ = jax.lax.scan(
+        final, ns = jax.lax.scan(
             step, carry, (trans_all, targets, idxs))
         return {
             "survived": ~final.dead,
@@ -245,6 +249,11 @@ def _check_one_fn(model: Model, cfg: DenseConfig):
             "overflow": jnp.bool_(False),
             "dead_step": final.dead_step,
             "max_frontier": final.max_frontier,
+            # §5.1 checker metric: total configs live across all return
+            # steps (the kernel's unit of search work; configs/sec = this
+            # over wall time). f32 accumulator: x64 is disabled under jit
+            # and a throughput metric tolerates rounding past 2^24.
+            "configs_explored": jnp.sum(ns.astype(jnp.float32)),
         }
 
     return check
@@ -258,6 +267,32 @@ def make_checker3(model: Model, cfg: DenseConfig):
 def make_batch_checker3(model: Model, cfg: DenseConfig):
     """jitted check over a batch: slot_tabs[B,R,K,4], ... -> [B] results."""
     return jax.jit(jax.vmap(_check_one_fn(model, cfg)))
+
+
+# -- packed results ------------------------------------------------------
+# One device->host fetch per launch: the result dict is stacked into a
+# single i32[..., 5] tensor on device and split on host. This matters a
+# lot on tunneled/remote TPU backends where every small fetch pays a full
+# network round trip (~0.1 s each: fetching the 5-key dict costs more
+# than the whole search at tutorial scale).
+
+PACKED_FIELDS = ("survived", "overflow", "dead_step", "max_frontier",
+                 "configs_explored")
+
+
+def _pack_result(out: dict) -> jax.Array:
+    cfgs = jnp.clip(out["configs_explored"], 0, 2**31 - 1).astype(jnp.int32)
+    return jnp.stack([out["survived"].astype(jnp.int32),
+                      out["overflow"].astype(jnp.int32),
+                      out["dead_step"], out["max_frontier"], cfgs], axis=-1)
+
+
+def unpack_np(arr) -> dict:
+    """np i32[..., 5] (one fetch) -> result dict of np arrays/scalars."""
+    arr = np.asarray(arr)
+    return {"survived": arr[..., 0] != 0, "overflow": arr[..., 1] != 0,
+            "dead_step": arr[..., 2], "max_frontier": arr[..., 3],
+            "configs_explored": arr[..., 4]}
 
 
 _CACHE: dict[tuple, Any] = {}
@@ -274,6 +309,22 @@ def cached_batch_checker3(model: Model, cfg: DenseConfig):
     key = ("batch3", model.cache_key(), cfg)
     if key not in _CACHE:
         _CACHE[key] = make_batch_checker3(model, cfg)
+    return _CACHE[key]
+
+
+def cached_checker3_packed(model: Model, cfg: DenseConfig):
+    key = ("single3p", model.cache_key(), cfg)
+    if key not in _CACHE:
+        fn = _check_one_fn(model, cfg)
+        _CACHE[key] = jax.jit(lambda *a: _pack_result(fn(*a)))
+    return _CACHE[key]
+
+
+def cached_batch_checker3_packed(model: Model, cfg: DenseConfig):
+    key = ("batch3p", model.cache_key(), cfg)
+    if key not in _CACHE:
+        fn = jax.vmap(_check_one_fn(model, cfg))
+        _CACHE[key] = jax.jit(lambda *a: _pack_result(fn(*a)))
     return _CACHE[key]
 
 
@@ -316,11 +367,12 @@ def check_steps3(rs: ReturnSteps, model: Model | None = None,
         raise ValueError(
             f"dense kernel infeasible for k_slots={rs.k_slots}, "
             f"max_value={rs.max_value}; use the sort kernel (wgl2)")
-    check = cached_checker3(model, cfg)
-    out = {k: np.asarray(v) for k, v in check(
-        jnp.asarray(rs.slot_tabs), jnp.asarray(rs.slot_active),
-        jnp.asarray(rs.targets)).items()}
+    check = cached_checker3_packed(model, cfg)
+    out = unpack_np(check(jnp.asarray(rs.slot_tabs),
+                          jnp.asarray(rs.slot_active),
+                          jnp.asarray(rs.targets)))
     out["valid"] = verdict(out)
+    out["configs_explored"] = int(out["configs_explored"])
     return out
 
 
@@ -374,23 +426,30 @@ def batch_arrays3(encs: Sequence[EncodedHistory], model: Model,
     return cfg, arrays, steps
 
 
-def check_batch_encoded3(encs: Sequence[EncodedHistory],
-                         model: Model | None = None) -> list[dict]:
-    """Check a batch of histories in one vmapped dense launch; returns one
-    result dict per history (v2-compatible schema + valid)."""
+def assemble_batch_results(out: dict, steps, cfg: DenseConfig) -> list[dict]:
+    """Unpacked [B]-array results -> one result dict per history
+    (v2-compatible schema + valid). Shared by the XLA and pallas batch
+    entry points so the two backends cannot drift apart in schema."""
     from .wgl import verdict
 
-    if model is None:
-        from ..models import CASRegister
-        model = CASRegister()
-    cfg, arrays, steps = batch_arrays3(encs, model)
-    check = cached_batch_checker3(model, cfg)
-    out = {k: np.asarray(v) for k, v in check(*arrays).items()}
     results = []
     for i, s in enumerate(steps):
         one = {k: out[k][i].item() for k in out}
         one["valid"] = verdict(one)
         one["op_count"] = s.n_ops
+        one["configs_explored"] = int(one["configs_explored"])
         one["table_cells"] = cfg.n_states * cfg.n_masks
         results.append(one)
     return results
+
+
+def check_batch_encoded3(encs: Sequence[EncodedHistory],
+                         model: Model | None = None) -> list[dict]:
+    """Check a batch of histories in one vmapped dense launch; returns one
+    result dict per history (v2-compatible schema + valid)."""
+    if model is None:
+        from ..models import CASRegister
+        model = CASRegister()
+    cfg, arrays, steps = batch_arrays3(encs, model)
+    check = cached_batch_checker3_packed(model, cfg)
+    return assemble_batch_results(unpack_np(check(*arrays)), steps, cfg)

@@ -1,0 +1,389 @@
+"""Pallas TPU kernel for the dense subset-lattice WGL search (wgl3).
+
+Same search as ops/wgl3.py (knossos :linear semantics, reference call site
+src/jepsen/etcdemo.clj:117 [dep]), fused into ONE kernel per history batch:
+the whole return-step scan runs inside the kernel with the reachability
+table held on-chip, instead of an XLA `lax.scan` whose per-step closure
+round-trips the batched table through HBM.
+
+Why a hand kernel wins here (and what it does differently from wgl3's
+XLA formulation):
+  * The table u32[S, W] for typical geometry (S=8 states, K=12 slots ⇒
+    W=2^7=128 words) is EXACTLY one (8,128) VPU tile. The kernel carries
+    it as a loop value — zero HBM traffic between steps; XLA's scan over
+    a [B, S, W] batch streams ~1 MiB of table (plus closure temporaries)
+    per step.
+  * The per-history closure `while_loop` converges independently per
+    program. Under `vmap`, XLA lock-steps the loop across the whole batch
+    (every history pays the slowest history's round count per step).
+  * The mask-bit exposure for slot j >= 5, a [S, hi, 2, lo] reshape in
+    XLA (a lane shuffle), becomes a static lane ROLL by 2^(j-5): firing
+    slot j moves a config from word w (bit j-5 clear) to word w + 2^(j-5)
+    (pltpu.roll + iota mask — the VPU-native formulation).
+  * Transition matrices are pre-bitpacked host-side to column masks
+    colmask[r, s', j] = bitmask over SOURCE states s (S <= 32 fits u32),
+    so the state OR-reduce is S broadcast-selects per slot with no
+    scalar loads: sel[s'] = (colmask[:, j] >> s) & 1, a [S,1]x[1,W]
+    broadcast against table row s.
+
+Layout contract (prepare_pallas_batch):
+  colmask  u32[B, R, Sp, 128]   Sp = S padded to 8 sublanes; lane axis is
+                                the slot j (K <= 128); one (8,128) tile
+                                per return step.
+  targets  i32[B, R]            target slot per return step, -1 = pad.
+
+The kernel is exact (dense table = whole config space, no overflow), so
+results match wgl3 bit-for-bit; tests run it in interpreter mode on CPU
+against the XLA kernel and the oracle (tests/test_wgl3_pallas.py).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..models.base import Model
+from .encode import EncodedHistory
+from .wgl3 import DenseConfig, _LO_MASK, batch_arrays3, dense_config
+
+# The kernel unrolls the slot sweep K times and carries a [S, 2^(K-5)]
+# table as registers/VMEM; cap K so the table stays a handful of tiles
+# (K=16 -> u32[8, 2048] = 64 KiB) and compile time stays sane.
+MAX_K_PALLAS = 16
+
+# Return steps per colmask block (grid chunking of the step axis): 512
+# steps x (8,128) u32 = 2 MiB per block, double-buffered well inside the
+# 16 MiB VMEM budget, while histories <= 512 steps stay single-chunk.
+STEP_CHUNK = 512
+
+
+def prepare_pallas_batch(model: Model, cfg: DenseConfig, slot_tabs, slot_active,
+                         targets):
+    """Host/XLA-side prep: transition matrices -> bit-packed column masks.
+
+    slot_tabs [B,R,K,4] i32, slot_active [B,R,K] bool, targets [B,R] i32
+    (the batched return-major arrays of wgl3.batch_arrays3).
+    Returns (colmask u32[B,R,Sp,128], targets i32[B,R]).
+    """
+    K, S, off = cfg.k_slots, cfg.n_states, cfg.state_offset
+    state_vals = jnp.arange(S, dtype=jnp.int32) - off
+    s_ids = jnp.arange(S, dtype=jnp.int32)
+
+    def trans_one(row, active):
+        legal, nxt = model.step(state_vals, row[0], row[1], row[2], row[3])
+        nxt_row = nxt + off
+        ok = legal & (nxt_row >= 0) & (nxt_row < S) & active
+        return ok[:, None] & (nxt_row[:, None] == s_ids[None, :])  # [S,S']
+
+    def pack(tabs, act):                      # [R,K,4],[R,K] for one history
+        tj = jax.vmap(jax.vmap(trans_one))(tabs, act)      # [R,K,S,S'] bool
+        bits = (tj.astype(jnp.uint32)
+                << jnp.arange(S, dtype=jnp.uint32)[None, None, :, None])
+        colmask = jnp.sum(bits, axis=2, dtype=jnp.uint32)  # [R,K,S'] over s
+        colmask = jnp.swapaxes(colmask, 1, 2)              # [R,S',K]
+        sp = max(8, (S + 7) // 8 * 8)
+        return jnp.pad(colmask, ((0, 0), (0, sp - S), (0, 128 - K)))
+
+    colmask = jax.vmap(pack)(slot_tabs, slot_active)
+    return colmask, targets.astype(jnp.int32)
+
+
+def _kernel_body(cfg: DenseConfig):
+    K, S, off = cfg.k_slots, cfg.n_states, cfg.state_offset
+    W = 1 << (K - 5)
+    Sp = max(8, (S + 7) // 8 * 8)
+    init_row = None  # bound in closure below
+
+    # NB: every jnp array used by the kernel is constructed INSIDE `body`
+    # (pallas kernels may not capture traced constants from build time;
+    # Python ints become literals, which is fine).
+
+    def _lane():
+        return jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
+
+    def allowed_mask(t):
+        """u32[1, W]: positions whose config mask has bit t CLEAR."""
+        full = jnp.uint32(0xFFFFFFFF)
+        inword = jnp.uint32(_LO_MASK[4])
+        for b in range(3, -1, -1):
+            inword = jnp.where(t == b, jnp.uint32(_LO_MASK[b]), inword)
+        word_ok = ((_lane() >> jnp.maximum(t - 5, 0)) & 1) == 0
+        return jnp.where(t < 5, jnp.broadcast_to(inword, (1, W)),
+                         jnp.where(word_ok, full, jnp.uint32(0)))
+
+    def closure(T, cm, allowed):
+        """One Gauss-Seidel sweep over all K slots (static unroll)."""
+        for j in range(K):
+            src = T & allowed                                # [Sp, W]
+            col = cm[:, j:j + 1]                             # u32[Sp, 1]
+            fired = jnp.zeros_like(T)
+            for s in range(S):
+                sel = ((col >> jnp.uint32(s)) & 1) != 0      # [Sp,1]
+                fired = fired | jnp.where(sel, src[s:s + 1, :],
+                                          jnp.uint32(0))
+            if j < 5:
+                T = T | ((fired & jnp.uint32(_LO_MASK[j]))
+                         << jnp.uint32(1 << j))
+            else:
+                d = 1 << (j - 5)
+                tgt = ((_lane() >> (j - 5)) & 1) == 1        # bit-set lanes
+                T = T | jnp.where(tgt, pltpu.roll(fired, d, axis=1),
+                                  jnp.uint32(0))
+        return T
+
+    def prune(T, t, allowed):
+        def br(j):
+            def f(_):
+                if j < 5:
+                    return (T >> jnp.uint32(1 << j)) & allowed
+                d = 1 << (j - 5)
+                return pltpu.roll(T, W - d, axis=1) & allowed
+            return f
+        return jax.lax.switch(t, [br(j) for j in range(K)], None)
+
+    def body(tg_ref, cm_ref, out_ref, T_s, meta_s):
+        """Grid is (B, NC): history b, step-chunk c. The colmask block is
+        one RC-step chunk (long histories would blow the 16 MiB VMEM limit
+        as a single block); the search state (table + metadata) carries
+        across chunks in scratch, which persists over the sequential TPU
+        grid."""
+        b = pl.program_id(0)
+        c = pl.program_id(1)
+        NC = pl.num_programs(1)
+        RC = cm_ref.shape[1]
+
+        @pl.when(c == 0)
+        def _init():
+            # Initial table: bit 0 of word 0 in the init-state row (built
+            # with iota masks — scatter has no Mosaic lowering).
+            rows = jax.lax.broadcasted_iota(jnp.int32, (Sp, W), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (Sp, W), 1)
+            T_s[:, :] = jnp.where((rows == init_row) & (cols == 0),
+                                  jnp.uint32(1), jnp.uint32(0))
+            meta_s[0] = 0    # dead
+            meta_s[1] = -1   # dead_step
+            meta_s[2] = 1    # max_frontier
+            meta_s[3] = 0    # configs_explored
+
+        def step(i, carry):
+            T, dead, dead_step, maxf, cfgs = carry
+            r = c * RC + i
+            t_raw = tg_ref[b, r]
+            is_pad = t_raw < 0
+            t = jnp.maximum(t_raw, 0)
+            allowed = allowed_mask(t)
+            cm = cm_ref[0, i]                                # u32[Sp, 128]
+
+            def wbody(st):
+                Tw, n_prev, _ch, rounds = st
+                Tw = closure(Tw, cm, allowed)
+                n_now = jnp.sum(jax.lax.population_count(Tw),
+                                dtype=jnp.int32)
+                return Tw, n_now, n_now > n_prev, rounds + 1
+
+            def wcond(st):
+                return st[2] & (st[3] < cfg.rounds)
+
+            n0 = jnp.sum(jax.lax.population_count(T), dtype=jnp.int32)
+            T, n, _c, _r = jax.lax.while_loop(
+                wcond, wbody, (T, n0, ~is_pad, jnp.int32(0)))
+
+            pruned = prune(T, t, allowed)
+            T_new = jnp.where(is_pad, T, pruned)
+            alive = jnp.any(T_new != 0)
+            died = ~is_pad & ~dead & ~alive
+            dead = dead | died
+            T_new = jnp.where(dead, jnp.zeros_like(T_new), T_new)
+            return (T_new, dead,
+                    jnp.where(died & (dead_step < 0), r, dead_step),
+                    jnp.maximum(maxf, n),
+                    # Pad steps (scan-bucket AND chunk-alignment pads) must
+                    # not count: keeps the metric padding-invariant and
+                    # bit-identical to the XLA kernel whatever the chunking.
+                    cfgs + jnp.where(is_pad, 0, n))
+
+        # cfgs accumulates as i32 (a scalar f32 bitcast has no Mosaic
+        # lowering); exact up to 2^31 summed configs, beyond which the f32
+        # accumulator of the XLA kernel is approximate anyway.
+        init = (T_s[:, :], meta_s[0] != 0, meta_s[1], meta_s[2], meta_s[3])
+        T, dead, dead_step, maxf, cfgs = jax.lax.fori_loop(0, RC, step, init)
+        T_s[:, :] = T
+        meta_s[0] = dead.astype(jnp.int32)
+        meta_s[1] = dead_step
+        meta_s[2] = maxf
+        meta_s[3] = cfgs
+
+        # ONE flat whole-[5B] 1-D SMEM output block, each program writing
+        # its 5 slots (the wgl3 PACKED_FIELDS layout, so the host unpacks
+        # both kernels' results identically). Shape matters enormously
+        # here: separate [B] output blocks (or one 2-D [B,5] block) cost
+        # ~0.33 s/launch at B=256 in per-program block flushes — 3x the
+        # whole search — and the TPU lowering rejects 1-element blocks
+        # outright, so per-program blocks are not an option either.
+        @pl.when(c == NC - 1)
+        def _emit():
+            out_ref[5 * b + 0] = jnp.where(dead, 0, 1).astype(jnp.int32)
+            out_ref[5 * b + 1] = jnp.int32(0)  # overflow: impossible (dense)
+            out_ref[5 * b + 2] = dead_step
+            out_ref[5 * b + 3] = maxf
+            out_ref[5 * b + 4] = cfgs
+
+    def bind(row):
+        nonlocal init_row
+        init_row = row
+        return body
+
+    return bind
+
+
+def make_batch_checker_pallas(model: Model, cfg: DenseConfig,
+                              interpret: bool = False):
+    """check(slot_tabs[B,R,K,4], slot_active[B,R,K], targets[B,R]) ->
+    DEVICE i32[B, 5] packed results (wgl3.PACKED_FIELDS / unpack_np)."""
+    if cfg.k_slots > MAX_K_PALLAS:
+        raise ValueError(f"pallas kernel supports k_slots <= {MAX_K_PALLAS}, "
+                         f"got {cfg.k_slots}")
+    Sp = max(8, (cfg.n_states + 7) // 8 * 8)
+    W = 1 << (cfg.k_slots - 5)
+    row = int(model.init_state()) + cfg.state_offset
+    kernel = _kernel_body(cfg)(row)
+
+    import functools
+
+    # Two SEPARATE jits, sequenced in Python: fusing the transition prep
+    # into the same XLA program as the pallas custom-call serializes
+    # pathologically on TPU (0.54 s vs 0.12 s for the identical work at
+    # B=256); as separate dispatches they pipeline.
+    prep = jax.jit(functools.partial(prepare_pallas_batch, model, cfg))
+
+    @functools.lru_cache(maxsize=None)
+    def launch(B: int, R: int):
+        # Chunk the step axis: one colmask block of RC steps per grid
+        # iteration (a whole 10k-step history as a single block would need
+        # 32 MiB of VMEM against the 16 MiB limit); search state carries
+        # across chunks in scratch.
+        RC = min(R, STEP_CHUNK)
+        NC = (R + RC - 1) // RC
+        R_pad = NC * RC
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,   # targets: whole [B,R_pad] table, SMEM
+            grid=(B, NC),
+            in_specs=[
+                pl.BlockSpec((1, RC, Sp, 128),
+                             lambda b, c, tg_ref: (b, c, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[pl.BlockSpec((5 * B,), lambda b, c, tg_ref: (0,),
+                                    memory_space=pltpu.SMEM)],
+            scratch_shapes=[
+                pltpu.VMEM((Sp, W), jnp.uint32),   # table carry
+                pltpu.SMEM((4,), jnp.int32),        # dead/step/maxf/cfgs
+            ],
+        )
+
+        def run(tg, cm):
+            if R_pad != R:
+                tg = jnp.pad(tg, ((0, 0), (0, R_pad - R)),
+                             constant_values=-1)
+                cm = jnp.pad(cm, ((0, 0), (0, R_pad - R), (0, 0), (0, 0)))
+            return pl.pallas_call(
+                kernel,
+                grid_spec=grid_spec,
+                out_shape=[jax.ShapeDtypeStruct((5 * B,), jnp.int32)],
+                interpret=interpret,
+            )(tg, cm)[0].reshape(B, 5)
+
+        return jax.jit(run)
+
+    def check(slot_tabs, slot_active, targets):
+        """DEVICE i32[B, 5] in the wgl3 PACKED_FIELDS layout — the caller
+        fetches once and splits host-side (wgl3.unpack_np). One fetch per
+        launch is the difference between ~0.12 s and ~0.6 s per call on a
+        tunneled TPU backend (~0.1 s round trip per fetch)."""
+        colmask, tg = prep(slot_tabs, slot_active, targets)
+        B, R = targets.shape
+        return launch(B, R)(tg, colmask)
+
+    return check
+
+
+_CACHE: dict[tuple, object] = {}
+
+
+def cached_batch_checker_pallas(model: Model, cfg: DenseConfig,
+                                interpret: bool = False):
+    key = ("pallas", model.cache_key(), cfg, interpret)
+    if key not in _CACHE:
+        _CACHE[key] = make_batch_checker_pallas(model, cfg, interpret)
+    return _CACHE[key]
+
+
+def pallas_feasible(cfg: DenseConfig | None) -> bool:
+    return cfg is not None and cfg.k_slots <= MAX_K_PALLAS
+
+
+def pallas_available() -> bool:
+    """Compiled pallas path runs only on a real TPU backend (tests use
+    interpret=True explicitly on CPU)."""
+    import jax
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def use_pallas(cfg: DenseConfig | None) -> bool:
+    """Production routing predicate: dense geometry fits the kernel AND a
+    TPU backend is live."""
+    return pallas_feasible(cfg) and pallas_available()
+
+
+def check_batch_encoded_pallas(encs: Sequence[EncodedHistory],
+                               model: Model | None = None,
+                               interpret: bool = False) -> list[dict]:
+    """Batch entry point mirroring wgl3.check_batch_encoded3."""
+    from .wgl3 import assemble_batch_results, unpack_np
+
+    if model is None:
+        from ..models import CASRegister
+        model = CASRegister()
+    cfg, arrays, steps = batch_arrays3(encs, model)
+    if not pallas_feasible(cfg):
+        raise ValueError(f"pallas infeasible for k_slots={cfg.k_slots}")
+    check = cached_batch_checker_pallas(model, cfg, interpret)
+    return assemble_batch_results(unpack_np(check(*arrays)), steps, cfg)
+
+
+def packed_batch_checker(model: Model, cfg: DenseConfig):
+    """THE routing point between the two dense backends: returns
+    (packed_check_fn, kernel_name). Every production consumer (bench, the
+    Linearizable/Independent checkers) routes through here or through
+    check_batch_encoded_auto, so a feasibility/backend change lands in one
+    place."""
+    from . import wgl3
+
+    if use_pallas(cfg):
+        return cached_batch_checker_pallas(model, cfg), "wgl3-dense-pallas"
+    return wgl3.cached_batch_checker3_packed(model, cfg), "wgl3-dense"
+
+
+def check_batch_encoded_auto(encs: Sequence[EncodedHistory],
+                             model: Model | None = None
+                             ) -> tuple[list[dict], str]:
+    """Route a batch to the best dense backend for this platform; returns
+    (per-history results, kernel_name)."""
+    from .wgl3 import assemble_batch_results, unpack_np
+
+    if model is None:
+        from ..models import CASRegister
+        model = CASRegister()
+    cfg, arrays, steps = batch_arrays3(encs, model)
+    check, name = packed_batch_checker(model, cfg)
+    return assemble_batch_results(unpack_np(check(*arrays)), steps,
+                                  cfg), name

@@ -110,7 +110,8 @@ def check_corpus_multislice(encs: Sequence, model, mesh=None
     out_spec = NamedSharding(mesh, P(axes))
     fn = jax.jit(check, out_shardings={
         "survived": out_spec, "overflow": out_spec,
-        "dead_step": out_spec, "max_frontier": out_spec})
+        "dead_step": out_spec, "max_frontier": out_spec,
+        "configs_explored": out_spec})
     out = fn(*global_arrays)
     gathered = {k: np.asarray(multihost_utils.process_allgather(
         v, tiled=True)) for k, v in out.items()}
@@ -119,6 +120,8 @@ def check_corpus_multislice(encs: Sequence, model, mesh=None
         one = {k: gathered[k][i].item() for k in gathered}
         one["valid"] = verdict(one)
         one["op_count"] = s.n_ops
+        # int like every other backend (the dict path carries f32).
+        one["configs_explored"] = int(one["configs_explored"])
         results.append(one)
     return results
 

@@ -167,3 +167,24 @@ def test_independent_batched_dense_detects_bad_key():
     assert res["results"]["0"]["backend"] == "jax-dense-batched"
     assert res["results"]["2"]["backend"] == "jax-dense"
     assert res["results"]["2"]["failed_op"] == "read -> 4"
+
+
+def test_configs_explored_metric():
+    """SURVEY.md §5.1: the checker reports configs explored (the search's
+    unit of work) on both the single and batched dense paths, and the
+    count is sane: at least one config per return step, bounded by the
+    table size times steps."""
+    from jepsen_etcd_demo_tpu.checkers import Linearizable
+    rng = random.Random(0x5EC)
+    h = gen_register_history(rng, n_ops=60, n_procs=6)
+    res = Linearizable(backend="jax").check({}, h)
+    n_returns = sum(1 for op in h if op.type in ("ok", "info"))
+    assert res["configs_explored"] >= n_returns
+    assert res["configs_explored"] <= res["f_cap"] * (2 * n_returns + 2)
+
+    encs = [encode_register_history(
+        gen_register_history(random.Random(i), n_ops=40, n_procs=5),
+        k_slots=16) for i in range(3)]
+    from jepsen_etcd_demo_tpu.ops import wgl3
+    batch = wgl3.check_batch_encoded3(encs, CASRegister())
+    assert all(one["configs_explored"] > 0 for one in batch)

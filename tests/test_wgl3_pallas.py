@@ -1,0 +1,115 @@
+"""Pallas fused-scan dense WGL kernel (ops/wgl3_pallas.py).
+
+Runs in interpreter mode on the virtual-CPU platform (conftest forces it),
+differentially against the XLA dense kernel (ops/wgl3.py) and the oracle —
+the pallas kernel must agree bit-for-bit on every field, including the
+search metrics. The compiled path is exercised on real TPU by bench.py.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from jepsen_etcd_demo_tpu.checkers.oracle import check_events_oracle
+from jepsen_etcd_demo_tpu.models import CASRegister
+from jepsen_etcd_demo_tpu.ops import wgl3, wgl3_pallas
+from jepsen_etcd_demo_tpu.ops.encode import encode_register_history
+from jepsen_etcd_demo_tpu.utils.fuzz import (gen_register_history,
+                                             mutate_history)
+
+from golden import GOLDEN
+
+MODEL = CASRegister()
+FIELDS = ("valid", "dead_step", "max_frontier", "configs_explored")
+
+
+def _pallas(encs):
+    return wgl3_pallas.check_batch_encoded_pallas(encs, MODEL,
+                                                  interpret=True)
+
+
+def test_golden_histories():
+    encs, verdicts = [], []
+    for name, hist, expected in GOLDEN:
+        encs.append(encode_register_history(hist, k_slots=16))
+        verdicts.append(expected)
+    for one, expected, (name, _, _) in zip(_pallas(encs), verdicts, GOLDEN):
+        assert one["valid"] is expected, name
+
+
+def test_differential_vs_xla_kernel():
+    """Fuzzed valid + mutated histories: every result field must match the
+    XLA dense kernel exactly (same search, same metrics)."""
+    encs = []
+    for i in range(12):
+        h = gen_register_history(random.Random(i), n_ops=70, n_procs=8,
+                                 p_info=0.01)
+        if i % 2:
+            h = mutate_history(random.Random(1000 + i), h)
+        encs.append(encode_register_history(h, k_slots=16))
+    ref = wgl3.check_batch_encoded3(encs, MODEL)
+    pal = _pallas(encs)
+    for r, p in zip(ref, pal):
+        for f in FIELDS:
+            assert r[f] == p[f], f
+
+
+def test_differential_vs_oracle_single():
+    for i in range(4):
+        h = gen_register_history(random.Random(50 + i), n_ops=50, n_procs=6)
+        enc = encode_register_history(h, k_slots=16)
+        want = check_events_oracle(enc, MODEL).valid
+        assert _pallas([enc])[0]["valid"] is want
+
+
+def test_step_chunking_long_history():
+    """R > STEP_CHUNK forces the multi-chunk grid with scratch-carried
+    search state; results must match the single-block XLA kernel."""
+    h = gen_register_history(random.Random(9), n_ops=1500, n_procs=8,
+                             p_info=0.0005)
+    enc = encode_register_history(h, k_slots=32)
+    steps = wgl3.step_bucket(
+        sum(1 for op in h if op.type in ("ok", "info")))
+    assert steps > wgl3_pallas.STEP_CHUNK, "test must exercise chunking"
+    r = wgl3.check_encoded3(enc, MODEL)
+    p = _pallas([enc])[0]
+    for f in FIELDS:
+        assert r[f] == p[f], f
+
+
+def test_feasibility_and_routing():
+    assert not wgl3_pallas.pallas_feasible(None)
+    cfg = wgl3.DenseConfig(k_slots=18, n_states=8, state_offset=1)
+    assert not wgl3_pallas.pallas_feasible(cfg)   # K > MAX_K_PALLAS
+    ok = wgl3.DenseConfig(k_slots=12, n_states=8, state_offset=1)
+    assert wgl3_pallas.pallas_feasible(ok)
+    # Tests run on the virtual CPU platform: the compiled-pallas routing
+    # predicate must refuse (interpret mode is opt-in for tests only).
+    assert not wgl3_pallas.pallas_available()
+    assert not wgl3_pallas.use_pallas(ok)
+
+
+def test_infeasible_k_raises():
+    with pytest.raises(ValueError):
+        wgl3_pallas.make_batch_checker_pallas(
+            MODEL, wgl3.DenseConfig(k_slots=20, n_states=8, state_offset=1))
+
+
+def test_chunk_alignment_pads_do_not_count():
+    """Step buckets that are NOT multiples of STEP_CHUNK (e.g. 768) force
+    chunk-alignment padding; those pad steps must not inflate
+    configs_explored (regression: pallas counted them, XLA did not)."""
+    h = gen_register_history(random.Random(77), n_ops=800, n_procs=8,
+                             p_info=0.0005)
+    enc = encode_register_history(h, k_slots=32)
+    bucket = wgl3.step_bucket(
+        sum(1 for op in h if op.type in ("ok", "info")))
+    assert bucket > wgl3_pallas.STEP_CHUNK
+    assert bucket % wgl3_pallas.STEP_CHUNK != 0, \
+        "test must exercise chunk-alignment padding"
+    r = wgl3.check_encoded3(enc, MODEL)
+    p = _pallas([enc])[0]
+    for f in FIELDS:
+        assert r[f] == p[f], f
