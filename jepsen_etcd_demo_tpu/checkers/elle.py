@@ -1,0 +1,251 @@
+"""Elle-equivalent transactional anomaly checker (list-append workload).
+
+The reference's dependency tree ships elle 0.1.2 (jepsen.etcdemo.iml:46,
+reached transitively through jepsen.checker — SURVEY.md §2.2 lists it as a
+dependency component; round-1 scope deferred it). This is the TPU-first
+re-design of that capability for the canonical list-append workload:
+
+  txn ops: Op(f="txn", value=[micro-op, ...]) with micro-ops
+      ("append", k, v)  — append v to the list under key k
+      ("r", k, vs)      — read the list under k (vs: None on invoke,
+                           tuple/list of appended values on :ok)
+
+Inference (elle's core trick): appends to a key are OBSERVABLE as list
+prefixes, so any read totally orders every append it observed —
+  * two reads of one key must be prefix-compatible   (else :incompatible-order)
+  * consecutive observed values e_i, e_i+1 give a ww edge
+    writer(e_i) -> writer(e_i+1)
+  * a read ending at e gives a wr edge writer(e) -> reader
+  * a read ending at e, with e' next in the observed order, gives an rw
+    (anti-dependency) edge reader -> writer(e')
+
+Anomalies (elle's taxonomy):
+  * G1a aborted read       — read observes a value appended by a :fail txn
+  * G1b intermediate read  — read observes a txn's non-final state of a key
+  * incompatible-order     — reads of one key disagree beyond prefixing
+  * G0 write cycle         — cycle in ww
+  * G1c circular info      — cycle in ww|wr (with >= 1 wr)
+  * G-single               — cycle in ww|wr|rw with exactly one rw
+  * G2-item                — cycle with >= 2 rw edges
+
+Cycle search runs on the dense adjacency matrix via MXU matrix-squaring
+closure (ops/cycles.py); the found cycle is reconstructed host-side as the
+witness. :info txns are treated soundly: their appends may legitimately be
+observed (never G1a) but contribute no graph edges (their order is
+unknowable), so no anomaly can be fabricated from an indeterminate txn.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Sequence
+
+import numpy as np
+
+from .base import Checker
+from ..ops.cycles import bfs_path, extract_cycle, reach_and_cycles
+from ..ops.op import Op
+
+
+class TxnEncodeError(ValueError):
+    pass
+
+
+def _pair_txns(history: Sequence[Op]):
+    """Invoke/completion pairing by process (the runner guarantees one
+    outstanding op per process). Returns list of
+    (invoke_value, completion_type, completion_value)."""
+    pending: dict[Any, Op] = {}
+    txns = []
+    for op in history:
+        if op.process == "nemesis":   # fault-plane channel, not a txn
+            continue
+        if op.f != "txn":
+            raise TxnEncodeError(f"non-txn op {op.f!r} in txn history")
+        if op.type == "invoke":
+            if op.process in pending:
+                raise TxnEncodeError(f"process {op.process} double-invoke")
+            pending[op.process] = op
+        elif op.type in ("ok", "fail", "info"):
+            inv = pending.pop(op.process, None)
+            if inv is None:
+                raise TxnEncodeError(f"completion without invoke: {op}")
+            txns.append((inv.value, op.type,
+                         op.value if op.type == "ok" else inv.value))
+    for inv in pending.values():   # still-open at history end = info
+        txns.append((inv.value, "info", inv.value))
+    return txns
+
+
+class ElleChecker(Checker):
+    """checker/elle equivalent over list-append txn histories."""
+
+    name = "elle"
+
+    def check(self, test: dict, history: Sequence[Op],
+              opts: dict | None = None) -> dict[str, Any]:
+        txns = _pair_txns(history)
+        oks = [t for t in txns if t[1] == "ok"]
+        n = len(oks)
+        anomalies: dict[str, list] = defaultdict(list)
+
+        # Ownership maps per key.
+        append_of: dict[tuple, int] = {}      # (k, v) -> ok txn idx
+        failed_vals: set[tuple] = set()
+        info_vals: set[tuple] = set()
+        multi_appends: dict[tuple, list] = defaultdict(list)  # per (txn,k)
+        for i, (_, _, value) in enumerate(oks):
+            for mop in value:
+                if mop[0] == "append":
+                    k, v = mop[1], mop[2]
+                    if (k, v) in append_of:
+                        raise TxnEncodeError(
+                            f"append value {v!r} reused for key {k!r}")
+                    append_of[(k, v)] = i
+                    multi_appends[(i, k)].append(v)
+        for value, typ, _ in txns:
+            if typ in ("fail", "info"):
+                for mop in value:
+                    if mop[0] == "append":
+                        (failed_vals if typ == "fail" else
+                         info_vals).add((mop[1], mop[2]))
+
+        # Reads grouped per key: (reader_idx, observed tuple).
+        reads: dict[Any, list] = defaultdict(list)
+        for i, (_, _, value) in enumerate(oks):
+            for mop in value:
+                if mop[0] == "r" and mop[2] is not None:
+                    reads[mop[1]].append((i, tuple(mop[2])))
+
+        # G1a / G1b and the per-key observed version order.
+        order: dict[Any, tuple] = {}
+        for k, obs in reads.items():
+            for reader, vs in obs:
+                for v in vs:
+                    if (k, v) in failed_vals and (k, v) not in append_of:
+                        anomalies["G1a"].append(
+                            {"key": k, "value": v, "reader": reader})
+                if vs:
+                    owner = append_of.get((k, vs[-1]))
+                    if owner is not None:
+                        own = multi_appends[(owner, k)]
+                        if own and vs[-1] != own[-1] and owner != reader:
+                            anomalies["G1b"].append(
+                                {"key": k, "value": vs[-1],
+                                 "reader": reader, "writer": owner})
+            # Prefix-compatibility: ascending by length, every read must
+            # extend the previous longest (two equal-length reads that
+            # differ fail the prefix test directly).
+            longest = ()
+            for _, vs in sorted(obs, key=lambda rv: len(rv[1])):
+                if vs[:len(longest)] != longest:
+                    anomalies["incompatible-order"].append(
+                        {"key": k, "read_a": list(longest),
+                         "read_b": list(vs)})
+                    break
+                longest = vs
+            order[k] = longest
+
+        # Dependency edges over ok txns.
+        ww = np.zeros((n, n), bool)
+        wr = np.zeros((n, n), bool)
+        rw = np.zeros((n, n), bool)
+        pos = {}
+        for k, longest in order.items():
+            for j, v in enumerate(longest):
+                pos[(k, v)] = j
+            for a, b in zip(longest, longest[1:]):
+                wa, wb = append_of.get((k, a)), append_of.get((k, b))
+                if wa is not None and wb is not None and wa != wb:
+                    ww[wa, wb] = True
+        for k, obs in reads.items():
+            longest = order[k]
+            for reader, vs in obs:
+                if vs:
+                    wa = append_of.get((k, vs[-1]))
+                    if wa is not None and wa != reader:
+                        wr[wa, reader] = True
+                nxt_idx = len(vs)
+                if nxt_idx < len(longest):
+                    wb = append_of.get((k, longest[nxt_idx]))
+                    if wb is not None and wb != reader:
+                        rw[reader, wb] = True
+
+        self._find_cycles(ww, wr, rw, oks, anomalies)
+
+        types = sorted(anomalies)
+        return {
+            "valid": not types,
+            "anomaly_types": types,
+            "anomalies": {t: anomalies[t] for t in types},
+            "txn_count": n,
+            "edge_counts": {"ww": int(ww.sum()), "wr": int(wr.sum()),
+                            "rw": int(rw.sum())},
+            "backend": "jax-mxu-closure",
+        }
+
+    # -- cycle classification --------------------------------------------
+    def _find_cycles(self, ww, wr, rw, oks, anomalies):
+        def witness(cyc):
+            return {"cycle": cyc,
+                    "txns": [list(oks[i][2]) for i in cyc[:-1]]}
+
+        # Full graph first: acyclic full graph implies every subset is
+        # acyclic — ONE closure launch on the (common) valid path.
+        full = ww | wr | rw
+        reach_f, cyc_f = reach_and_cycles(full)
+        if not cyc_f.any():
+            return
+        reach_ww, cyc_ww = reach_and_cycles(ww)
+        if cyc_ww.any():
+            anomalies["G0"].append(witness(
+                extract_cycle(ww, reach_ww, cyc_ww)))
+        g1 = ww | wr
+        reach_g1, cyc_g1 = reach_and_cycles(g1)
+        if cyc_g1.any() and not cyc_ww.any():
+            anomalies["G1c"].append(witness(
+                extract_cycle(g1, reach_g1, cyc_g1)))
+        if not cyc_g1.any():
+            # Cycles need rw edges. G-single holds iff SOME rw edge is
+            # closed by a ww|wr-only path (exactly one anti-dependency) —
+            # exact, unlike counting rw edges on one arbitrary extracted
+            # cycle, which can mis-classify when 1-rw and 2-rw cycles
+            # coexist.
+            for a, b in zip(*np.nonzero(rw & ~g1)):
+                if reach_g1[b, a]:
+                    back = bfs_path(g1, int(b), int(a))  # [b, ..., a]
+                    anomalies["G-single"].append(witness([int(a)] + back))
+                    break
+            else:
+                anomalies["G2-item"].append(witness(
+                    extract_cycle(full, reach_f, cyc_f)))
+
+
+# -- pure-Python oracle (differential tests) -----------------------------
+
+def tarjan_has_cycle(adj: np.ndarray) -> bool:
+    """Iterative DFS cycle detection — the CPU oracle the MXU closure is
+    differentially tested against."""
+    n = adj.shape[0]
+    color = [0] * n   # 0 white, 1 grey, 2 black
+    for root in range(n):
+        if color[root]:
+            continue
+        stack = [(root, iter(np.flatnonzero(adj[root])))]
+        color[root] = 1
+        while stack:
+            node, it = stack[-1]
+            adv = False
+            for s in it:
+                s = int(s)
+                if color[s] == 1:
+                    return True
+                if color[s] == 0:
+                    color[s] = 1
+                    stack.append((s, iter(np.flatnonzero(adj[s]))))
+                    adv = True
+                    break
+            if not adv:
+                color[node] = 2
+                stack.pop()
+    return False

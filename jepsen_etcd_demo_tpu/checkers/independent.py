@@ -162,7 +162,10 @@ def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]]
     encs: dict[Any, ReturnSteps] = {}
     for k, e in event_encs.items():
         if e.k_slots != k_slots:
-            e = encode_register_history(keyed[k], k_slots=k_slots)
+            # Re-encode through the model's op translation (mutex
+            # acquire/release -> cas) exactly as lin.encode did above.
+            e = encode_register_history(
+                lin.model.prepare_history(keyed[k]), k_slots=k_slots)
         encs[k] = encode_return_steps(e)
     r_cap = max(1, max(e.slot_tabs.shape[0] for e in encs.values()))
     keys = list(encs)

@@ -44,6 +44,9 @@ class Linearizable(Checker):
 
     # -- encoding ---------------------------------------------------------
     def encode(self, history: Sequence[Op]) -> EncodedHistory:
+        return self._encode_translated(self.model.prepare_history(history))
+
+    def _encode_translated(self, history: Sequence[Op]) -> EncodedHistory:
         k = self.k_slots
         while True:
             try:
@@ -56,7 +59,10 @@ class Linearizable(Checker):
     # -- checking ---------------------------------------------------------
     def check(self, test: dict, history: Sequence[Op],
               opts: dict | None = None) -> dict[str, Any]:
-        enc = self.encode(history)
+        # Translate ONCE (e.g. mutex acquire/release -> cas) so the
+        # witness replay below sees the same op language the encoder did.
+        history = self.model.prepare_history(history)
+        enc = self._encode_translated(history)
         if enc.n_events == 0:
             return {"valid": True, "op_count": 0, "backend": self.backend}
         if self.backend == "oracle":

@@ -133,8 +133,8 @@ def cmd_test(args) -> int:
 
 def cmd_analyze(args) -> int:
     from ..store.store import RunDir
-    from ..checkers import (Compose, IndependentChecker, Linearizable,
-                            SetChecker, TimelineChecker)
+    from ..checkers import (Compose, ElleChecker, IndependentChecker,
+                            Linearizable, SetChecker, TimelineChecker)
     from ..checkers.perf import PerfChecker
 
     run = RunDir(args.run_dir)
@@ -142,6 +142,8 @@ def cmd_analyze(args) -> int:
     if args.workload == "set":
         sub = SetChecker()
         checker = Compose({"perf": PerfChecker(), "indep": sub})
+    elif args.workload == "append":
+        checker = Compose({"perf": PerfChecker(), "indep": ElleChecker()})
     else:
         checker = Compose({"perf": PerfChecker(),
                            "indep": IndependentChecker(Compose({

@@ -121,6 +121,51 @@ class FakeKVStore:
             await asyncio.sleep(self.op_delay_s * self.rng.random())
         return applied
 
+    async def txn(self, node: str, mops: list) -> list:
+        """Atomic multi-key transaction over micro-ops (elle's list-append
+        workload; no reference-demo counterpart — the fake cluster stands
+        in for a transactional store so the elle checker has an end-to-end
+        path). Micro-ops: ("append", k, v) appends v to the list under k;
+        ("r", k, None) reads the list. Returns completed micro-ops with
+        reads filled in. Injected bugs: lost_write_prob drops an acked
+        append; stale_read_prob serves a read from an old snapshot (both
+        elle-detectable anomalies)."""
+        maybe_timeout = node in self.isolated
+        if maybe_timeout and self.rng.random() >= self.partial_apply_prob:
+            raise Timeout(f"node {node} partitioned")
+        out = []
+        written: set = set()
+        async with self.lock:
+            self._snapshot()
+            for mop in mops:
+                f, k, v = mop
+                if f == "append":
+                    if self.rng.random() >= self.lost_write_prob:
+                        cur = self.data.get(k)
+                        cur = () if not isinstance(cur, tuple) else cur
+                        self.data[k] = cur + (v,)
+                    written.add(k)
+                    out.append(("append", k, v))
+                elif f == "r":
+                    src = self.data
+                    # Stale reads never hide the txn's OWN earlier append
+                    # (read-your-writes inside a txn is assumed even by
+                    # the buggy store; elle's "internal" check is out of
+                    # scope here).
+                    if (k not in written and self.snapshots
+                            and self.rng.random() < self.stale_read_prob):
+                        src = self.rng.choice(self.snapshots)
+                    cur = src.get(k)
+                    cur = () if not isinstance(cur, tuple) else cur
+                    out.append(("r", k, cur))
+                else:
+                    raise ValueError(f"unknown micro-op {f!r}")
+        if maybe_timeout:
+            raise Timeout(f"node {node} partitioned (txn applied)")
+        if self.op_delay_s:
+            await asyncio.sleep(self.op_delay_s * self.rng.random())
+        return out
+
     async def swap(self, node: str, key: str, fn) -> Any:
         """Atomic read-modify-write retry loop — verschlimmbesserung's swap!
         (reference set.clj:26-31 uses it for set adds)."""

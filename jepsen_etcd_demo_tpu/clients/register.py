@@ -89,6 +89,9 @@ class _BoundFakeConn:
     async def swap(self, key, fn):
         return await self.store.swap(self.node, key, fn)
 
+    async def txn(self, mops):
+        return await self.store.txn(self.node, mops)
+
 
 def fake_conn_factory(store):
     def factory(test, node):

@@ -1,0 +1,50 @@
+"""Txn client for the elle list-append workload.
+
+No direct reference-demo counterpart (the demo never drives elle, it only
+ships it as a dependency — jepsen.etcdemo.iml:46); the client follows the
+same 5-method protocol and error mapping shape as the register client
+(reference src/jepsen/etcdemo.clj:76-108): a timeout on a txn that may
+have written is indeterminate -> :info; a pure-read txn can safely
+:fail.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..ops.op import Op
+from .base import Client, ClientError, Timeout, completed
+
+
+class TxnClient(Client):
+    """conn_factory(test, node) -> connection exposing async txn(mops)."""
+
+    def __init__(self, conn_factory: Callable, conn=None):
+        self.conn_factory = conn_factory
+        self.conn = conn
+
+    async def open(self, test: dict, node: str) -> "TxnClient":
+        conn = self.conn_factory(test, node)
+        if hasattr(conn, "__await__"):
+            conn = await conn
+        return TxnClient(self.conn_factory, conn)
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        if op.f != "txn":
+            raise ValueError(f"unknown op f={op.f!r}")
+        try:
+            done = await self.conn.txn(list(op.value))
+            return completed(op, "ok", value=done)
+        except Timeout:
+            writes = any(m[0] == "append" for m in op.value)
+            return completed(op, "info" if writes else "fail",
+                             error="timeout")
+        except ClientError as e:
+            return completed(op, "fail", error=str(e))
+
+    async def close(self, test: dict) -> None:
+        close = getattr(self.conn, "close", None)
+        if close is not None:
+            res = close()
+            if hasattr(res, "__await__"):
+                await res

@@ -96,9 +96,48 @@ def set_workload(opts: dict, conn_factory: Callable) -> dict:
     }
 
 
+def append_workload(opts: dict, conn_factory: Callable) -> dict:
+    """Elle list-append workload: random multi-key txns of reads and
+    appends (values unique per key), checked by the MXU-backed elle
+    checker (checkers/elle.py). No reference-demo counterpart — the demo
+    only ships elle as a dependency (jepsen.etcdemo.iml:46) — but the
+    capability is part of the dependency surface SURVEY.md §2.2 lists.
+    Requires a transactional connection (the fake cluster provides txn();
+    etcd v2 has no transactions)."""
+    from .checkers.elle import ElleChecker
+    from .clients.txn import TxnClient
+
+    n_keys = int(opts.get("txn_keys", 3))
+    max_len = int(opts.get("txn_len", 4))
+    counters: dict = {}
+
+    def txn_gen(ctx):
+        mops = []
+        for _ in range(1 + ctx.rng.randrange(max_len)):
+            k = f"k{ctx.rng.randrange(n_keys)}"
+            if ctx.rng.random() < 0.5:
+                mops.append(("r", k, None))
+            else:
+                counters[k] = counters.get(k, 0) + 1
+                mops.append(("append", k, counters[k]))
+        return {"f": "txn", "value": mops}
+
+    return {
+        "client": TxnClient(conn_factory),
+        "checker": ElleChecker(),
+        "generator": gen.repeat(txn_gen),
+        # Final phase: one read-everything txn after healing, so the tail
+        # of appends is observed (tightens the inferred version order).
+        "final_generator": gen.once({
+            "f": "txn",
+            "value": [("r", f"k{i}", None) for i in range(n_keys)]}),
+    }
+
+
 WORKLOADS = {
     "register": register_workload,
     "set": set_workload,
+    "append": append_workload,
 }
 
 

@@ -8,11 +8,13 @@ functions: `step_py` (Python scalars, used by the oracle checker) and `step`
 
 from .base import Model  # noqa: F401
 from .cas_register import CASRegister  # noqa: F401
+from .mutex import Mutex  # noqa: F401
 from .register import Register  # noqa: F401
 
 REGISTRY = {
     "cas-register": CASRegister,
     "register": Register,
+    "mutex": Mutex,
 }
 
 

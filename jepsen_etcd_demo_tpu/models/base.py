@@ -58,6 +58,12 @@ class Model(abc.ABC):
         cache keys must have identical step semantics."""
         return (self.name, self.init_state())
 
+    def prepare_history(self, history):
+        """Model-level op translation applied before encoding (e.g. the
+        mutex model rewrites acquire/release into CAS ops). Identity by
+        default; must return Ops the register encoder accepts."""
+        return history
+
     @abc.abstractmethod
     def init_state(self) -> int:
         ...

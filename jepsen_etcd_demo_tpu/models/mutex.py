@@ -1,0 +1,40 @@
+"""Mutex model — knossos model/mutex equivalent.
+
+Part of the knossos model surface the reference ships (knossos 0.3.7,
+jepsen.etcdemo.iml:58; the demo itself only instantiates cas-register at
+src/jepsen/etcdemo.clj:117). Semantics: `acquire` is legal iff unlocked,
+`release` iff locked — i.e. exactly a CAS register over {0 unlocked,
+1 locked} with acquire = cas(0->1) and release = cas(1->0). The model
+therefore REUSES the CAS step function (same kernel, same packing) and
+contributes only the op translation, applied before encoding via
+prepare_history().
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Sequence
+
+from .cas_register import CASRegister
+from ..ops.op import Op
+
+UNLOCKED, LOCKED = 0, 1
+
+
+class Mutex(CASRegister):
+    name = "mutex"
+
+    def __init__(self):
+        super().__init__(initial=UNLOCKED)
+
+    def prepare_history(self, history: Sequence[Op]) -> list[Op]:
+        out = []
+        for op in history:
+            if op.f == "acquire":
+                out.append(replace(op, f="cas", value=(UNLOCKED, LOCKED)))
+            elif op.f == "release":
+                out.append(replace(op, f="cas", value=(LOCKED, UNLOCKED)))
+            else:
+                raise ValueError(
+                    f"mutex history may only acquire/release, got {op.f!r}")
+        return out

@@ -1,0 +1,121 @@
+"""Cycle detection over dependency graphs — the MXU path for elle.
+
+The reference ships elle 0.1.2 in its dependency tree (jepsen.etcdemo.iml:46,
+reached transitively through jepsen.checker; SURVEY.md §2.2): a
+transactional anomaly checker whose core is finding cycles in a
+transaction dependency graph. This module is the TPU-native compute core
+for that capability: the graph lives as a dense boolean adjacency matrix
+and reachability is computed by REPEATED MATRIX SQUARING — O(log N)
+[N, N] matmuls, which is exactly MXU food (f32 matmuls on 128-aligned
+tiles), instead of elle's JVM depth-first search.
+
+    R_1 = A                      (paths of length 1)
+    R_{2k} = R_k | R_k @ R_k     (paths of length <= 2k, >= 1 edge)
+    node i lies on a cycle  <=>  R⁺[i, i]
+
+Everything is jitted and shape-bucketed (N padded to a multiple of 128);
+results come back as ONE packed device fetch. The pure-Python Tarjan SCC
+oracle used by the differential tests lives in checkers/elle.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pad_to(n: int, mult: int = 128) -> int:
+    return max(mult, (n + mult - 1) // mult * mult)
+
+
+@functools.lru_cache(maxsize=None)
+def _closure_fn(n_pad: int):
+    """jitted: adj f32[n_pad, n_pad] (0/1) -> (reach_plus f32 0/1,
+    cycle_mask bool[n_pad])."""
+
+    def closure(adj):
+        # ceil(log2(n_pad)) squarings bound the longest simple path.
+        steps = max(1, int(np.ceil(np.log2(n_pad))))
+
+        def body(r, _):
+            # Boolean semiring via f32 matmul + threshold: the matmul is
+            # the MXU op; the threshold keeps entries in {0, 1} so values
+            # never overflow f32 exactness (n_pad < 2^24).
+            r = jnp.minimum(r + r @ r, 1.0)
+            return r, None
+
+        r, _ = jax.lax.scan(body, adj, None, length=steps)
+        return r, jnp.diagonal(r) > 0.5
+
+    return jax.jit(closure)
+
+
+def reach_and_cycles(adj: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """adj: bool[N, N] (edge i->j). Returns (reach_plus bool[N, N] — paths
+    with >= 1 edge — and cycle_mask bool[N]), both host numpy, via one
+    device computation + one fetch."""
+    n = adj.shape[0]
+    if n == 0:
+        return np.zeros((0, 0), bool), np.zeros((0,), bool)
+    n_pad = _pad_to(n)
+    a = np.zeros((n_pad, n_pad), np.float32)
+    a[:n, :n] = adj.astype(np.float32)
+    r, cyc = _closure_fn(n_pad)(jnp.asarray(a))
+    # Single packed fetch: [N, N+1] slab (reach plus the cycle column).
+    packed = np.asarray(jnp.concatenate(
+        [r[:n, :n], cyc[:n, None].astype(jnp.float32)], axis=1))
+    return packed[:, :n] > 0.5, packed[:, n] > 0.5
+
+
+def has_cycle(adj: np.ndarray) -> bool:
+    return bool(reach_and_cycles(adj)[1].any())
+
+
+def bfs_path(adj: np.ndarray, src: int, dst: int) -> list[int] | None:
+    """Shortest path src -> dst (node list incl. both ends) by BFS over
+    the boolean adjacency matrix; None if unreachable."""
+    from collections import deque
+
+    if src == dst:
+        return [src]
+    parent = {src: None}
+    q = deque([src])
+    while q:
+        v = q.popleft()
+        for s in np.flatnonzero(adj[v]):
+            s = int(s)
+            if s in parent:
+                continue
+            parent[s] = v
+            if s == dst:
+                path = [s]
+                while parent[path[-1]] is not None:
+                    path.append(parent[path[-1]])
+                return path[::-1]
+            q.append(s)
+    return None
+
+
+def extract_cycle(adj: np.ndarray, reach: np.ndarray,
+                  cycles: np.ndarray) -> list[int]:
+    """Reconstruct one explicit cycle (node list, first == last) from the
+    reachability closure — the witness elle renders for a failing check.
+    BFS from a cycle node's successor back to the node: shortest witness
+    and guaranteed termination (a greedy reach-guided walk can oscillate
+    forever between interlocking cycles)."""
+    starts = np.flatnonzero(cycles)
+    if starts.size == 0:
+        return []
+    c = int(starts[0])
+    for s in np.flatnonzero(adj[c]):
+        s = int(s)
+        if s == c:
+            return [c, c]
+        if reach[s, c]:
+            back = bfs_path(adj, s, c)
+            assert back is not None, "closure says s reaches c"
+            return [c] + back
+    raise AssertionError("cycle node has no successor on its cycle")

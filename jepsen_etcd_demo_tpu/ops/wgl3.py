@@ -298,13 +298,6 @@ def unpack_np(arr) -> dict:
 _CACHE: dict[tuple, Any] = {}
 
 
-def cached_checker3(model: Model, cfg: DenseConfig):
-    key = ("single3", model.cache_key(), cfg)
-    if key not in _CACHE:
-        _CACHE[key] = make_checker3(model, cfg)
-    return _CACHE[key]
-
-
 def cached_batch_checker3(model: Model, cfg: DenseConfig):
     key = ("batch3", model.cache_key(), cfg)
     if key not in _CACHE:

@@ -1,0 +1,279 @@
+"""Elle-equivalent checker (checkers/elle.py + ops/cycles.py).
+
+Golden anomaly histories for every class in the taxonomy, MXU-closure vs
+Tarjan-DFS differential on random graphs, serial-execution fuzz (must be
+anomaly-free), and the hermetic end-to-end append workload with and
+without injected bugs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_etcd_demo_tpu.checkers.elle import (ElleChecker, TxnEncodeError,
+                                                tarjan_has_cycle)
+from jepsen_etcd_demo_tpu.compose import fake_test
+from jepsen_etcd_demo_tpu.ops.cycles import (extract_cycle, has_cycle,
+                                             reach_and_cycles)
+from jepsen_etcd_demo_tpu.ops.op import Op
+from jepsen_etcd_demo_tpu.runner import run_test
+
+CHECK = ElleChecker()
+
+
+def txn_history(*txns):
+    """txns: (completion_type, [mops]) — builds invoke/completion pairs,
+    one process per txn (invoke value has reads blanked to None)."""
+    h = []
+    for p, (typ, mops) in enumerate(txns):
+        inv = [(m[0], m[1], None) if m[0] == "r" else m for m in mops]
+        h.append(Op(type="invoke", f="txn", value=inv, process=p))
+        h.append(Op(type=typ, f="txn",
+                    value=mops if typ == "ok" else inv, process=p))
+    return h
+
+
+def anomalies_of(*txns):
+    return CHECK.check({}, txn_history(*txns))
+
+
+# -- golden anomaly classes ----------------------------------------------
+
+def test_serial_history_valid():
+    res = anomalies_of(
+        ("ok", [("append", "x", 1)]),
+        ("ok", [("r", "x", (1,)), ("append", "x", 2)]),
+        ("ok", [("r", "x", (1, 2))]),
+    )
+    assert res["valid"] is True
+    assert res["anomaly_types"] == []
+    assert res["edge_counts"]["ww"] >= 1
+    assert res["backend"] == "jax-mxu-closure"
+
+
+def test_g0_write_cycle():
+    res = anomalies_of(
+        ("ok", [("append", "x", 1), ("append", "y", 1)]),
+        ("ok", [("append", "x", 2), ("append", "y", 2)]),
+        ("ok", [("r", "x", (1, 2)), ("r", "y", (2, 1))]),
+    )
+    assert res["valid"] is False
+    assert "G0" in res["anomaly_types"]
+    cyc = res["anomalies"]["G0"][0]["cycle"]
+    assert cyc[0] == cyc[-1] and len(cyc) >= 3
+
+
+def test_g1a_aborted_read():
+    res = anomalies_of(
+        ("fail", [("append", "x", 7)]),
+        ("ok", [("r", "x", (7,))]),
+    )
+    assert res["valid"] is False
+    assert res["anomaly_types"] == ["G1a"]
+    assert res["anomalies"]["G1a"][0]["value"] == 7
+
+
+def test_info_append_observed_is_not_g1a():
+    """An indeterminate txn's append MAY legitimately be visible."""
+    res = anomalies_of(
+        ("info", [("append", "x", 7)]),
+        ("ok", [("r", "x", (7,))]),
+    )
+    assert res["valid"] is True
+
+
+def test_g1b_intermediate_read():
+    res = anomalies_of(
+        ("ok", [("append", "x", 1), ("append", "x", 2)]),
+        ("ok", [("r", "x", (1,))]),
+        ("ok", [("r", "x", (1, 2))]),
+    )
+    assert res["valid"] is False
+    assert "G1b" in res["anomaly_types"]
+
+
+def test_incompatible_order():
+    res = anomalies_of(
+        ("ok", [("append", "x", 1)]),
+        ("ok", [("append", "x", 2)]),
+        ("ok", [("r", "x", (1, 2))]),
+        ("ok", [("r", "x", (2, 1))]),
+    )
+    assert res["valid"] is False
+    assert "incompatible-order" in res["anomaly_types"]
+
+
+def test_g1c_circular_information_flow():
+    res = anomalies_of(
+        ("ok", [("append", "x", 1), ("r", "y", (1,))]),
+        ("ok", [("r", "x", (1,)), ("append", "y", 1)]),
+    )
+    assert res["valid"] is False
+    assert res["anomaly_types"] == ["G1c"]
+
+
+def test_g_single_one_antidependency():
+    res = anomalies_of(
+        ("ok", [("append", "x", 1), ("append", "z", 1)]),
+        ("ok", [("r", "x", (1,)), ("r", "z", ())]),
+        ("ok", [("r", "z", (1,))]),
+    )
+    assert res["valid"] is False
+    assert res["anomaly_types"] == ["G-single"]
+
+
+def test_g2_item_two_antidependencies():
+    res = anomalies_of(
+        ("ok", [("append", "x", 1), ("r", "y", ())]),
+        ("ok", [("append", "y", 1), ("r", "x", ())]),
+        ("ok", [("r", "x", (1,)), ("r", "y", (1,))]),
+    )
+    assert res["valid"] is False
+    assert res["anomaly_types"] == ["G2-item"]
+
+
+def test_encode_errors():
+    with pytest.raises(TxnEncodeError):
+        CHECK.check({}, [Op(type="invoke", f="read", value=None, process=0)])
+    with pytest.raises(TxnEncodeError):
+        CHECK.check({}, txn_history(
+            ("ok", [("append", "x", 1)]),
+            ("ok", [("append", "x", 1)]),  # value reuse
+        ))
+
+
+# -- closure kernel vs DFS oracle ----------------------------------------
+
+def test_closure_differential_fuzz():
+    rng = np.random.default_rng(0xE11E)
+    for trial in range(30):
+        n = int(rng.integers(2, 40))
+        density = rng.uniform(0.01, 0.15)
+        adj = rng.random((n, n)) < density
+        np.fill_diagonal(adj, False)
+        assert has_cycle(adj) == tarjan_has_cycle(adj), f"trial {trial}"
+
+
+def test_closure_finds_planted_cycle_and_witness():
+    n = 150   # spans two 128-tiles
+    adj = np.zeros((n, n), bool)
+    for i in range(n - 1):        # chain 0 -> 1 -> ... -> 149
+        adj[i, i + 1] = True
+    assert not has_cycle(adj)
+    adj[n - 1, 60] = True          # close a long cycle 60..149
+    reach, cyc = reach_and_cycles(adj)
+    assert cyc.any()
+    assert set(np.flatnonzero(cyc)) == set(range(60, n))
+    w = extract_cycle(adj, reach, cyc)
+    assert w[0] == w[-1]
+    assert len(w) == (n - 60) + 1
+
+
+# -- serial-execution fuzz: no false positives ---------------------------
+
+def test_serial_fuzz_no_anomalies():
+    rng = random.Random(0x5E1A)
+    for _ in range(10):
+        store: dict = {}
+        counters: dict = {}
+        txns = []
+        for _ in range(40):
+            mops = []
+            for _ in range(1 + rng.randrange(3)):
+                k = f"k{rng.randrange(3)}"
+                if rng.random() < 0.5:
+                    mops.append(("r", k, tuple(store.get(k, ()))))
+                else:
+                    counters[k] = counters.get(k, 0) + 1
+                    v = counters[k]
+                    store[k] = tuple(store.get(k, ())) + (v,)
+                    mops.append(("append", k, v))
+            txns.append(("ok", mops))
+        res = anomalies_of(*txns)
+        assert res["valid"] is True, res["anomaly_types"]
+
+
+# -- end-to-end append workload ------------------------------------------
+
+def fast_opts(tmp_path, **kw):
+    opts = {"time_limit": 1.2, "rate": 150.0, "store_root": str(tmp_path),
+            "recovery_wait": 0.05, "nemesis_interval": 0.2,
+            "workload": "append", "seed": 11}
+    opts.update(kw)
+    return opts
+
+
+def test_append_run_healthy_is_valid(tmp_path):
+    test = fake_test(fast_opts(tmp_path, no_nemesis=True))
+    result = asyncio.run(run_test(test))
+    assert result["valid"] is True
+    assert result["indep"]["txn_count"] > 20
+
+
+def test_append_run_detects_lost_appends(tmp_path):
+    """Injected lost appends surface as elle anomalies (a read observes a
+    prefix missing an acked append -> rw/incompatible anomalies)."""
+    test = fake_test(fast_opts(tmp_path, lost_write_prob=0.4,
+                               no_nemesis=True))
+    result = asyncio.run(run_test(test))
+    assert result["valid"] is False
+    assert result["indep"]["anomaly_types"]
+
+
+def test_append_run_under_partitions_is_valid(tmp_path):
+    """Partitions only produce indeterminacy (info txns), never anomalies:
+    the elle checker must stay sound under faults."""
+    test = fake_test(fast_opts(tmp_path, seed=3))
+    result = asyncio.run(run_test(test))
+    assert result["valid"] is True
+
+
+def test_extract_cycle_interlocking_cycles_terminates():
+    """Regression: greedy reach-guided walks oscillate on 0->1->2->{1,3},
+    3->0; the BFS extraction must terminate and return a real cycle."""
+    adj = np.zeros((4, 4), bool)
+    for a, b in [(0, 1), (1, 2), (2, 1), (2, 3), (3, 0)]:
+        adj[a, b] = True
+    reach, cyc = reach_and_cycles(adj)
+    assert cyc.all()
+    w = extract_cycle(adj, reach, cyc)
+    assert w[0] == w[-1]
+    for a, b in zip(w, w[1:]):
+        assert adj[a, b]
+
+
+def test_checker_survives_interlocking_wr_cycles():
+    """Regression: the checker must report the anomaly on a history whose
+    wr graph has interlocking cycles, not crash extracting the witness."""
+    res = anomalies_of(
+        ("ok", [("append", "x", 1), ("r", "w", (1,))]),
+        ("ok", [("r", "x", (1,)), ("append", "y", 1), ("r", "z", (1,))]),
+        ("ok", [("r", "y", (1,)), ("append", "z", 1), ("append", "w", 1)]),
+        ("ok", [("r", "w", (1,)), ("append", "v", 1)]),
+    )
+    assert res["valid"] is False
+    assert "G1c" in res["anomaly_types"]
+
+
+def test_g_single_preferred_over_g2_when_both_exist():
+    """Exact classification: a 1-rw cycle must be reported as G-single
+    even when a 2-rw cycle also exists (and would be found first by the
+    witness walk)."""
+    from collections import defaultdict
+    ww = np.zeros((4, 4), bool)
+    wr = np.zeros((4, 4), bool)
+    rw = np.zeros((4, 4), bool)
+    rw[0, 1] = rw[1, 0] = True     # 2-rw cycle on nodes 0,1
+    wr[2, 3] = True                 # 1-rw cycle on nodes 2,3
+    rw[3, 2] = True
+    oks = [(None, "ok", [("append", "x", i)]) for i in range(4)]
+    anomalies = defaultdict(list)
+    CHECK._find_cycles(ww, wr, rw, oks, anomalies)
+    assert "G-single" in anomalies
+    assert "G2-item" not in anomalies
+    cyc = anomalies["G-single"][0]["cycle"]
+    assert set(cyc) == {2, 3}
