@@ -32,8 +32,8 @@ import numpy as np
 
 N_OPS = 150           # ops per history (tutorial run scale, BASELINE configs[0])
 N_PROCS = 10          # concurrency, matching the reference's 10 threads/key
-CORPUS = 256          # histories per batched launch (corpus-replay scale,
-#                       BASELINE configs[4] reads 1024 stored histories)
+CORPUS = 1024         # histories per batched launch — the full corpus-replay
+#                       scale (BASELINE configs[4]: 1024 stored histories)
 REPEATS = 3
 LONG_OPS = (1_000, 10_000)
 

@@ -23,6 +23,14 @@ Anomalies (elle's taxonomy):
   * G1a aborted read       — read observes a value appended by a :fail txn
   * G1b intermediate read  — read observes a txn's non-final state of a key
   * incompatible-order     — reads of one key disagree beyond prefixing
+  * duplicates             — a read observes the same value twice
+  * lost-append            — a txn's appends to a key are atomic, so they
+                             occupy a CONTIGUOUS run of the true list;
+                             a read observing one of them with the txn's
+                             neighbouring append absent from the adjacent
+                             position proves an acked append went missing
+                             (elle finds these through its internal/ww
+                             machinery; here it is a direct check)
   * G0 write cycle         — cycle in ww
   * G1c circular info      — cycle in ww|wr (with >= 1 wr)
   * G-single               — cycle in ww|wr|rw with exactly one rw
@@ -92,7 +100,6 @@ class ElleChecker(Checker):
         # Ownership maps per key.
         append_of: dict[tuple, int] = {}      # (k, v) -> ok txn idx
         failed_vals: set[tuple] = set()
-        info_vals: set[tuple] = set()
         multi_appends: dict[tuple, list] = defaultdict(list)  # per (txn,k)
         for i, (_, _, value) in enumerate(oks):
             for mop in value:
@@ -104,11 +111,10 @@ class ElleChecker(Checker):
                     append_of[(k, v)] = i
                     multi_appends[(i, k)].append(v)
         for value, typ, _ in txns:
-            if typ in ("fail", "info"):
+            if typ == "fail":
                 for mop in value:
                     if mop[0] == "append":
-                        (failed_vals if typ == "fail" else
-                         info_vals).add((mop[1], mop[2]))
+                        failed_vals.add((mop[1], mop[2]))
 
         # Reads grouped per key: (reader_idx, observed tuple).
         reads: dict[Any, list] = defaultdict(list)
@@ -117,14 +123,43 @@ class ElleChecker(Checker):
                 if mop[0] == "r" and mop[2] is not None:
                     reads[mop[1]].append((i, tuple(mop[2])))
 
-        # G1a / G1b and the per-key observed version order.
+        # Direct (non-cycle) anomalies and the per-key observed version
+        # order.
         order: dict[Any, tuple] = {}
         for k, obs in reads.items():
             for reader, vs in obs:
+                if len(set(vs)) != len(vs):
+                    anomalies["duplicates"].append(
+                        {"key": k, "read": list(vs), "reader": reader})
                 for v in vs:
                     if (k, v) in failed_vals and (k, v) not in append_of:
                         anomalies["G1a"].append(
                             {"key": k, "value": v, "reader": reader})
+                # A committed txn's appends to k are atomic: they occupy a
+                # contiguous run of the true list, and any read is a
+                # prefix of that list. So an observed value must have the
+                # writer's previous append IMMEDIATELY before it, and —
+                # unless the read ends there — the writer's next append
+                # immediately after it. A violation proves an acked
+                # append vanished (lost-append), regardless of which txn
+                # wrote the value that sits there instead.
+                for p, v in enumerate(vs):
+                    owner = append_of.get((k, v))
+                    if owner is None or owner == reader:
+                        continue
+                    own = multi_appends[(owner, k)]
+                    i = own.index(v)
+                    if i > 0 and (p == 0 or vs[p - 1] != own[i - 1]):
+                        anomalies["lost-append"].append(
+                            {"key": k, "missing": own[i - 1],
+                             "observed": v, "read": list(vs),
+                             "writer": owner, "reader": reader})
+                    if (i + 1 < len(own) and p + 1 < len(vs)
+                            and vs[p + 1] != own[i + 1]):
+                        anomalies["lost-append"].append(
+                            {"key": k, "missing": own[i + 1],
+                             "observed": v, "read": list(vs),
+                             "writer": owner, "reader": reader})
                 if vs:
                     owner = append_of.get((k, vs[-1]))
                     if owner is not None:
@@ -150,10 +185,7 @@ class ElleChecker(Checker):
         ww = np.zeros((n, n), bool)
         wr = np.zeros((n, n), bool)
         rw = np.zeros((n, n), bool)
-        pos = {}
         for k, longest in order.items():
-            for j, v in enumerate(longest):
-                pos[(k, v)] = j
             for a, b in zip(longest, longest[1:]):
                 wa, wb = append_of.get((k, a)), append_of.get((k, b))
                 if wa is not None and wb is not None and wa != wb:

@@ -143,7 +143,10 @@ def cmd_analyze(args) -> int:
         sub = SetChecker()
         checker = Compose({"perf": PerfChecker(), "indep": sub})
     elif args.workload == "append":
-        checker = Compose({"perf": PerfChecker(), "indep": ElleChecker()})
+        checker = Compose({"perf": PerfChecker(),
+                           "indep": Compose({
+                               "elle": ElleChecker(),
+                               "timeline": TimelineChecker()})})
     else:
         checker = Compose({"perf": PerfChecker(),
                            "indep": IndependentChecker(Compose({

@@ -27,6 +27,14 @@ class TxnClient(Client):
         conn = self.conn_factory(test, node)
         if hasattr(conn, "__await__"):
             conn = await conn
+        if not hasattr(conn, "txn"):
+            # Fail fast at setup, not with an AttributeError mid-run: the
+            # etcd v2 API has no transactions, so the append workload only
+            # runs against transactional stores (e.g. --fake).
+            raise RuntimeError(
+                "append workload requires a transactional connection "
+                f"(conn {type(conn).__name__!r} has no txn()); "
+                "use --fake or a store with multi-key transactions")
         return TxnClient(self.conn_factory, conn)
 
     async def invoke(self, test: dict, op: Op) -> Op:

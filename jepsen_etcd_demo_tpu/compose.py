@@ -124,7 +124,8 @@ def append_workload(opts: dict, conn_factory: Callable) -> dict:
 
     return {
         "client": TxnClient(conn_factory),
-        "checker": ElleChecker(),
+        "checker": Compose({"elle": ElleChecker(),
+                            "timeline": TimelineChecker()}),
         "generator": gen.repeat(txn_gen),
         # Final phase: one read-everything txn after healing, so the tail
         # of appends is observed (tightens the inferred version order).

@@ -48,6 +48,17 @@ class Runner:
         cmd = " ".join(shellquote(a) for a in argv)
         return await self.run(cmd, su=su, check=check, timeout_s=timeout_s)
 
+    async def upload(self, local_path: str, remote_path: str
+                     ) -> CommandResult:
+        """Copy a file onto the node (cu/install-archive! transport leg)."""
+        raise NotImplementedError
+
+    async def download(self, remote_path: str, local_path: str,
+                       check: bool = False) -> CommandResult:
+        """Copy a file off the node (db/LogFiles collection,
+        reference src/jepsen/etcdemo.clj:62-64)."""
+        raise NotImplementedError
+
     async def _spawn(self, argv: Sequence[str], check: bool,
                      timeout_s: float) -> CommandResult:
         proc = await asyncio.create_subprocess_exec(
@@ -84,6 +95,15 @@ class LocalRunner(Runner):
         if su and self.allow_su:
             cmd = f"sudo sh -c {shellquote(cmd)}"
         return await self._spawn(["sh", "-c", cmd], check, timeout_s)
+
+    async def upload(self, local_path: str, remote_path: str
+                     ) -> CommandResult:
+        return await self._spawn(["cp", local_path, remote_path], True, 300.0)
+
+    async def download(self, remote_path: str, local_path: str,
+                       check: bool = False) -> CommandResult:
+        return await self._spawn(["cp", remote_path, local_path], check,
+                                 300.0)
 
 
 class SSHRunner(Runner):

@@ -25,6 +25,11 @@ async def debian_setup(r: Runner, node: str) -> None:
             missing.append(p)
     if missing:
         log.info("%s: installing %s", node, missing)
+        # Refresh package lists first — a fresh node's cache is usually
+        # stale/empty and the install would 404 (jepsen.os.debian does the
+        # same update-then-install dance [dep]).
+        await r.run("DEBIAN_FRONTEND=noninteractive apt-get -y update",
+                    su=True, check=False, timeout_s=600.0)
         await r.run(
             "DEBIAN_FRONTEND=noninteractive apt-get -y install "
             + " ".join(missing),

@@ -409,3 +409,23 @@ class TestEtcdDBCommands:
         go(debian_setup(RecordingRunner("n1", log), "n1"))
         joined = " && ".join(c for _, c, _ in log)
         assert "apt-get" in joined
+
+
+def test_local_runner_upload_download_roundtrip(tmp_path):
+    """Runner transfer symmetry: LocalRunner implements the same
+    upload/download surface as SSHRunner (db/LogFiles collection works in
+    local mode)."""
+    import asyncio
+    from jepsen_etcd_demo_tpu.control.runner import LocalRunner
+
+    src = tmp_path / "src.txt"
+    src.write_text("log line\n")
+    r = LocalRunner("n1")
+
+    async def go():
+        await r.upload(str(src), str(tmp_path / "up.txt"))
+        await r.download(str(tmp_path / "up.txt"),
+                         str(tmp_path / "down.txt"), check=True)
+
+    asyncio.run(go())
+    assert (tmp_path / "down.txt").read_text() == "log line\n"

@@ -211,7 +211,12 @@ def test_append_run_healthy_is_valid(tmp_path):
     test = fake_test(fast_opts(tmp_path, no_nemesis=True))
     result = asyncio.run(run_test(test))
     assert result["valid"] is True
-    assert result["indep"]["txn_count"] > 20
+    assert result["indep"]["elle"]["txn_count"] > 20
+    # Timeline artifact rendered for the txn history too.
+    from pathlib import Path
+    run_dir = next(p for p in Path(tmp_path).glob("*/*")
+                   if p.is_dir() and not p.is_symlink())
+    assert (run_dir / "timeline.html").exists()
 
 
 def test_append_run_detects_lost_appends(tmp_path):
@@ -221,7 +226,7 @@ def test_append_run_detects_lost_appends(tmp_path):
                                no_nemesis=True))
     result = asyncio.run(run_test(test))
     assert result["valid"] is False
-    assert result["indep"]["anomaly_types"]
+    assert result["indep"]["elle"]["anomaly_types"]
 
 
 def test_append_run_under_partitions_is_valid(tmp_path):
@@ -277,3 +282,51 @@ def test_g_single_preferred_over_g2_when_both_exist():
     assert "G2-item" not in anomalies
     cyc = anomalies["G-single"][0]["cycle"]
     assert set(cyc) == {2, 3}
+
+
+def test_lost_append_mid_txn_detected():
+    """Regression (false negative): a committed txn's appends are atomic
+    and contiguous; a read observing the second without the first proves
+    the first was lost — even though it never appears at any read's
+    tail."""
+    res = anomalies_of(
+        ("ok", [("append", "x", 1), ("append", "x", 2)]),
+        ("ok", [("r", "x", (2,))]),
+    )
+    assert res["valid"] is False
+    assert "lost-append" in res["anomaly_types"]
+    assert res["anomalies"]["lost-append"][0]["missing"] == 1
+
+
+def test_lost_append_between_writers_detected():
+    """Regression: T wrote [1,2], U wrote [3]; a read of (1,3) is missing
+    the mid-list 2 — contiguity of T's run is violated."""
+    res = anomalies_of(
+        ("ok", [("append", "x", 1), ("append", "x", 2)]),
+        ("ok", [("append", "x", 3)]),
+        ("ok", [("r", "x", (1, 3))]),
+    )
+    assert res["valid"] is False
+    assert "lost-append" in res["anomaly_types"]
+    assert res["anomalies"]["lost-append"][0]["missing"] == 2
+
+
+def test_duplicate_values_detected():
+    res = anomalies_of(
+        ("ok", [("append", "x", 1)]),
+        ("ok", [("r", "x", (1, 1))]),
+    )
+    assert res["valid"] is False
+    assert "duplicates" in res["anomaly_types"]
+
+
+def test_append_workload_requires_txn_conn():
+    import asyncio
+    from jepsen_etcd_demo_tpu.clients.txn import TxnClient
+
+    class NoTxnConn:
+        pass
+
+    client = TxnClient(lambda test, node: NoTxnConn())
+    with pytest.raises(RuntimeError, match="transactional"):
+        asyncio.run(client.open({}, "n1"))
