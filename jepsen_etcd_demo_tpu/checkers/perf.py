@@ -26,6 +26,28 @@ SECOND = 1_000_000_000
 QUANTILES = [0.5, 0.95, 0.99, 1.0]
 
 
+def nemesis_windows(history: Sequence[Op]):
+    """[(start_s, stop_s)] intervals where the nemesis was active — jepsen
+    shades these on its perf charts so latency spikes line up with faults.
+    An un-stopped start extends to the history end."""
+    out = []
+    t_start = None
+    t_max = 0.0
+    for op in history:
+        t = op.time / SECOND
+        t_max = max(t_max, t)
+        if op.process != "nemesis" or op.type == INVOKE:
+            continue
+        if op.f == "start" and t_start is None:
+            t_start = t
+        elif op.f == "stop" and t_start is not None:
+            out.append((t_start, t))
+            t_start = None
+    if t_start is not None:
+        out.append((t_start, t_max))
+    return out
+
+
 def latency_pairs(history: Sequence[Op]):
     """(f, completion-type, invoke-time-ns, latency-ns) per completed client
     op; nemesis excluded."""
@@ -63,20 +85,26 @@ class PerfChecker(Checker):
         store_dir = (opts or {}).get("store_dir")
         if store_dir and pairs:
             try:
-                self._render(Path(store_dir), pairs)
+                self._render(Path(store_dir), pairs,
+                             nemesis_windows(history))
                 result["charts"] = ["latency-raw.png",
                                     "latency-quantiles.png", "rate.png"]
             except Exception as e:  # charts are best-effort observability
                 log.warning("perf chart rendering failed: %s", e)
         return result
 
-    def _render(self, store_dir: Path, pairs) -> None:
+    def _render(self, store_dir: Path, pairs, windows=()) -> None:
         import matplotlib
         matplotlib.use("Agg")
         import matplotlib.pyplot as plt
 
         colors = {OK: "#2a9d43", FAIL: "#d43a2a", INFO: "#e9a820"}
         markers = {"read": "o", "write": "s", "cas": "^", "add": "s"}
+
+        def shade(ax):
+            # Grey bands where the nemesis was active (jepsen chart parity).
+            for lo, hi in windows:
+                ax.axvspan(lo, hi, color="#cccccc", alpha=0.4, zorder=0)
 
         # latency-raw: scatter of every op, by type/outcome.
         fig, ax = plt.subplots(figsize=(10, 5))
@@ -91,6 +119,7 @@ class PerfChecker(Checker):
         ax.set_xlabel("time (s)")
         ax.set_ylabel("latency (s)")
         ax.legend(fontsize=7, ncol=3)
+        shade(ax)
         ax.set_title("latency raw")
         fig.savefig(store_dir / "latency-raw.png", dpi=100,
                     bbox_inches="tight")
@@ -114,6 +143,7 @@ class PerfChecker(Checker):
         ax.set_xlabel("time (s)")
         ax.set_ylabel("latency (s)")
         ax.legend(fontsize=8)
+        shade(ax)
         ax.set_title("latency quantiles")
         fig.savefig(store_dir / "latency-quantiles.png", dpi=100,
                     bbox_inches="tight")
@@ -130,6 +160,7 @@ class PerfChecker(Checker):
         ax.set_xlabel("time (s)")
         ax.set_ylabel("ops/s")
         ax.legend(fontsize=8)
+        shade(ax)
         ax.set_title("throughput")
         fig.savefig(store_dir / "rate.png", dpi=100, bbox_inches="tight")
         plt.close(fig)

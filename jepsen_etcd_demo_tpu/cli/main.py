@@ -75,6 +75,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(no ssh/etcd)")
     t.add_argument("--no-nemesis", action="store_true",
                    help="disable fault injection")
+    t.add_argument("--nemesis", default="partition",
+                   choices=["partition", "clock", "kill", "pause", "noop"],
+                   help="fault to inject on the nemesis channel "
+                        "(kill/pause need a real DB, not --fake)")
     t.add_argument("--version", default="v3.1.5",
                    help="etcd version to install")
     t.add_argument("--stale-read-prob", type=float, default=0.0,
@@ -108,6 +112,7 @@ def _test_opts(args) -> dict:
         "seed": args.seed,
         "store_root": args.store,
         "no_nemesis": args.no_nemesis,
+        "nemesis": args.nemesis,
         "version": args.version,
         "ssh": {"username": args.username, "private_key": args.private_key},
         "stale_read_prob": args.stale_read_prob,

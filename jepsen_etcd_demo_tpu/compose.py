@@ -27,7 +27,9 @@ from .clients.set_client import SetClient
 from .db.debian import debian_setup
 from .db.etcd import EtcdDB
 from .db.fake import FakeDB
-from .nemesis.partition import PartitionRandomHalves, FakePartitionNemesis
+from .nemesis import (ClockSkewNemesis, FakeClockSkewNemesis,
+                      FakePartitionNemesis, KillNemesis, NoopNemesis,
+                      PartitionRandomHalves, PauseNemesis)
 
 # noop-test-style defaults (reference tests/noop-test [dep]: n1..n5,
 # concurrency, time-limit; overridden by CLI opts then by the demo map,
@@ -215,13 +217,47 @@ def compose_test(opts: dict, conn_factory: Callable,
     return test
 
 
+def pick_nemesis(opts: dict, store: Optional[FakeKVStore] = None, db=None):
+    """Nemesis registry (jepsen.nemesis family, SURVEY.md §2.2:
+    partition, kill, pause, clock skew). `store` selects the hermetic
+    twins; kill/pause need a real DB."""
+    kind = opts.get("nemesis", "partition")
+    seed = int(opts.get("seed", 0))
+    if store is not None:
+        fakes = {
+            "partition": lambda: FakePartitionNemesis(store, seed=seed),
+            "clock": lambda: FakeClockSkewNemesis(store, seed=seed),
+            "noop": NoopNemesis,
+        }
+        if kind not in fakes:
+            raise ValueError(
+                f"nemesis {kind!r} not available in --fake mode "
+                f"(have: {sorted(fakes)})")
+        return fakes[kind]()
+    reals = {
+        "partition": lambda: PartitionRandomHalves(seed=seed),
+        "clock": lambda: ClockSkewNemesis(seed=seed),
+        "kill": lambda: KillNemesis(db, seed=seed),
+        "pause": lambda: _pause_nemesis(seed),
+        "noop": NoopNemesis,
+    }
+    if kind not in reals:
+        raise ValueError(f"unknown nemesis {kind!r} (have: {sorted(reals)})")
+    return reals[kind]()
+
+
+def _pause_nemesis(seed: int):
+    from .db.etcd import PIDFILE
+    return PauseNemesis(PIDFILE, seed=seed)
+
+
 def etcd_test(opts: dict) -> dict:
     """The real composition (reference etcd-test, :146-175): Debian OS prep,
     etcd v3.1.5 DB, SSH control, iptables partition nemesis."""
     test = compose_test(opts, etcd_conn_factory())
     test["db"] = EtcdDB(version=opts.get("version", "v3.1.5"))
     test["os_setup"] = lambda runner, node: debian_setup(runner, node)
-    test["nemesis"] = PartitionRandomHalves(seed=int(test.get("seed", 0)))
+    test["nemesis"] = pick_nemesis(test, db=test["db"])
     return test
 
 
@@ -239,7 +275,6 @@ def fake_test(opts: dict, store: Optional[FakeKVStore] = None) -> dict:
                                 opts.get("duplicate_cas_prob", 0.0)))
     test = compose_test(opts, fake_conn_factory(store))
     test["db"] = FakeDB()
-    test["nemesis"] = FakePartitionNemesis(store,
-                                           seed=int(test.get("seed", 0)))
+    test["nemesis"] = pick_nemesis(test, store=store)
     test["fake_store"] = store
     return test

@@ -11,3 +11,4 @@ from .partition import (  # noqa: F401
     PartitionRandomHalves, FakePartitionNemesis, bisect_nodes, random_halves,
 )
 from .process_faults import KillNemesis, PauseNemesis  # noqa: F401
+from .clock import ClockSkewNemesis, FakeClockSkewNemesis  # noqa: F401

@@ -28,3 +28,11 @@ class Nemesis(abc.ABC):
 class NoopNemesis(Nemesis):
     async def invoke(self, test: dict, op: Op) -> Op:
         return Op(type="info", f=op.f, value="noop", process=op.process)
+
+
+def random_minority(rng, nodes: list) -> list:
+    """Random non-empty subset of at most half the nodes — the shared
+    target-selection rule of the kill/pause/clock nemeses (a strict
+    minority, so a quorum always survives the fault)."""
+    n = rng.randrange(1, max(2, len(nodes) // 2 + 1))
+    return rng.sample(list(nodes), n)

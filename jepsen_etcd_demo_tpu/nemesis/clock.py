@@ -1,0 +1,107 @@
+"""Clock-skew nemesis.
+
+Beyond the reference demo (which only partitions) but part of the jepsen
+nemesis family this build's fault-injection ABC covers (SURVEY.md §2.2
+"jepsen.nemesis" row: partition, kill, pause, clock skew). jepsen's
+nemesis/clock bumps node wall clocks and resets them on heal; correctness
+of the HARNESS is unaffected (histories are timestamped client-side), so
+this fault targets the system under test's clock assumptions (leases,
+TTLs, leader election timeouts in etcd).
+
+Real path: `date -s @<epoch+delta>` over the control plane (su), recording
+each node's applied delta; :stop / teardown restores by applying the
+inverse delta relative to the node's CURRENT clock (the node kept ticking
+while skewed, so absolute restore would lose elapsed time).
+
+Fake path: records the skew on the in-process store (`store.clock_skew`)
+so hermetic runs exercise the same op/plumbing; the fake register is
+linearizable regardless of clocks, so verdicts must stay valid — which is
+itself the soundness property the e2e test pins down.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ..control.runner import runner_for
+from ..ops.op import Op
+from .base import Nemesis, random_minority
+
+
+class ClockSkewNemesis(Nemesis):
+    """:start skews a random subset's clocks by up to +/- max_skew_s;
+    :stop applies the inverse deltas."""
+
+    def __init__(self, seed: int = 0, max_skew_s: float = 60.0):
+        self.rng = random.Random(seed)
+        self.max_skew_s = max_skew_s
+        self.applied: dict[str, float] = {}
+
+    async def _shift(self, test: dict, node: str, delta_s: int) -> bool:
+        """Shift relative to the node's own current clock; True iff the
+        date command actually succeeded (no CAP_SYS_TIME / sudo problems
+        must not be recorded as applied — the heal pass would then skew a
+        clock that was never skewed)."""
+        r = runner_for(test, node)
+        res = await r.run(
+            f"date -s @$(( $(date +%s) + {delta_s} ))",
+            su=True, check=False)
+        return res.ok
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        if op.f == "start":
+            for node in random_minority(self.rng, test["nodes"]):
+                # Whole seconds, drawn once: the same value is applied,
+                # recorded, and inverted (a float here would silently
+                # truncate in the shell while the history reported it).
+                delta = 0
+                while delta == 0:
+                    delta = self.rng.randint(-int(self.max_skew_s),
+                                             int(self.max_skew_s))
+                if await self._shift(test, node, delta):
+                    self.applied[node] = self.applied.get(node, 0) + delta
+            value = {"skewed": dict(self.applied)}
+        elif op.f == "stop":
+            await self._restore(test)
+            value = "clocks restored"
+        else:
+            value = f"unknown nemesis op {op.f}"
+        return Op(type="info", f=op.f, value=value, process=op.process)
+
+    async def _restore(self, test: dict) -> None:
+        for node, delta in list(self.applied.items()):
+            if await self._shift(test, node, -delta):
+                del self.applied[node]
+
+    async def teardown(self, test: dict) -> None:
+        await self._restore(test)
+
+
+class FakeClockSkewNemesis(Nemesis):
+    """Hermetic twin: records skews on the FakeKVStore (which is
+    linearizable regardless, so the checker verdict must stay valid)."""
+
+    def __init__(self, store, seed: int = 0, max_skew_s: float = 60.0):
+        self.store = store
+        self.rng = random.Random(seed)
+        self.max_skew_s = max_skew_s
+        if not hasattr(store, "clock_skew"):
+            store.clock_skew = {}
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        if op.f == "start":
+            for node in random_minority(self.rng, self.store.nodes):
+                self.store.clock_skew[node] = self.rng.uniform(
+                    -self.max_skew_s, self.max_skew_s)
+            value = {"skewed": {k: round(v, 1) for k, v
+                               in self.store.clock_skew.items()}}
+        elif op.f == "stop":
+            self.store.clock_skew.clear()
+            value = "clocks restored"
+        else:
+            value = f"unknown nemesis op {op.f}"
+        return Op(type="info", f=op.f, value=value, process=op.process)
+
+    async def teardown(self, test: dict) -> None:
+        self.store.clock_skew.clear()

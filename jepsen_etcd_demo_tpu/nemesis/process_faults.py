@@ -10,7 +10,7 @@ import random
 
 from ..control.runner import runner_for
 from ..ops.op import Op
-from .base import Nemesis
+from .base import Nemesis, random_minority
 
 
 class KillNemesis(Nemesis):
@@ -23,8 +23,7 @@ class KillNemesis(Nemesis):
 
     async def invoke(self, test: dict, op: Op) -> Op:
         if op.f == "start":
-            n = self.rng.randrange(1, max(2, len(test["nodes"]) // 2 + 1))
-            self.killed = self.rng.sample(test["nodes"], n)
+            self.killed = random_minority(self.rng, test["nodes"])
             for node in self.killed:
                 r = runner_for(test, node)
                 from ..db.etcd import PIDFILE
@@ -55,8 +54,7 @@ class PauseNemesis(Nemesis):
 
     async def invoke(self, test: dict, op: Op) -> Op:
         if op.f == "start":
-            n = self.rng.randrange(1, max(2, len(test["nodes"]) // 2 + 1))
-            self.paused = self.rng.sample(test["nodes"], n)
+            self.paused = random_minority(self.rng, test["nodes"])
             for node in self.paused:
                 r = runner_for(test, node)
                 await r.run(f"kill -STOP $(cat {self.pidfile})", su=True,

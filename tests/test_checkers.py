@@ -174,3 +174,20 @@ class TestSetChecker:
         h = _h((INVOKE, "add", 5, 0),
                (INVOKE, "read", None, 1), (OK, "read", [5], 1))
         assert SetChecker().check({}, h)["valid"] is True
+
+
+def test_nemesis_windows_extraction():
+    """Perf-chart shading: start/stop completions on the nemesis channel
+    become active intervals; a dangling start extends to history end."""
+    from jepsen_etcd_demo_tpu.checkers.perf import nemesis_windows
+    from jepsen_etcd_demo_tpu.ops.op import Op
+    S = 1_000_000_000
+    h = [
+        Op(type="invoke", f="start", value=None, process="nemesis", time=1*S),
+        Op(type="info", f="start", value=None, process="nemesis", time=2*S),
+        Op(type="invoke", f="read", value=(0, None), process=0, time=3*S),
+        Op(type="info", f="stop", value=None, process="nemesis", time=5*S),
+        Op(type="info", f="start", value=None, process="nemesis", time=8*S),
+        Op(type="ok", f="read", value=(0, 1), process=0, time=9*S),
+    ]
+    assert nemesis_windows(h) == [(2.0, 5.0), (8.0, 9.0)]

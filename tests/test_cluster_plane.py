@@ -429,3 +429,61 @@ def test_local_runner_upload_download_roundtrip(tmp_path):
 
     asyncio.run(go())
     assert (tmp_path / "down.txt").read_text() == "log line\n"
+
+
+class TestClockSkewCommands:
+    """ClockSkewNemesis: date-shift command assembly + inverse restore."""
+
+    def _nemesis_log(self, ops):
+        from jepsen_etcd_demo_tpu.nemesis import clock as clk
+        from jepsen_etcd_demo_tpu.ops.op import Op
+
+        log = []
+        test = {"nodes": ["n1", "n2", "n3", "n4", "n5"]}
+        nem = clk.ClockSkewNemesis(seed=7, max_skew_s=30)
+
+        orig = clk.runner_for
+        clk.runner_for = lambda t, node: RecordingRunner(node, log)
+        try:
+            for f in ops:
+                go(nem.invoke(test, Op(type="invoke", f=f, value=None,
+                                       process="nemesis")))
+        finally:
+            clk.runner_for = orig
+        return log
+
+    def test_start_shifts_subset_with_sudo(self):
+        log = self._nemesis_log(["start"])
+        assert log and all(su for _, _, su in log)
+        assert all("date -s @$(( $(date +%s) +" in c for _, c, _ in log)
+        assert len({n for n, _, _ in log}) == len(log)  # one shift per node
+
+    def test_stop_applies_inverse_deltas(self):
+        log = self._nemesis_log(["start", "stop"])
+        shifts = {}
+        for n, c, _ in log:
+            delta = int(c.split("+")[-1].rstrip(" )"))
+            shifts.setdefault(n, []).append(delta)
+        for n, ds in shifts.items():
+            assert len(ds) == 2 and ds[0] + ds[1] == 0, (n, ds)
+
+
+def test_pick_nemesis_registry():
+    from jepsen_etcd_demo_tpu.compose import pick_nemesis
+    from jepsen_etcd_demo_tpu.clients.fake_kv import FakeKVStore
+    from jepsen_etcd_demo_tpu.nemesis import (
+        ClockSkewNemesis, FakeClockSkewNemesis, FakePartitionNemesis,
+        NoopNemesis, PartitionRandomHalves)
+
+    store = FakeKVStore()
+    assert isinstance(pick_nemesis({}, store=store), FakePartitionNemesis)
+    assert isinstance(pick_nemesis({"nemesis": "clock"}, store=store),
+                      FakeClockSkewNemesis)
+    assert isinstance(pick_nemesis({"nemesis": "noop"}, store=store),
+                      NoopNemesis)
+    with pytest.raises(ValueError, match="fake"):
+        pick_nemesis({"nemesis": "kill"}, store=store)
+    assert isinstance(pick_nemesis({}), PartitionRandomHalves)
+    assert isinstance(pick_nemesis({"nemesis": "clock"}), ClockSkewNemesis)
+    with pytest.raises(ValueError, match="unknown"):
+        pick_nemesis({"nemesis": "sharknado"})

@@ -124,3 +124,19 @@ def test_history_is_well_formed(tmp_path):
             pending.discard(op.process)
     times = [o.time for o in hist]
     assert times == sorted(times)
+
+
+def test_clock_skew_run_is_valid(tmp_path):
+    """Clock skew must never produce harness-side anomalies (histories are
+    timestamped client-side); the skewed fake run stays linearizable and
+    the skews were really applied and healed."""
+    test = fake_test(fast_opts(tmp_path, workload="register", seed=4,
+                               nemesis="clock"))
+    result = run(test)
+    assert result["valid"] is True
+    hist = Store(test["store_root"]).latest().read_history()
+    skews = [o for o in hist if o.process == "nemesis"
+             and o.type == "info" and isinstance(o.value, dict)
+             and "skewed" in o.value]
+    assert skews, "clock nemesis never fired"
+    assert test["fake_store"].clock_skew == {}  # healed at teardown
