@@ -63,7 +63,8 @@ def bench_corpus(model):
     # pallas kernel on a live TPU, XLA kernel otherwise. Both return packed
     # i32[B,5] (ONE device->host fetch — per-fetch round trips dominate
     # wall time on tunneled backends).
-    check, kernel_name = wgl3_pallas.packed_batch_checker(model, cfg)
+    check, kernel_name = wgl3_pallas.packed_batch_checker(
+        model, cfg, n_steps=arrays[2].shape[1])
     out = wgl3.unpack_np(check(*arrays))  # compile + warmup
     assert out["survived"].all(), "bench corpus must be valid by construction"
     best = float("inf")

@@ -122,19 +122,17 @@ class Linearizable(Checker):
                     "f_cap": cfg3.n_states * cfg3.n_masks}
 
         # General path (huge values / extreme pending counts): the sort
-        # kernel, run chunk-by-chunk with host-checkpointed frontier carry.
-        # Overflow escalates capacity and RESUMES from the last chunk
-        # boundary — exact native verdicts, no Python-oracle fallback
-        # (SURVEY.md §5.4/§5.7). Tighten the slot table first: a smaller
-        # mask width shrinks the sort and often re-enables packed dedup.
-        from ..ops.encode import reslot_events
+        # kernel run chunk-by-chunk with host-checkpointed frontier carry
+        # and capacity escalation, falling back to the chunked dense
+        # lattice for frontiers beyond any practical f_cap — exact native
+        # verdicts all the way down, no Python-oracle fallback
+        # (SURVEY.md §5.4/§5.7).
+        from ..ops import wgl3_pallas
 
-        tight = max(8, (enc.max_pending + 3) // 4 * 4)
-        if tight < enc.k_slots:
-            enc = reslot_events(enc, tight)
-        rs = encode_return_steps(enc)
-        out = wgl2.check_steps_resumable(rs, self.model, f_cap=self.f_cap)
-        return {"valid": out["valid"], "backend": "jax", "op_count": enc.n_ops,
+        out = wgl3_pallas.check_encoded_general(enc, self.model,
+                                                f_cap=self.f_cap)
+        return {"valid": out["valid"], "backend": "jax",
+                "op_count": out["op_count"],
                 "dead_step": out["dead_step"],
                 "max_frontier": out["max_frontier"],
                 "overflow": False,

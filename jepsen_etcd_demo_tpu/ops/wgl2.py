@@ -358,3 +358,38 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
 def check_encoded2(enc: EncodedHistory, model: Model | None = None,
                    f_cap: int = 256) -> dict[str, Any]:
     return check_steps(encode_return_steps(enc), model, f_cap)
+
+
+def sort_k_slots(enc: EncodedHistory) -> int:
+    """Slot-table width the sort kernel runs at for this history (real
+    concurrency rounded up to a multiple of 4, floor 8). Single source:
+    f_cap_max sizing in the routing ladder depends on this EXACT value."""
+    return max(8, (enc.max_pending + 3) // 4 * 4)
+
+
+def check_encoded_resumable(enc: EncodedHistory, model: Model | None = None,
+                            f_cap: int = 256,
+                            f_cap_max: int = 1 << 20) -> dict[str, Any]:
+    """The general-geometry production path (huge values or wide pending
+    sets where the dense lattice is infeasible): tighten the slot table to
+    the history's real concurrency, then run the resumable chunked sort
+    kernel. Shared by the Linearizable checker and the auto router.
+    Raises MemoryError when the frontier outgrows f_cap_max (callers may
+    then fall back to the dense-chunked lattice, which has no frontier
+    capacity at all)."""
+    from .encode import reslot_events
+
+    if model is None:
+        from ..models import CASRegister
+        model = CASRegister()
+    tight = sort_k_slots(enc)
+    if tight < enc.k_slots:
+        enc = reslot_events(enc, tight)
+    # Clamp the STARTING capacity too: the escalation loop only checks
+    # f_cap_max after an overflow, so an oversized initial f_cap would
+    # run its first sort past the very limit f_cap_max protects.
+    f_cap = max(4, min(f_cap, f_cap_max))
+    out = check_steps_resumable(encode_return_steps(enc), model,
+                                f_cap=f_cap, f_cap_max=f_cap_max)
+    out["op_count"] = enc.n_ops
+    return out

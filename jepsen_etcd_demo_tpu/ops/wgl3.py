@@ -70,10 +70,17 @@ class DenseConfig:
 
 
 # Largest table (S * 2^K cells) the dense kernel will build per history.
-# Cells are BITS (32 packed per uint32 word): 2^26 cells = 8 MiB of table,
-# so even a 64-history batch stays ~512 MiB of HBM at the extreme; typical
-# jepsen concurrency (K ~ 12, S ~ 8) is a 4 KiB table.
-DENSE_CELL_BUDGET = 1 << 26
+# Cells are BITS (32 packed per uint32 word). Two forces set the cap:
+#  * algorithmic crossover — per-step cost is O(K * S * 2^K) regardless of
+#    how few configs are LIVE, while the sort kernel (wgl2) pays
+#    O(f_cap * K); past K ~ 17 the live frontier is invariably tiny
+#    relative to the lattice, so dense sweeps waste >100x the work;
+#  * the axon TPU worker kills programs running longer than ~1-2 min, and
+#    a K=20 dense chunk measured ~35 s per 4k steps — wide-K histories
+#    must not reach this kernel at all.
+# 2^20 cells admits typical jepsen geometries (K<=17 at S=8 — concurrency
+# 10 gives K=12, a 4 KiB table) and routes wider ones to wgl2.
+DENSE_CELL_BUDGET = 1 << 20
 
 
 def dense_config(model: Model, k_slots: int, max_value: int,
@@ -262,6 +269,85 @@ def _check_one_fn(model: Model, cfg: DenseConfig):
 def make_checker3(model: Model, cfg: DenseConfig):
     """jitted check(slot_tabs[R,K,4], slot_active[R,K], targets[R])."""
     return jax.jit(_check_one_fn(model, cfg))
+
+
+# Step-axis limit for ONE scan program. The axon TPU worker reliably
+# crashes compiling/running a ~100k-step scan (40k is fine); beyond this,
+# the search runs as a host-driven loop of fixed-size scan chunks with
+# the (tiny) carry staying on device between calls.
+LONG_SCAN_CHUNK = 16384
+
+
+def _chunk_fn(model: Model, cfg: DenseConfig):
+    """jitted (carry, tabs[C,K,4], act[C,K], tgts[C], idx0) ->
+    (carry', configs-partial f32 scalar) — the partial sums accumulate
+    device-side across chunks and are fetched once at the end."""
+    step, transitions = make_step_fn3(model, cfg)
+
+    def run(carry, tabs, act, tgts, idx0):
+        trans = jax.vmap(transitions)(tabs, act)
+        idxs = idx0 + jnp.arange(tgts.shape[0], dtype=jnp.int32)
+        carry, ns = jax.lax.scan(step, carry, (trans, tgts, idxs))
+        return carry, jnp.sum(ns.astype(jnp.float32))
+
+    return jax.jit(run)
+
+
+def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
+                      chunk: int | None = None) -> dict:
+    """Single-history dense check for histories whose step count exceeds
+    one scan program: pad to a chunk multiple, loop chunks host-side.
+    Bit-identical to check_steps3 (same step fn; pads contribute nothing).
+
+    Chunk size scales inversely with table width so one chunk's wall time
+    stays far under the axon worker's program-kill threshold (sweep cost
+    per step is proportional to the cell count)."""
+    if chunk is None:
+        cells = cfg.n_states * cfg.n_masks
+        chunk = min(LONG_SCAN_CHUNK,
+                    max(512, LONG_SCAN_CHUNK * (1 << 15) // max(cells, 1)))
+    key = ("chunk3", model.cache_key(), cfg, chunk)
+    if key not in _CACHE:
+        _CACHE[key] = _chunk_fn(model, cfg)
+    run = _CACHE[key]
+    n = rs.n_steps
+    n_pad = (n + chunk - 1) // chunk * chunk
+    rs = rs.padded_to(n_pad)
+    carry = _init_carry3(model, cfg)
+    cfgs_dev = None
+    for c in range(n_pad // chunk):
+        sl = slice(c * chunk, (c + 1) * chunk)
+        carry, part = run(carry, jnp.asarray(rs.slot_tabs[sl]),
+                          jnp.asarray(rs.slot_active[sl]),
+                          jnp.asarray(rs.targets[sl]),
+                          jnp.int32(c * chunk))
+        cfgs_dev = part if cfgs_dev is None else cfgs_dev + part
+        # Early exit on death: one 1-byte fetch per chunk (~0.1 s on a
+        # tunneled backend) vs minutes of dead chunks on wide tables.
+        if bool(np.asarray(carry.dead)):
+            break
+    from .wgl import verdict
+
+    # One packed fetch at the end (chunks chain device-side).
+    packed = np.asarray(jnp.stack([
+        jnp.where(carry.dead, 0, 1),
+        carry.dead_step, carry.max_frontier,
+        jnp.clip(cfgs_dev, 0, 2**31 - 1).astype(jnp.int32)]))
+    out = {
+        "survived": bool(packed[0]),
+        "overflow": False,
+        "dead_step": int(packed[1]),
+        "max_frontier": int(packed[2]),
+        "configs_explored": int(packed[3]),
+    }
+    out["valid"] = verdict(out)
+    return out
+
+
+# One-scan-program step limit for the NON-chunked XLA path (a ~100k-step
+# scan crashes the axon worker; ~32k is tested-good). Batches padded
+# beyond it route per-history through check_steps3_long.
+LONG_SCAN_MAX = 32768
 
 
 def make_batch_checker3(model: Model, cfg: DenseConfig):

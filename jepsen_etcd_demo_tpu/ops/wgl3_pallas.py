@@ -324,8 +324,19 @@ def cached_batch_checker_pallas(model: Model, cfg: DenseConfig,
     return _CACHE[key]
 
 
-def pallas_feasible(cfg: DenseConfig | None) -> bool:
-    return cfg is not None and cfg.k_slots <= MAX_K_PALLAS
+# Longest padded step axis the pallas path accepts. The targets table is
+# scalar-prefetched whole into SMEM (4 bytes/step); ~100k steps crashed
+# the TPU worker outright (SMEM exhaustion on the axon backend), while
+# 8192 (the 10k-op bench) is routinely fine. 16384 = 64 KiB of SMEM, a
+# 2x margin over the tested regime; longer histories route to the XLA
+# kernel, whose scan streams targets from HBM.
+MAX_R_PALLAS = 16384
+
+
+def pallas_feasible(cfg: DenseConfig | None,
+                    n_steps: int | None = None) -> bool:
+    return (cfg is not None and cfg.k_slots <= MAX_K_PALLAS
+            and (n_steps is None or n_steps <= MAX_R_PALLAS))
 
 
 def pallas_available() -> bool:
@@ -338,10 +349,11 @@ def pallas_available() -> bool:
         return False
 
 
-def use_pallas(cfg: DenseConfig | None) -> bool:
+def use_pallas(cfg: DenseConfig | None,
+               n_steps: int | None = None) -> bool:
     """Production routing predicate: dense geometry fits the kernel AND a
     TPU backend is live."""
-    return pallas_feasible(cfg) and pallas_available()
+    return pallas_feasible(cfg, n_steps) and pallas_available()
 
 
 def check_batch_encoded_pallas(encs: Sequence[EncodedHistory],
@@ -354,21 +366,80 @@ def check_batch_encoded_pallas(encs: Sequence[EncodedHistory],
         from ..models import CASRegister
         model = CASRegister()
     cfg, arrays, steps = batch_arrays3(encs, model)
-    if not pallas_feasible(cfg):
-        raise ValueError(f"pallas infeasible for k_slots={cfg.k_slots}")
+    if not pallas_feasible(cfg, n_steps=arrays[2].shape[1]):
+        raise ValueError(
+            f"pallas infeasible for k_slots={cfg.k_slots}, "
+            f"n_steps={arrays[2].shape[1]}")
     check = cached_batch_checker_pallas(model, cfg, interpret)
     return assemble_batch_results(unpack_np(check(*arrays)), steps, cfg)
 
 
-def packed_batch_checker(model: Model, cfg: DenseConfig):
+def check_encoded_general(enc: EncodedHistory, model: Model,
+                          f_cap: int = 256,
+                          f_cap_max: int | None = None) -> dict:
+    """The exact-verdict ladder for geometries OUTSIDE the dense budget
+    (wide pending sets / huge values):
+
+      1. resumable sort kernel, with f_cap capped so the per-step sort
+         stays under the axon worker's allocation fault (~2M keys);
+      2. if the live frontier outgrows that cap, the dense subset-lattice
+         run CHUNKED with a budget override — per-step cost is 2^K bits
+         but capacity is unconditionally exact, and small chunks keep
+         each program under the worker's kill threshold.
+
+    Every rung is exact; there is no oracle fallback. The result carries
+    a "kernel" key naming the rung that produced the verdict."""
+    from . import wgl2, wgl3
+    from .encode import encode_return_steps, reslot_events
+
+    tight = wgl2.sort_k_slots(enc)   # f_cap_max sizing must match the
+    #                                  width the sort kernel really uses
+    if f_cap_max is None:
+        # The ~2M-key sort allocation fault is an axon-TPU-worker limit;
+        # other backends take the sort kernel as far as memory goes.
+        if pallas_available():
+            f_cap_max = max(4096, min(1 << 20, (1 << 21) // (tight + 1)))
+        else:
+            f_cap_max = 1 << 20
+    try:
+        out = wgl2.check_encoded_resumable(enc, model, f_cap=f_cap,
+                                           f_cap_max=f_cap_max)
+        out["kernel"] = "wgl2-sort-resumable"
+        return out
+    except MemoryError:
+        cfg = wgl3.dense_config(model, tight, enc.max_value,
+                                budget=1 << 26)
+        if cfg is None:
+            raise
+        if enc.k_slots != tight:
+            enc = reslot_events(enc, tight)
+        out = wgl3.check_steps3_long(encode_return_steps(enc), model, cfg)
+        out["op_count"] = enc.n_ops
+        out["f_cap"] = cfg.n_states * cfg.n_masks
+        out["escalations"] = 0
+        out["kernel"] = "wgl3-dense-chunked"
+        return out
+
+
+def packed_batch_checker(model: Model, cfg: DenseConfig,
+                         n_steps: int | None = None):
     """THE routing point between the two dense backends: returns
     (packed_check_fn, kernel_name). Every production consumer (bench, the
     Linearizable/Independent checkers) routes through here or through
     check_batch_encoded_auto, so a feasibility/backend change lands in one
-    place."""
+    place. `n_steps` is the padded step-axis length when known (very long
+    histories exceed the pallas SMEM budget and route to XLA)."""
     from . import wgl3
 
-    if use_pallas(cfg):
+    if n_steps is not None and n_steps > wgl3.LONG_SCAN_MAX:
+        # Neither packed checker survives a scan program this long on the
+        # axon worker; callers must go through check_batch_encoded_auto /
+        # check_steps3_long, which chunk the step axis host-side.
+        raise ValueError(
+            f"n_steps={n_steps} exceeds one scan program "
+            f"(LONG_SCAN_MAX={wgl3.LONG_SCAN_MAX}); use "
+            f"check_batch_encoded_auto or wgl3.check_steps3_long")
+    if use_pallas(cfg, n_steps):
         return cached_batch_checker_pallas(model, cfg), "wgl3-dense-pallas"
     return wgl3.cached_batch_checker3_packed(model, cfg), "wgl3-dense"
 
@@ -378,12 +449,32 @@ def check_batch_encoded_auto(encs: Sequence[EncodedHistory],
                              ) -> tuple[list[dict], str]:
     """Route a batch to the best dense backend for this platform; returns
     (per-history results, kernel_name)."""
+    from . import wgl3
     from .wgl3 import assemble_batch_results, unpack_np
 
     if model is None:
         from ..models import CASRegister
         model = CASRegister()
+    from .wgl3 import tight_k_slots
+
+    k = max(tight_k_slots(e) for e in encs)
+    if dense_config(model, k, max(e.max_value for e in encs)) is None:
+        results = [check_encoded_general(e, model) for e in encs]
+        kernels = {one["kernel"] for one in results}
+        return results, (kernels.pop() if len(kernels) == 1 else "mixed")
     cfg, arrays, steps = batch_arrays3(encs, model)
-    check, name = packed_batch_checker(model, cfg)
+    R = arrays[2].shape[1]
+    if R > wgl3.LONG_SCAN_MAX:
+        # Step count exceeds what one scan program can hold: host-driven
+        # chunked scans, one history at a time (histories this long come
+        # alone in practice).
+        results = []
+        for s in steps:
+            one = wgl3.check_steps3_long(s, model, cfg)
+            one["op_count"] = s.n_ops
+            one["table_cells"] = cfg.n_states * cfg.n_masks
+            results.append(one)
+        return results, "wgl3-dense-chunked"
+    check, name = packed_batch_checker(model, cfg, n_steps=R)
     return assemble_batch_results(unpack_np(check(*arrays)), steps,
                                   cfg), name

@@ -188,3 +188,65 @@ def test_configs_explored_metric():
     from jepsen_etcd_demo_tpu.ops import wgl3
     batch = wgl3.check_batch_encoded3(encs, CASRegister())
     assert all(one["configs_explored"] > 0 for one in batch)
+
+
+def _wide_history(n_procs=18, writes=True):
+    """max_pending == n_procs: every process invokes before any completes,
+    pushing tight_k_slots past the dense budget (k >= 18)."""
+    from jepsen_etcd_demo_tpu.ops.op import Op
+    h = []
+    for p in range(n_procs):
+        h.append(Op(type="invoke", f="write", value=p % 5, process=p))
+    for p in range(n_procs):
+        h.append(Op(type="ok", f="write", value=p % 5, process=p))
+    h.append(Op(type="invoke", f="read", value=None, process=0))
+    h.append(Op(type="ok", f="read", value=(n_procs - 1) % 5, process=0))
+    return h
+
+
+def test_wide_pending_routes_to_sort_kernel():
+    """k beyond the dense cell budget: the auto router must hand the batch
+    to the resumable sort kernel, with verdicts matching the oracle."""
+    from jepsen_etcd_demo_tpu.ops import wgl3, wgl3_pallas
+    h = _wide_history()
+    enc = encode_register_history(h, k_slots=32)
+    assert wgl3.dense_config(CASRegister(), wgl3.tight_k_slots(enc),
+                             enc.max_value) is None
+    results, kernel = wgl3_pallas.check_batch_encoded_auto([enc])
+    assert kernel == "wgl2-sort-resumable"
+    assert results[0]["valid"] is check_events_oracle(
+        enc, CASRegister()).valid
+
+
+def test_general_ladder_falls_back_to_dense_chunked():
+    """When the live frontier outgrows every permissible f_cap, the ladder
+    must fall through to the chunked dense lattice and still return the
+    oracle's exact verdict (never a Python fallback, never a crash)."""
+    from jepsen_etcd_demo_tpu.ops import wgl3_pallas
+    h = _wide_history()
+    enc = encode_register_history(h, k_slots=32)
+    out = wgl3_pallas.check_encoded_general(enc, CASRegister(),
+                                            f_cap=4, f_cap_max=16)
+    want = check_events_oracle(enc, CASRegister())
+    assert out["valid"] is want.valid
+    assert out["max_frontier"] == want.max_frontier
+    assert out["op_count"] == enc.n_ops
+
+
+def test_general_ladder_detects_invalid_and_reports_kernel():
+    """The dense-chunked rung must catch a violation (early-exit path) and
+    results must name the rung that produced the verdict."""
+    from jepsen_etcd_demo_tpu.ops import wgl3_pallas
+    from jepsen_etcd_demo_tpu.ops.op import Op
+    h = _wide_history()
+    # Corrupt the final read: 5 was never written (writes draw from 0-4;
+    # the value stays small so the dense state bound holds).
+    h[-1] = Op(type="ok", f="read", value=5, process=0)
+    enc = encode_register_history(h, k_slots=32)
+    out = wgl3_pallas.check_encoded_general(enc, CASRegister(),
+                                            f_cap=4, f_cap_max=16)
+    assert out["valid"] is False
+    assert out["kernel"] == "wgl3-dense-chunked"
+    assert out["dead_step"] >= 0
+    want = check_events_oracle(enc, CASRegister())
+    assert want.valid is False
