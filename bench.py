@@ -64,7 +64,7 @@ def bench_corpus(model):
     # i32[B,5] (ONE device->host fetch — per-fetch round trips dominate
     # wall time on tunneled backends).
     check, kernel_name = wgl3_pallas.packed_batch_checker(
-        model, cfg, n_steps=arrays[2].shape[1])
+        model, cfg, n_steps=arrays[2].shape[1], batch=arrays[2].shape[0])
     out = wgl3.unpack_np(check(*arrays))  # compile + warmup
     assert out["survived"].all(), "bench corpus must be valid by construction"
     best = float("inf")

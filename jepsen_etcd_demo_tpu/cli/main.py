@@ -93,6 +93,13 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--model", default="cas-register")
     a.add_argument("--backend", default="jax", choices=["jax", "oracle"])
 
+    c = sub.add_parser(
+        "corpus",
+        help="re-check EVERY stored run's per-key histories in one "
+             "batched kernel launch (corpus replay)")
+    c.add_argument("store_root", help="results store root directory")
+    c.add_argument("--model", default="cas-register")
+
     s = sub.add_parser("serve", help="serve the results store over http")
     s.add_argument("--port", type=int, default=8080)
     s.add_argument("--host", default="127.0.0.1")
@@ -164,6 +171,56 @@ def cmd_analyze(args) -> int:
     return 0 if result.get("valid") is True else 1
 
 
+def cmd_corpus(args) -> int:
+    """Corpus replay (BASELINE configs[4]): gather every stored run's
+    per-key register histories and verify them all in ONE batched launch
+    of the dense kernel — the framework's answer to re-checking a store
+    full of histories after a checker change."""
+    import time
+
+    from ..checkers import Linearizable
+    from ..checkers.independent import split_by_key
+    from ..ops import wgl3_pallas
+    from ..store.store import Store
+
+    # Linearizable.encode: model op-translation + slot-table escalation
+    # (a run whose partitions piled up >32 forever-pending :info ops must
+    # not crash the whole corpus pass).
+    lin = Linearizable(model=args.model)
+    entries = []   # (run_name, key, encoded)
+    for run in Store(args.store_root).runs():
+        try:
+            keyed = split_by_key(run.read_history())
+        except (ValueError, OSError) as e:
+            print(f"# skipping {run.path}: {e}", file=sys.stderr)
+            continue
+        for k, h in sorted(keyed.items(), key=lambda kv: str(kv[0])):
+            try:
+                entries.append((str(run.path), k, lin.encode(h)))
+            except ValueError as e:
+                print(f"# skipping {run.path} key {k}: {e}",
+                      file=sys.stderr)
+    if not entries:
+        print(json.dumps({"valid": True, "runs": 0, "keys": 0}))
+        return 0
+    t0 = time.perf_counter()
+    results, kernel = wgl3_pallas.check_batch_encoded_auto(
+        [e[2] for e in entries], lin.model)
+    wall = time.perf_counter() - t0
+    invalid = [{"run": r, "key": k}
+               for (r, k, _), one in zip(entries, results)
+               if one["valid"] is not True]
+    print(json.dumps({
+        "valid": not invalid,
+        "runs": len({r for r, _, _ in entries}),
+        "keys": len(entries),
+        "invalid": invalid,
+        "kernel": kernel,
+        "wall_s": round(wall, 3),
+    }))
+    return 0 if not invalid else 1
+
+
 def cmd_serve(args) -> int:
     from ..web.server import serve
     serve(args.store, host=args.host, port=args.port)
@@ -179,6 +236,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return cmd_test(args)
     if args.command == "analyze":
         return cmd_analyze(args)
+    if args.command == "corpus":
+        return cmd_corpus(args)
     if args.command == "serve":
         return cmd_serve(args)
     return 2

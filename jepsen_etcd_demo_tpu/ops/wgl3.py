@@ -481,13 +481,13 @@ def check_encoded3(enc: EncodedHistory, model: Model | None = None,
     return check_steps3(rs, model, cfg)
 
 
-def batch_arrays3(encs: Sequence[EncodedHistory], model: Model,
-                  cfg: DenseConfig | None = None):
-    """Tighten/reslot/encode/pad/stack a batch of event encodings for one
-    vmapped dense launch. Returns (cfg, (tabs, act, tgt), steps) — `steps`
-    are the per-history ReturnSteps (for op counts etc). Single source of
-    the batched-launch plumbing for the independent checker, the bench, and
-    the tests."""
+def batch_steps3(encs: Sequence[EncodedHistory], model: Model,
+                 cfg: DenseConfig | None = None):
+    """HOST-side half of the batched-launch plumbing: tighten/reslot/
+    encode a batch into per-history ReturnSteps and the bucketed common
+    step count. No device transfer happens here, so routers can inspect
+    (cfg, r_cap) and choose a backend before committing tens of MB to a
+    (possibly tunneled) device."""
     from .encode import reslot_events
 
     k = max(tight_k_slots(e) for e in encs)
@@ -498,11 +498,24 @@ def batch_arrays3(encs: Sequence[EncodedHistory], model: Model,
     steps = [encode_return_steps(
         reslot_events(e, k) if e.k_slots != k else e) for e in encs]
     r_cap = step_bucket(max(s.n_steps for s in steps))
+    return cfg, steps, r_cap
+
+
+def stack_steps3(steps, r_cap: int):
+    """DEVICE-side half: pad to the common step count, stack, transfer."""
     padded = [s.padded_to(r_cap) for s in steps]
-    arrays = (jnp.asarray(np.stack([p.slot_tabs for p in padded])),
-              jnp.asarray(np.stack([p.slot_active for p in padded])),
-              jnp.asarray(np.stack([p.targets for p in padded])))
-    return cfg, arrays, steps
+    return (jnp.asarray(np.stack([p.slot_tabs for p in padded])),
+            jnp.asarray(np.stack([p.slot_active for p in padded])),
+            jnp.asarray(np.stack([p.targets for p in padded])))
+
+
+def batch_arrays3(encs: Sequence[EncodedHistory], model: Model,
+                  cfg: DenseConfig | None = None):
+    """Tighten/reslot/encode/pad/stack a batch of event encodings for one
+    vmapped dense launch. Returns (cfg, (tabs, act, tgt), steps) — `steps`
+    are the per-history ReturnSteps (for op counts etc)."""
+    cfg, steps, r_cap = batch_steps3(encs, model, cfg)
+    return cfg, stack_steps3(steps, r_cap), steps
 
 
 def assemble_batch_results(out: dict, steps, cfg: DenseConfig) -> list[dict]:

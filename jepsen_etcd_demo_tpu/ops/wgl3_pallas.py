@@ -324,19 +324,24 @@ def cached_batch_checker_pallas(model: Model, cfg: DenseConfig,
     return _CACHE[key]
 
 
-# Longest padded step axis the pallas path accepts. The targets table is
-# scalar-prefetched whole into SMEM (4 bytes/step); ~100k steps crashed
-# the TPU worker outright (SMEM exhaustion on the axon backend), while
-# 8192 (the 10k-op bench) is routinely fine. 16384 = 64 KiB of SMEM, a
-# 2x margin over the tested regime; longer histories route to the XLA
+# Bounds on the scalar-prefetched targets table [B, R_pad] (whole thing
+# lands in SMEM, 4 bytes/entry). Empirically on the axon worker:
+# [1024, 128] (the bench corpus, 512 KiB) and [1, 16384] run routinely;
+# [1, ~98k] kills the worker. The two caps keep launches inside the
+# tested-good envelope on BOTH axes — per-history steps and total
+# prefetch entries — with ~2x margin; anything bigger routes to the XLA
 # kernel, whose scan streams targets from HBM.
 MAX_R_PALLAS = 16384
+MAX_PREFETCH_PALLAS = 1 << 18
 
 
 def pallas_feasible(cfg: DenseConfig | None,
-                    n_steps: int | None = None) -> bool:
+                    n_steps: int | None = None,
+                    batch: int | None = None) -> bool:
     return (cfg is not None and cfg.k_slots <= MAX_K_PALLAS
-            and (n_steps is None or n_steps <= MAX_R_PALLAS))
+            and (n_steps is None or n_steps <= MAX_R_PALLAS)
+            and (n_steps is None or batch is None
+                 or batch * n_steps <= MAX_PREFETCH_PALLAS))
 
 
 def pallas_available() -> bool:
@@ -350,10 +355,11 @@ def pallas_available() -> bool:
 
 
 def use_pallas(cfg: DenseConfig | None,
-               n_steps: int | None = None) -> bool:
+               n_steps: int | None = None,
+               batch: int | None = None) -> bool:
     """Production routing predicate: dense geometry fits the kernel AND a
     TPU backend is live."""
-    return pallas_feasible(cfg, n_steps) and pallas_available()
+    return pallas_feasible(cfg, n_steps, batch) and pallas_available()
 
 
 def check_batch_encoded_pallas(encs: Sequence[EncodedHistory],
@@ -422,13 +428,15 @@ def check_encoded_general(enc: EncodedHistory, model: Model,
 
 
 def packed_batch_checker(model: Model, cfg: DenseConfig,
-                         n_steps: int | None = None):
+                         n_steps: int | None = None,
+                         batch: int | None = None):
     """THE routing point between the two dense backends: returns
     (packed_check_fn, kernel_name). Every production consumer (bench, the
     Linearizable/Independent checkers) routes through here or through
     check_batch_encoded_auto, so a feasibility/backend change lands in one
-    place. `n_steps` is the padded step-axis length when known (very long
-    histories exceed the pallas SMEM budget and route to XLA)."""
+    place. `n_steps` is the padded step-axis length and `batch` the batch
+    size when known (very long histories exceed the pallas SMEM budget
+    and route to XLA)."""
     from . import wgl3
 
     if n_steps is not None and n_steps > wgl3.LONG_SCAN_MAX:
@@ -439,7 +447,7 @@ def packed_batch_checker(model: Model, cfg: DenseConfig,
             f"n_steps={n_steps} exceeds one scan program "
             f"(LONG_SCAN_MAX={wgl3.LONG_SCAN_MAX}); use "
             f"check_batch_encoded_auto or wgl3.check_steps3_long")
-    if use_pallas(cfg, n_steps):
+    if use_pallas(cfg, n_steps, batch):
         return cached_batch_checker_pallas(model, cfg), "wgl3-dense-pallas"
     return wgl3.cached_batch_checker3_packed(model, cfg), "wgl3-dense"
 
@@ -448,33 +456,57 @@ def check_batch_encoded_auto(encs: Sequence[EncodedHistory],
                              model: Model | None = None
                              ) -> tuple[list[dict], str]:
     """Route a batch to the best dense backend for this platform; returns
-    (per-history results, kernel_name)."""
+    (per-history results, kernel_name — "mixed" when histories split
+    across backends).
+
+    The batch is PARTITIONED by per-history dense feasibility: one wide
+    or huge-value history must not demote a whole corpus to sequential
+    ladder runs — the feasible majority still goes through one batched
+    launch."""
     from . import wgl3
-    from .wgl3 import assemble_batch_results, unpack_np
 
     if model is None:
         from ..models import CASRegister
         model = CASRegister()
-    from .wgl3 import tight_k_slots
+    dense_idx, general_idx = [], []
+    for i, e in enumerate(encs):
+        ok = dense_config(model, wgl3.tight_k_slots(e), e.max_value)
+        (dense_idx if ok is not None else general_idx).append(i)
 
-    k = max(tight_k_slots(e) for e in encs)
-    if dense_config(model, k, max(e.max_value for e in encs)) is None:
-        results = [check_encoded_general(e, model) for e in encs]
-        kernels = {one["kernel"] for one in results}
-        return results, (kernels.pop() if len(kernels) == 1 else "mixed")
-    cfg, arrays, steps = batch_arrays3(encs, model)
-    R = arrays[2].shape[1]
-    if R > wgl3.LONG_SCAN_MAX:
-        # Step count exceeds what one scan program can hold: host-driven
-        # chunked scans, one history at a time (histories this long come
-        # alone in practice).
-        results = []
-        for s in steps:
-            one = wgl3.check_steps3_long(s, model, cfg)
-            one["op_count"] = s.n_ops
-            one["table_cells"] = cfg.n_states * cfg.n_masks
-            results.append(one)
-        return results, "wgl3-dense-chunked"
-    check, name = packed_batch_checker(model, cfg, n_steps=R)
-    return assemble_batch_results(unpack_np(check(*arrays)), steps,
-                                  cfg), name
+    results: list = [None] * len(encs)
+    kernels: set[str] = set()
+    if dense_idx:
+        sub = [encs[i] for i in dense_idx]
+        try:
+            cfg, steps, r_cap = wgl3.batch_steps3(sub, model)
+        except ValueError:
+            # Individually feasible but not under one SHARED geometry
+            # (e.g. one history's k with another's value range): ladder
+            # each — rare extreme, correctness over batching.
+            general_idx = sorted(general_idx + dense_idx)
+            dense_idx = []
+        else:
+            if r_cap > wgl3.LONG_SCAN_MAX:
+                # Step count exceeds one scan program: host-driven chunked
+                # scans, one history at a time — arrays never stacked or
+                # transferred (check_steps3_long streams chunk by chunk).
+                for i, s in zip(dense_idx, steps):
+                    one = wgl3.check_steps3_long(s, model, cfg)
+                    one["op_count"] = s.n_ops
+                    one["table_cells"] = cfg.n_states * cfg.n_masks
+                    results[i] = one
+                kernels.add("wgl3-dense-chunked")
+            else:
+                arrays = wgl3.stack_steps3(steps, r_cap)
+                check, name = packed_batch_checker(
+                    model, cfg, n_steps=r_cap, batch=len(sub))
+                batch_out = wgl3.assemble_batch_results(
+                    wgl3.unpack_np(check(*arrays)), steps, cfg)
+                for i, one in zip(dense_idx, batch_out):
+                    results[i] = one
+                kernels.add(name)
+    for i in general_idx:
+        one = check_encoded_general(encs[i], model)
+        results[i] = one
+        kernels.add(one["kernel"])
+    return results, (kernels.pop() if len(kernels) == 1 else "mixed")

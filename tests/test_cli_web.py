@@ -141,3 +141,31 @@ class TestWebServer:
             assert '"invoke"' in hist
         finally:
             httpd.shutdown()
+
+
+def test_corpus_replay_batches_all_runs(tmp_path, capsys):
+    """`corpus` re-checks every stored run's per-key histories in one
+    batched launch (BASELINE configs[4]): a healthy store exits 0; adding
+    a corrupted run flips the corpus verdict to 1 and names the run."""
+    import json as _json
+
+    store = str(tmp_path / "store")
+    assert main(["test", "-w", "register", "--fake", "--no-nemesis",
+                 "--time-limit", "1.2", "--rate", "150",
+                 "--store", store, "--seed", "21"]) == 0
+    assert main(["test", "-w", "register", "--fake", "--no-nemesis",
+                 "--time-limit", "1.2", "--rate", "150",
+                 "--store", store, "--seed", "22"]) == 0
+    rc = main(["corpus", store])
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["valid"] is True
+    assert out["runs"] == 2 and out["keys"] >= 2
+
+    assert main(["test", "-w", "register", "--fake", "--no-nemesis",
+                 "--time-limit", "1.2", "--rate", "150",
+                 "--store", store, "--seed", "23",
+                 "--stale-read-prob", "0.8"]) == 1
+    rc = main(["corpus", store])
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1 and out["valid"] is False
+    assert out["invalid"] and out["runs"] == 3

@@ -250,3 +250,21 @@ def test_general_ladder_detects_invalid_and_reports_kernel():
     assert out["dead_step"] >= 0
     want = check_events_oracle(enc, CASRegister())
     assert want.valid is False
+
+
+def test_auto_partitions_mixed_batches():
+    """One dense-infeasible history in a batch must not demote the rest:
+    the feasible histories still go through one batched dense launch and
+    the kernel label reports the mix."""
+    from jepsen_etcd_demo_tpu.ops import wgl3_pallas
+    rng = random.Random(0xA11)
+    encs = [encode_register_history(
+        gen_register_history(random.Random(i), n_ops=40, n_procs=5),
+        k_slots=16) for i in range(3)]
+    wide = encode_register_history(_wide_history(), k_slots=32)
+    results, kernel = wgl3_pallas.check_batch_encoded_auto(
+        encs + [wide], CASRegister())
+    assert kernel == "mixed"
+    for enc, one in zip(encs + [wide], results):
+        assert one["valid"] is check_events_oracle(enc, CASRegister()).valid
+    assert results[-1]["kernel"] == "wgl2-sort-resumable"
