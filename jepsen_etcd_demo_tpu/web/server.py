@@ -15,26 +15,57 @@ from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
 from ..store import Store
 
 
+def _run_summary(results: dict) -> str:
+    """Compact why-it-failed / what-ran column: op count, rate, and for
+    invalid runs the failing detail (per-key failed ops, elle anomaly
+    types) pulled from the composed result tree."""
+    bits = []
+    perf = results.get("perf") or {}
+    if perf.get("count"):
+        bits.append(f"{perf['count']} ops")
+    if perf.get("rate_hz"):
+        bits.append(f"{perf['rate_hz']:.0f}/s")
+    indep = results.get("indep") or {}
+    for key, sub in (indep.get("results") or {}).items():
+        lin = sub.get("linear", sub) if isinstance(sub, dict) else {}
+        if isinstance(lin, dict) and lin.get("valid") is False:
+            op = lin.get("failed_op")
+            bits.append(f"key {key}: {op}" if op else f"key {key}: invalid")
+    elle = indep.get("elle") or {}
+    if elle.get("anomaly_types"):
+        bits.append("anomalies: " + ", ".join(elle["anomaly_types"]))
+    if indep.get("lost_count"):   # untruncated ('lost' caps at 100)
+        bits.append(f"lost adds: {indep['lost_count']}")
+    return "; ".join(str(b) for b in bits[:4])
+
+
 def _index_html(store: Store) -> str:
     rows = []
     for run in reversed(store.runs()):
         rel = run.path.relative_to(store.root)
         try:
-            valid = run.read_results().get("valid")
+            results = run.read_results()
+            valid = results.get("valid")
         except Exception:
-            valid = "?"
+            results, valid = {}, "?"
+        try:
+            summary = _run_summary(results)
+        except Exception:   # off-schema results must not hide the verdict
+            summary = ""
         color = {True: "#2a9d43", False: "#d43a2a"}.get(valid, "#e9a820")
         href = urllib.parse.quote(f"/files/{rel}/")
         rows.append(
             f"<tr><td><a href='{href}'>"
             f"{html.escape(str(rel))}</a></td>"
-            f"<td style='color:{color};font-weight:bold'>{valid}</td></tr>")
+            f"<td style='color:{color};font-weight:bold'>{valid}</td>"
+            f"<td style='color:#666'>{html.escape(summary)}</td></tr>")
     return (
         "<!doctype html><html><head><meta charset='utf-8'>"
         "<title>jepsen-tpu store</title>"
         "<style>body{font-family:sans-serif}td{padding:4px 12px}</style>"
         "</head><body><h2>test runs</h2>"
-        f"<table><tr><th>run</th><th>valid</th></tr>{''.join(rows)}</table>"
+        f"<table><tr><th>run</th><th>valid</th><th>detail</th></tr>"
+        f"{''.join(rows)}</table>"
         "</body></html>")
 
 

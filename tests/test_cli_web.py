@@ -169,3 +169,22 @@ def test_corpus_replay_batches_all_runs(tmp_path, capsys):
     out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 1 and out["valid"] is False
     assert out["invalid"] and out["runs"] == 3
+
+
+def test_index_shows_failure_detail(tmp_path):
+    """The run index's detail column surfaces WHY an invalid run failed
+    (the per-key failing op from the witness)."""
+    assert _run_cli(tmp_path, "--stale-read-prob", "0.8",
+                    "--no-nemesis", time_limit="1.0") == 1
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                make_handler(str(tmp_path / "store")))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+    try:
+        idx = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/").read().decode()
+        assert "False" in idx
+        assert "key " in idx        # detail names the failing key
+        assert " ops" in idx        # perf count rendered
+    finally:
+        httpd.shutdown()
