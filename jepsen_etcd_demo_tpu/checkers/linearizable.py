@@ -131,10 +131,16 @@ class Linearizable(Checker):
 
         out = wgl3_pallas.check_encoded_general(enc, self.model,
                                                 f_cap=self.f_cap)
-        return {"valid": out["valid"], "backend": "jax",
-                "op_count": out["op_count"],
-                "dead_step": out["dead_step"],
-                "max_frontier": out["max_frontier"],
-                "overflow": False,
-                "f_cap": out["f_cap"],
-                "escalations": out["escalations"]}
+        res = {"valid": out["valid"], "backend": "jax",
+               "op_count": out["op_count"],
+               "dead_step": out["dead_step"],
+               "max_frontier": out["max_frontier"],
+               # exhaustion carries overflow=True + error context;
+               # every exact rung reports False
+               "overflow": out.get("overflow", False),
+               "f_cap": out["f_cap"],
+               "escalations": out["escalations"]}
+        for extra in ("kernel", "error"):
+            if extra in out:
+                res[extra] = out[extra]
+        return res

@@ -303,9 +303,12 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
     stays far under the axon worker's program-kill threshold (sweep cost
     per step is proportional to the cell count)."""
     if chunk is None:
+        # Floor 128: at the 2^26-cell budget ceiling a step costs ~70 ms,
+        # so even the floor chunk stays ~10 s — safely under the axon
+        # worker's program-kill threshold.
         cells = cfg.n_states * cfg.n_masks
         chunk = min(LONG_SCAN_CHUNK,
-                    max(512, LONG_SCAN_CHUNK * (1 << 15) // max(cells, 1)))
+                    max(128, LONG_SCAN_CHUNK * (1 << 15) // max(cells, 1)))
     key = ("chunk3", model.cache_key(), cfg, chunk)
     if key not in _CACHE:
         _CACHE[key] = _chunk_fn(model, cfg)

@@ -394,7 +394,14 @@ def check_encoded_general(enc: EncodedHistory, model: Model,
          each program under the worker's kill threshold.
 
     Every rung is exact; there is no oracle fallback. The result carries
-    a "kernel" key naming the rung that produced the verdict."""
+    a "kernel" key naming the rung that produced the verdict. When the
+    geometry defeats every rung (frontier past every permissible f_cap
+    AND a lattice too wide to sweep — seen at ~28 pending ops, where the
+    dense table would be 2^31 cells), the verdict is the honest tri-state
+    "unknown" with overflow=True, never a crash: the jepsen checker
+    contract (and knossos' behavior at its own limits) is an
+    indeterminate result, and merge_valid propagates it so the run exits
+    nonzero."""
     from . import wgl2, wgl3
     from .encode import encode_return_steps, reslot_events
 
@@ -412,11 +419,15 @@ def check_encoded_general(enc: EncodedHistory, model: Model,
                                            f_cap_max=f_cap_max)
         out["kernel"] = "wgl2-sort-resumable"
         return out
-    except MemoryError:
+    except MemoryError as e:
         cfg = wgl3.dense_config(model, tight, enc.max_value,
                                 budget=1 << 26)
         if cfg is None:
-            raise
+            return {"valid": "unknown", "survived": False, "overflow": True,
+                    "dead_step": -1, "max_frontier": -1,
+                    "op_count": enc.n_ops, "f_cap": f_cap_max,
+                    "escalations": -1, "kernel": "exhausted",
+                    "error": str(e)}
         if enc.k_slots != tight:
             enc = reslot_events(enc, tight)
         out = wgl3.check_steps3_long(encode_return_steps(enc), model, cfg)
