@@ -124,7 +124,7 @@ def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]]
     Prefers the dense lattice kernel (wgl3) — exact, no overflow — whenever
     the shared config table is feasible; falls back to the sort kernel."""
     from ..ops import wgl, wgl2, wgl3
-    from ..ops.encode import (encode_return_steps, encode_register_history,
+    from ..ops.encode import (encode_return_steps, encode_history,
                               reslot_events, ReturnSteps)
     import jax.numpy as jnp
 
@@ -164,8 +164,8 @@ def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]]
         if e.k_slots != k_slots:
             # Re-encode through the model's op translation (mutex
             # acquire/release -> cas) exactly as lin.encode did above.
-            e = encode_register_history(
-                lin.model.prepare_history(keyed[k]), k_slots=k_slots)
+            e = encode_history(lin.model.prepare_history(keyed[k]),
+                               lin.model, k_slots=k_slots)
         encs[k] = encode_return_steps(e)
     r_cap = max(1, max(e.slot_tabs.shape[0] for e in encs.values()))
     keys = list(encs)

@@ -19,8 +19,7 @@ from .oracle import check_events_oracle
 from ..ops.encode import EV_RETURN
 from ..models import Model, get_model
 from ..ops.op import Op
-from ..ops.encode import (EncodedHistory, SlotOverflow,
-                          encode_register_history)
+from ..ops.encode import EncodedHistory, SlotOverflow, encode_history
 
 
 def _event_to_step(enc: EncodedHistory, dead_event: int) -> int:
@@ -50,7 +49,7 @@ class Linearizable(Checker):
         k = self.k_slots
         while True:
             try:
-                return encode_register_history(history, k_slots=k)
+                return encode_history(history, self.model, k_slots=k)
             except SlotOverflow:
                 if k >= 4096:
                     raise

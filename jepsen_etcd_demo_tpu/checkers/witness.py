@@ -63,7 +63,7 @@ def reconstruct_witness(enc: EncodedHistory, model: Model,
     events = np.asarray(enc.events)
     sources: list[Optional[Invocation]] = []
     if history is not None:
-        sources = list(event_sources(pair_history(history)))
+        sources = list(event_sources(pair_history(history, model)))
 
     def src(i: int) -> Optional[Invocation]:
         return sources[i] if i < len(sources) else None
@@ -114,22 +114,23 @@ def reconstruct_witness(enc: EncodedHistory, model: Model,
 def _build_witness(enc, model, event_index, slot, slots, slot_event,
                    seen, src):
     f, a1, a2, rv = slots[slot]
+    desc = model.describe_op
     # The best explanation: a reachable config that linearized the MOST ops
     # (its lineage is a concrete maximal linearization of the prefix).
     best_cfg = max(seen, key=lambda c: bin(c[1]).count("1"))
     prefix = [{
         "event_index": ev_i,
-        "op": describe_op(*_op_at(enc, ev_i)),
+        "op": desc(*_op_at(enc, ev_i)),
         "state_after": state,
         **_inv_info(src(ev_i)),
     } for ev_i, state in seen[best_cfg]]
     final_configs = sorted(
-        {(s, _pending_desc(m, slots, enc, slot_event)) for s, m in seen},
+        {(s, _pending_desc(m, slots, model)) for s, m in seen},
         key=str)[:16]
     ret = int((np.asarray(enc.events[:event_index, 0]) == EV_RETURN).sum())
     return {
         "valid": False,
-        "op": describe_op(f, a1, a2, rv),
+        "op": desc(f, a1, a2, rv),
         **_inv_info(src(slot_event[slot])),
         "event_index": event_index,
         "dead_step": ret,
@@ -140,7 +141,7 @@ def _build_witness(enc, model, event_index, slot, slots, slot_event,
             for s, p in final_configs],
         "explanation": (
             f"no reachable configuration could linearize "
-            f"{describe_op(f, a1, a2, rv)} by the time it returned"),
+            f"{desc(f, a1, a2, rv)} by the time it returned"),
     }
 
 
@@ -149,8 +150,8 @@ def _op_at(enc, event_index: int) -> tuple[int, int, int, int]:
     return f, a1, a2, rv
 
 
-def _pending_desc(mask: int, slots, enc, slot_event) -> tuple:
-    return tuple(describe_op(*op) for s, op in sorted(slots.items())
+def _pending_desc(mask: int, slots, model) -> tuple:
+    return tuple(model.describe_op(*op) for s, op in sorted(slots.items())
                  if not mask >> s & 1)
 
 

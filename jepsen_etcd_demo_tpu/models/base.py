@@ -61,8 +61,34 @@ class Model(abc.ABC):
     def prepare_history(self, history):
         """Model-level op translation applied before encoding (e.g. the
         mutex model rewrites acquire/release into CAS ops). Identity by
-        default; must return Ops the register encoder accepts."""
+        default; must return Ops encode_invocation accepts."""
         return history
+
+    def encode_invocation(self, f_name: str, invoke_value, ok_value,
+                          status: str) -> Tuple[int, int, int, int]:
+        """Op-language codec: map one paired invocation to the (f, a1, a2,
+        rv) event-row fields the step functions consume. Default: the
+        register language (read/write/cas — the reference's op set,
+        src/jepsen/etcdemo.clj:67-69). Models with a different op language
+        override this; by convention code F_READ must be reserved for pure
+        observations (the encoder drops indeterminate F_READ ops as
+        constraint-free, ops/encode.py)."""
+        from ..ops.encode import register_fields
+
+        return register_fields(f_name, invoke_value, ok_value, status)
+
+    def describe_op(self, f: int, a1: int, a2: int, rv: int) -> str:
+        """Human-readable rendering of an encoded op (witness artifacts,
+        checkers/witness.py). Default: the register language."""
+        from ..ops.encode import NIL, F_READ, F_WRITE, F_CAS
+
+        if f == F_READ:
+            return f"read -> {'nil' if rv == NIL else rv}"
+        if f == F_WRITE:
+            return f"write({a1})"
+        if f == F_CAS:
+            return f"cas({a1} -> {a2})"
+        return f"op({f}, {a1}, {a2}, {rv})"
 
     @abc.abstractmethod
     def init_state(self) -> int:

@@ -46,8 +46,16 @@ from .op import Op, INVOKE, OK, FAIL, INFO
 # read (parse-long of nil at :71-74,87-90). Any int32 value >= 0 is supported.
 NIL = -1
 
-# Function codes.
+# Function codes. F_READ is, BY CONVENTION, the pure-observation code in
+# every model's op language (it never mutates model state): the encoder
+# relies on this to drop indeterminate observations — an :info op with no
+# state effect imposes no constraint (reference :info mapping,
+# src/jepsen/etcdemo.clj:100-102) — without consulting the model. Codes
+# 3..5 are claimed by the non-register model families (models/gset.py,
+# models/queues.py); codes are only meaningful within one model's language,
+# so families may reuse them.
 F_READ, F_WRITE, F_CAS = 0, 1, 2
+F_ADD, F_ENQ, F_DEQ = 3, 4, 5
 FUNC_CODES = {"read": F_READ, "write": F_WRITE, "cas": F_CAS}
 
 # Event kinds.
@@ -116,13 +124,38 @@ def _encode_value(v: Any) -> int:
     return v
 
 
-def pair_history(history: Sequence[Op]) -> list[Invocation]:
+def register_fields(f_name: str, invoke_value: Any, ok_value: Any,
+                    status: str) -> tuple[int, int, int, int]:
+    """The register op language (reference ops at src/jepsen/etcdemo.clj:67-69):
+    read -> rv = observed value; write -> a1 = value; cas -> a1,a2 = old,new.
+    Default codec for models that don't define their own (models/base.py)."""
+    if f_name not in FUNC_CODES:
+        raise EncodeError(f"unsupported register op f={f_name!r}")
+    f = FUNC_CODES[f_name]
+    a1 = a2 = 0
+    rv = NIL
+    if f == F_READ:
+        if status == OK:
+            rv = _encode_value(ok_value)
+    elif f == F_WRITE:
+        a1 = _encode_value(invoke_value)
+    elif f == F_CAS:
+        old, new = invoke_value
+        a1, a2 = _encode_value(old), _encode_value(new)
+    return f, a1, a2, rv
+
+
+def pair_history(history: Sequence[Op], model=None) -> list[Invocation]:
     """Pair invoke entries with their completions by process id.
 
     Mirrors the framework recorder's pairing [dep]; a process has at most one
     outstanding invocation at a time (jepsen worker model). Invocations whose
     completion never arrives are treated as `info` (crashed mid-op), exactly
     like jepsen treats them when a run ends.
+
+    `model` supplies the op-language codec (Model.encode_invocation); None
+    uses the register conventions — the language of the reference demo and
+    of every model whose prepare_history translates into it.
     """
     pending: dict[Any, tuple[int, Op]] = {}
     out: list[Invocation] = []
@@ -141,33 +174,22 @@ def pair_history(history: Sequence[Op]) -> list[Invocation]:
                     f"{idx} has no pending invocation"
                 )
             inv_idx, inv = pending.pop(op.process)
-            out.append(_make_invocation(inv, op, inv_idx, idx))
+            out.append(_make_invocation(inv, op, inv_idx, idx, model))
         else:
             raise EncodeError(f"unknown op type {op.type!r} at index {idx}")
     # Unfinished invocations: open forever.
     for proc, (inv_idx, inv) in pending.items():
-        out.append(_make_invocation(inv, None, inv_idx, -1))
+        out.append(_make_invocation(inv, None, inv_idx, -1, model))
     out.sort(key=lambda i: i.invoke_index)
     return out
 
 
 def _make_invocation(inv: Op, comp: Optional[Op], inv_idx: int,
-                     comp_idx: int) -> Invocation:
-    if inv.f not in FUNC_CODES:
-        raise EncodeError(f"unsupported register op f={inv.f!r}")
-    f = FUNC_CODES[inv.f]
+                     comp_idx: int, model=None) -> Invocation:
     status = comp.type if comp is not None else INFO
-    a1 = a2 = 0
-    rv = NIL
-    value = inv.value
-    if f == F_READ:
-        if comp is not None and comp.type == OK:
-            rv = _encode_value(comp.value)
-    elif f == F_WRITE:
-        a1 = _encode_value(value)
-    elif f == F_CAS:
-        old, new = value
-        a1, a2 = _encode_value(old), _encode_value(new)
+    ok_value = comp.value if comp is not None and comp.type == OK else None
+    codec = register_fields if model is None else model.encode_invocation
+    f, a1, a2, rv = codec(inv.f, inv.value, ok_value, status)
     return Invocation(f=f, a1=a1, a2=a2, rv=rv, status=status,
                       invoke_index=inv_idx, complete_index=comp_idx,
                       process=inv.process)
@@ -245,6 +267,16 @@ def encode_register_history(history: Sequence[Op], k_slots: int = 32
                             ) -> EncodedHistory:
     """History of register ops (read/write/cas) -> padded event tensor."""
     return encode_events(pair_history(history), k_slots=k_slots)
+
+
+def encode_history(history: Sequence[Op], model, k_slots: int = 32
+                   ) -> EncodedHistory:
+    """History in `model`'s op language -> padded event tensor.
+
+    Does NOT apply model.prepare_history — the checker seam translates once
+    (checkers/linearizable.py) so witness reconstruction sees the same op
+    language the encoder did."""
+    return encode_events(pair_history(history, model), k_slots=k_slots)
 
 
 def reslot_events(enc: EncodedHistory, k_slots: int) -> EncodedHistory:
