@@ -1,20 +1,31 @@
 """State-machine models checked for linearizability.
 
 The reference checks a CAS register via knossos.model/cas-register
-(src/jepsen/etcdemo.clj:15,117). Models here expose two equivalent step
-functions: `step_py` (Python scalars, used by the oracle checker) and `step`
-(branchless array math, traced into the JAX kernel).
+(src/jepsen/etcdemo.clj:15,117); the other families mirror the rest of the
+knossos model surface the reference ships as a dependency (knossos 0.3.7,
+jepsen.etcdemo.iml:58). Models expose two equivalent step functions:
+`step_py` (Python scalars, used by the oracle checker) and `step`
+(branchless array math, traced into the JAX kernels); richer-than-scalar
+states (sets, queues, register files) are bit-packed into one int32 so
+every model rides the same flat-int32-frontier kernels.
 """
 
 from .base import Model  # noqa: F401
 from .cas_register import CASRegister  # noqa: F401
+from .gset import GSet  # noqa: F401
+from .multi_register import MultiRegister  # noqa: F401
 from .mutex import Mutex  # noqa: F401
+from .queues import FIFOQueue, UnorderedQueue  # noqa: F401
 from .register import Register  # noqa: F401
 
 REGISTRY = {
     "cas-register": CASRegister,
     "register": Register,
     "mutex": Mutex,
+    "gset": GSet,
+    "unordered-queue": UnorderedQueue,
+    "fifo-queue": FIFOQueue,
+    "multi-register": MultiRegister,
 }
 
 

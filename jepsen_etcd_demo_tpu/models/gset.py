@@ -1,0 +1,96 @@
+"""Grow-only set model — knossos model/set equivalent.
+
+Part of the knossos model surface the reference ships (knossos 0.3.7,
+jepsen.etcdemo.iml:58). The reference's set WORKLOAD is checked with pure
+set algebra (checker/set, src/jepsen/etcdemo/set.clj:46 — see
+checkers/set_checker.py); this model is the stronger LINEARIZABILITY check
+over the same op language: every read must observe exactly the adds
+linearized before it, not merely a superset of the acknowledged ones.
+
+TPU-first state design: the set over values 0..30 is its int32
+characteristic bitmask, so
+
+  add(v)  — always legal; state' = state | (1 << v)
+  read(S) — legal iff state == bitmask(S)  (an exact observation)
+
+and every transition is single-instruction bit algebra — no set objects,
+no hashing. With the reference's value domain (rand-int 5 ⇒ values 0..4,
+src/jepsen/etcdemo.clj:68) the whole state space is 32 states, so the
+dense subset-lattice kernel (ops/wgl3.py) checks gset histories with the
+table fully resident in one (8,128) VPU tile.
+
+Op language (encode_invocation): `add` carries the value on the invoke;
+`read` carries the observed collection of values on the ok completion.
+Indeterminate reads are dropped by the encoder (F_READ convention,
+ops/encode.py); indeterminate adds stay pending forever — exactly the
+reference's :info semantics for set adds (src/jepsen/etcdemo/set.clj:33-36).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import jax.numpy as jnp
+
+from .base import Model
+from ..ops.encode import EncodeError, NIL, F_READ, F_ADD
+
+MAX_ELEMENT = 30  # bit 31 would flip the int32 sign
+
+
+def _element_bit(v) -> int:
+    v = int(v)
+    if not 0 <= v <= MAX_ELEMENT:
+        raise EncodeError(
+            f"gset elements must be in 0..{MAX_ELEMENT} (got {v}); the set "
+            f"state is an int32 bitmask")
+    return 1 << v
+
+
+class GSet(Model):
+    name = "gset"
+    packable_states = True
+    state_offset = 0
+
+    def init_state(self) -> int:
+        return 0  # empty set
+
+    def state_bound(self, max_value: int) -> int:
+        # Every reachable state is an OR of add-masks, each <= max_value
+        # (the largest encoded field), so states fit its bit width. NOT
+        # max_value itself: adds of values 0 and 4 give masks 1 and 16 but
+        # state 17.
+        return (1 << max(int(max_value), 1).bit_length()) - 1
+
+    def encode_invocation(self, f_name, invoke_value, ok_value, status):
+        if f_name == "add":
+            return F_ADD, _element_bit(invoke_value), 0, NIL
+        if f_name == "read":
+            if ok_value is None:
+                return F_READ, 0, 0, NIL
+            mask = 0
+            for v in ok_value:
+                mask |= _element_bit(v)
+            return F_READ, 0, 0, mask
+        raise EncodeError(f"unsupported gset op f={f_name!r}")
+
+    def describe_op(self, f, a1, a2, rv):
+        if f == F_ADD:
+            return f"add({int(a1).bit_length() - 1})"
+        if f == F_READ:
+            els = [i for i in range(MAX_ELEMENT + 1) if int(rv) >> i & 1]
+            return f"read -> {{{', '.join(map(str, els))}}}"
+        return super().describe_op(f, a1, a2, rv)
+
+    def step_py(self, state, f, a1, a2, rv):
+        if f == F_ADD:
+            return (True, state | a1)
+        if f == F_READ:
+            return (state == rv, state)
+        raise ValueError(f"bad f {f}")
+
+    def step(self, state, f, a1, a2, rv):
+        is_add = f == F_ADD
+        legal = jnp.where(is_add, True, (f == F_READ) & (state == rv))
+        nxt = jnp.where(is_add, state | a1, state)
+        return legal, nxt.astype(jnp.int32)

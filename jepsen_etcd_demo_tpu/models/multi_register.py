@@ -1,0 +1,113 @@
+"""Multi-register model — knossos multi-register equivalent.
+
+Part of the knossos model surface the reference ships (knossos 0.3.7,
+jepsen.etcdemo.iml:58). An array of `n_registers` independent registers,
+read and written one at a time: `write(i, v)` / `read(i) -> v`.
+
+TPU-first state design: the register file packs into ONE int32 — each
+register is a `digit_bits`-wide field holding v+1 (0 = never written /
+NIL, matching the reference's missing-key reads, src/jepsen/etcdemo.clj:
+87-90) — so a step is two shifts and a mask, branchless, and the frontier
+stays a flat int32 vector like every other model. With small geometries
+(e.g. 2 registers over values 0..2) the whole state space fits the dense
+subset-lattice kernel's 32-state table.
+
+Op language (encode_invocation): values are (index, value) pairs —
+`write` carries both on the invoke; `read` carries the index on the
+invoke and the observed value on the ok completion ((i, v), v alone, or
+None for a never-written register).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import Model
+from ..ops.encode import EncodeError, NIL, F_READ, F_WRITE
+
+
+class MultiRegister(Model):
+    name = "multi-register"
+    packable_states = True
+    state_offset = 0
+
+    def __init__(self, n_registers: int = 3, max_value: int = 4):
+        self.n_registers = int(n_registers)
+        self.max_value = int(max_value)
+        self.digit_bits = (self.max_value + 1).bit_length()
+        if self.n_registers * self.digit_bits > 30:
+            raise ValueError(
+                f"multi-register state needs "
+                f"{self.n_registers * self.digit_bits} bits "
+                f"({self.n_registers} x {self.digit_bits}-bit registers); "
+                f"int32 admits 30 — shrink n_registers or max_value")
+        self.digit_mask = (1 << self.digit_bits) - 1
+
+    def cache_key(self) -> tuple:
+        return (self.name, self.n_registers, self.max_value)
+
+    def init_state(self) -> int:
+        return 0  # every register NIL (never written)
+
+    def state_bound(self, max_value: int) -> int:
+        # Fixed by the geometry, not the history's values.
+        return (1 << (self.n_registers * self.digit_bits)) - 1
+
+    def _check_index(self, i) -> int:
+        i = int(i)
+        if not 0 <= i < self.n_registers:
+            raise EncodeError(
+                f"register index {i} outside 0..{self.n_registers - 1}")
+        return i
+
+    def _check_value(self, v) -> int:
+        v = int(v)
+        if not 0 <= v <= self.max_value:
+            raise EncodeError(
+                f"multi-register value {v} outside 0..{self.max_value}")
+        return v
+
+    def encode_invocation(self, f_name, invoke_value, ok_value, status):
+        if f_name == "write":
+            i, v = invoke_value
+            return F_WRITE, self._check_index(i), self._check_value(v), NIL
+        if f_name == "read":
+            # Invoke carries (i, _) or bare i; the ok completion carries the
+            # observed value as (i, v) or bare v; None = register unwritten.
+            i = (invoke_value[0] if isinstance(invoke_value, (tuple, list))
+                 else invoke_value)
+            i = self._check_index(i)
+            if ok_value is None:
+                return F_READ, i, 0, NIL
+            v = (ok_value[1] if isinstance(ok_value, (tuple, list))
+                 else ok_value)
+            return F_READ, i, 0, (NIL if v is None else self._check_value(v))
+        raise EncodeError(f"unsupported multi-register op f={f_name!r}")
+
+    def describe_op(self, f, a1, a2, rv):
+        if f == F_WRITE:
+            return f"write(r{a1} = {a2})"
+        if f == F_READ:
+            return f"read(r{a1}) -> {'nil' if rv == NIL else rv}"
+        return super().describe_op(f, a1, a2, rv)
+
+    def step_py(self, state, f, a1, a2, rv):
+        b, m = self.digit_bits, self.digit_mask
+        shift = a1 * b
+        digit = (state >> shift) & m
+        if f == F_READ:
+            return (digit == rv + 1, state)
+        if f == F_WRITE:
+            return (True, (state & ~(m << shift)) | ((a2 + 1) << shift))
+        raise ValueError(f"bad f {f}")
+
+    def step(self, state, f, a1, a2, rv):
+        b, m = self.digit_bits, self.digit_mask
+        shift = a1 * b
+        digit = (state >> shift) & m
+        is_read = f == F_READ
+        is_write = f == F_WRITE
+        legal = jnp.where(is_read, digit == rv + 1, is_write)
+        nxt = jnp.where(is_write,
+                        (state & ~(m << shift)) | ((a2 + 1) << shift), state)
+        return legal, nxt.astype(jnp.int32)

@@ -1,0 +1,200 @@
+"""Queue models — knossos unordered-queue / fifo-queue equivalents.
+
+Part of the knossos model surface the reference ships (knossos 0.3.7,
+jepsen.etcdemo.iml:58; the demo itself only instantiates cas-register at
+src/jepsen/etcdemo.clj:117). Both models re-design the queue state for the
+TPU kernels — branchless int32 bit algebra instead of persistent
+collections:
+
+* `UnorderedQueue` — a bag with unique elements 0..30; state is the int32
+  characteristic bitmask of the elements currently queued. Enqueue sets a
+  bit, dequeue requires-and-clears it; dequeue order is unconstrained
+  (that's the "unordered" in knossos's model). Uniqueness is the standard
+  jepsen queue-workload shape (each enqueued value is a fresh int) and is
+  validated at encode time.
+
+* `FIFOQueue` — a bounded queue over values 0..max_value; state packs up
+  to `capacity` digits of `digit_bits` each into one int32, head at the
+  low bits. Values are stored as v+1 so digit 0 means "empty slot"; the
+  queue is always contiguous from the head (enqueue appends at the first
+  zero digit, dequeue shifts right), so the digit count is the queue
+  length. Enqueue beyond `capacity` is modelled as illegal, which would
+  wrongly prune real linearizations — so encoding REJECTS histories with
+  more total enqueues than `capacity` instead of risking a wrong verdict.
+
+Indeterminate (:info) enqueues stay pending forever, exactly like
+indeterminate register writes (reference :info mapping,
+src/jepsen/etcdemo.clj:100-102). Indeterminate DEQUEUES are rejected at
+encode time: a dequeue that may or may not have removed an unknown element
+cannot be encoded as a pending op with fixed fields, and silently dropping
+it would make the checker accept histories it shouldn't.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import Model
+from ..ops.encode import EncodeError, NIL, F_ENQ, F_DEQ
+from ..ops.op import INFO, INVOKE
+from .gset import MAX_ELEMENT, _element_bit
+
+
+class UnorderedQueue(Model):
+    name = "unordered-queue"
+    packable_states = True
+    state_offset = 0
+
+    def init_state(self) -> int:
+        return 0  # empty bag
+
+    def state_bound(self, max_value: int) -> int:
+        # States are ORs of element bits, each <= max_value (gset argument).
+        return (1 << max(int(max_value), 1).bit_length()) - 1
+
+    def prepare_history(self, history):
+        seen: set[int] = set()
+        for op in history:
+            if op.type == INVOKE and op.f == "enqueue":
+                v = int(op.value)
+                if v in seen:
+                    raise EncodeError(
+                        f"unordered-queue requires unique enqueue values "
+                        f"(duplicate {v}); the bag state is a bitmask")
+                seen.add(v)
+        return history
+
+    def encode_invocation(self, f_name, invoke_value, ok_value, status):
+        if f_name == "enqueue":
+            return F_ENQ, _element_bit(invoke_value), 0, NIL
+        if f_name == "dequeue":
+            if status == INFO:
+                raise EncodeError(
+                    "indeterminate dequeue (no observed value) cannot be "
+                    "encoded soundly; fail it or record its value")
+            if ok_value is None:
+                return F_DEQ, 0, 0, NIL  # fail: dropped by the encoder
+            return F_DEQ, 0, 0, _element_bit(ok_value)
+        raise EncodeError(f"unsupported unordered-queue op f={f_name!r}")
+
+    def describe_op(self, f, a1, a2, rv):
+        if f == F_ENQ:
+            return f"enqueue({int(a1).bit_length() - 1})"
+        if f == F_DEQ:
+            return f"dequeue -> {int(rv).bit_length() - 1}"
+        return super().describe_op(f, a1, a2, rv)
+
+    def step_py(self, state, f, a1, a2, rv):
+        if f == F_ENQ:
+            return (True, state | a1)
+        if f == F_DEQ:
+            return (bool(state & rv), state & ~rv)
+        raise ValueError(f"bad f {f}")
+
+    def step(self, state, f, a1, a2, rv):
+        is_enq = f == F_ENQ
+        is_deq = f == F_DEQ
+        legal = jnp.where(is_enq, True, is_deq & ((state & rv) != 0))
+        nxt = jnp.where(is_enq, state | a1,
+                        jnp.where(is_deq, state & ~rv, state))
+        return legal, nxt.astype(jnp.int32)
+
+
+class FIFOQueue(Model):
+    name = "fifo-queue"
+    packable_states = True
+    state_offset = 0
+
+    def __init__(self, max_value: int = 4, capacity: int = 10):
+        # Digit width: v+1 must fit, so bits for max_value+1 (v+1's top).
+        self.max_value = int(max_value)
+        self.capacity = int(capacity)
+        self.digit_bits = (self.max_value + 1).bit_length()
+        if self.capacity * self.digit_bits > 30:
+            raise ValueError(
+                f"fifo-queue state needs {self.capacity * self.digit_bits} "
+                f"bits (capacity {self.capacity} x {self.digit_bits}-bit "
+                f"digits); int32 admits 30 — shrink capacity or max_value")
+        self.digit_mask = (1 << self.digit_bits) - 1
+
+    def cache_key(self) -> tuple:
+        return (self.name, self.max_value, self.capacity)
+
+    def init_state(self) -> int:
+        return 0  # empty queue
+
+    def state_bound(self, max_value: int) -> int:
+        # Fixed by the geometry, not the history's values: any digit layout.
+        return (1 << (self.capacity * self.digit_bits)) - 1
+
+    def _check_value(self, v) -> int:
+        v = int(v)
+        if not 0 <= v <= self.max_value:
+            raise EncodeError(
+                f"fifo-queue value {v} outside 0..{self.max_value}")
+        return v
+
+    def prepare_history(self, history):
+        enqueues = sum(1 for op in history
+                       if op.type == INVOKE and op.f == "enqueue")
+        if enqueues > self.capacity:
+            raise EncodeError(
+                f"history has {enqueues} enqueues but fifo-queue capacity "
+                f"is {self.capacity}: a linearization could overflow the "
+                f"bounded state and be wrongly pruned — raise capacity")
+        return history
+
+    def encode_invocation(self, f_name, invoke_value, ok_value, status):
+        if f_name == "enqueue":
+            return F_ENQ, self._check_value(invoke_value), 0, NIL
+        if f_name == "dequeue":
+            if status == INFO:
+                raise EncodeError(
+                    "indeterminate dequeue (no observed value) cannot be "
+                    "encoded soundly; fail it or record its value")
+            if ok_value is None:
+                return F_DEQ, 0, 0, NIL  # fail: dropped by the encoder
+            return F_DEQ, 0, 0, self._check_value(ok_value)
+        raise EncodeError(f"unsupported fifo-queue op f={f_name!r}")
+
+    def describe_op(self, f, a1, a2, rv):
+        if f == F_ENQ:
+            return f"enqueue({a1})"
+        if f == F_DEQ:
+            return f"dequeue -> {rv}"
+        return super().describe_op(f, a1, a2, rv)
+
+    def _digits(self, state):
+        b, m = self.digit_bits, self.digit_mask
+        return [(state >> (i * b)) & m for i in range(self.capacity)]
+
+    def step_py(self, state, f, a1, a2, rv):
+        b, m = self.digit_bits, self.digit_mask
+        if f == F_ENQ:
+            length = sum(1 for d in self._digits(state) if d != 0)
+            if length >= self.capacity:
+                return (False, state)
+            return (True, state | ((a1 + 1) << (length * b)))
+        if f == F_DEQ:
+            head = state & m
+            return (head == rv + 1, state >> b)
+        raise ValueError(f"bad f {f}")
+
+    def step(self, state, f, a1, a2, rv):
+        b, m, cap = self.digit_bits, self.digit_mask, self.capacity
+        is_enq = f == F_ENQ
+        is_deq = f == F_DEQ
+        # Queue length = count of nonzero digits (contiguous from head).
+        length = sum((((state >> (i * b)) & m) != 0).astype(jnp.int32)
+                     for i in range(cap))
+        can_enq = is_enq & (length < cap)
+        # Shift for the append position; clamp so the computed (discarded)
+        # value at length==cap stays in-word.
+        enq_shift = jnp.minimum(length, cap - 1) * b
+        enq_state = state | ((a1 + 1) << enq_shift)
+        head = state & m
+        can_deq = is_deq & (head == rv + 1)
+        legal = can_enq | can_deq
+        nxt = jnp.where(can_enq, enq_state,
+                        jnp.where(is_deq, state >> b, state))
+        return legal, nxt.astype(jnp.int32)

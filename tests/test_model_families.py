@@ -1,0 +1,298 @@
+"""Model-family tests: gset, unordered-queue, fifo-queue, multi-register.
+
+Mirrors the knossos model surface the reference ships (knossos 0.3.7,
+jepsen.etcdemo.iml:58) beyond the demo's cas-register. Strategy per
+SURVEY.md §4: truth-table goldens per model, step/step_py agreement, and
+fuzz differential testing — simulation-valid histories and mutated
+likely-invalid ones through oracle, brute force, and the JAX checker
+(dense kernel where the geometry fits, sort kernel otherwise).
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jepsen_etcd_demo_tpu.checkers.linearizable import Linearizable
+from jepsen_etcd_demo_tpu.checkers.oracle import (brute_force_check,
+                                                  check_events_oracle)
+from jepsen_etcd_demo_tpu.models import (FIFOQueue, GSet, MultiRegister,
+                                         UnorderedQueue, get_model)
+from jepsen_etcd_demo_tpu.ops.encode import (EncodeError, F_ADD, F_DEQ,
+                                             F_ENQ, F_READ, F_WRITE, NIL,
+                                             encode_history)
+from jepsen_etcd_demo_tpu.ops.op import Op, INVOKE, OK, INFO
+from jepsen_etcd_demo_tpu.utils.fuzz import (gen_gset_history,
+                                             gen_multireg_history,
+                                             gen_queue_history,
+                                             mutate_family_history)
+
+
+def ops(*steps):
+    return [Op(type=t, f=f, value=v, process=p) for t, f, v, p in steps]
+
+
+# -- golden semantics ------------------------------------------------------
+
+def test_gset_truth_table():
+    m = GSet()
+    s = m.init_state()
+    legal, s = m.step_py(s, F_ADD, 1 << 3, 0, NIL)
+    assert legal and s == 8
+    legal, s = m.step_py(s, F_ADD, 1 << 0, 0, NIL)
+    assert legal and s == 9
+    assert m.step_py(9, F_READ, 0, 0, 9) == (True, 9)       # exact observation
+    assert m.step_py(9, F_READ, 0, 0, 8)[0] is False        # stale read
+    assert m.step_py(9, F_READ, 0, 0, 13)[0] is False       # phantom element
+
+
+def test_fifo_truth_table():
+    m = FIFOQueue(max_value=4, capacity=10)
+    s = m.init_state()
+    legal, s = m.step_py(s, F_ENQ, 2, 0, NIL)
+    assert legal
+    legal, s = m.step_py(s, F_ENQ, 0, 0, NIL)
+    assert legal
+    # FIFO: the first dequeue must observe 2 (the head), not 0.
+    assert m.step_py(s, F_DEQ, 0, 0, 0)[0] is False
+    legal, s = m.step_py(s, F_DEQ, 0, 0, 2)
+    assert legal
+    legal, s = m.step_py(s, F_DEQ, 0, 0, 0)
+    assert legal and s == 0
+    # Empty dequeue is illegal.
+    assert m.step_py(s, F_DEQ, 0, 0, 1)[0] is False
+
+
+def test_fifo_capacity_is_legality_bound():
+    m = FIFOQueue(max_value=1, capacity=2)
+    s = m.init_state()
+    for v in (0, 1):
+        legal, s = m.step_py(s, F_ENQ, v, 0, NIL)
+        assert legal
+    assert m.step_py(s, F_ENQ, 0, 0, NIL)[0] is False  # full
+
+
+def test_unordered_queue_truth_table():
+    m = UnorderedQueue()
+    s = m.init_state()
+    legal, s = m.step_py(s, F_ENQ, 1 << 5, 0, NIL)
+    assert legal
+    legal, s = m.step_py(s, F_ENQ, 1 << 9, 0, NIL)
+    assert legal
+    # Any queued element may come out — both orders legal.
+    assert m.step_py(s, F_DEQ, 0, 0, 1 << 9)[0] is True
+    assert m.step_py(s, F_DEQ, 0, 0, 1 << 5)[0] is True
+    legal, s = m.step_py(s, F_DEQ, 0, 0, 1 << 9)
+    assert legal
+    assert m.step_py(s, F_DEQ, 0, 0, 1 << 9)[0] is False   # already out
+
+
+def test_multi_register_truth_table():
+    m = MultiRegister(n_registers=3, max_value=4)
+    s = m.init_state()
+    assert m.step_py(s, F_READ, 1, 0, NIL) == (True, s)    # unwritten -> nil
+    assert m.step_py(s, F_READ, 1, 0, 0)[0] is False       # phantom value
+    legal, s = m.step_py(s, F_WRITE, 1, 3, NIL)
+    assert legal
+    assert m.step_py(s, F_READ, 1, 0, 3)[0] is True
+    assert m.step_py(s, F_READ, 0, 0, 3)[0] is False       # other register
+    legal, s = m.step_py(s, F_WRITE, 1, 0, NIL)            # overwrite
+    assert legal
+    assert m.step_py(s, F_READ, 1, 0, 0)[0] is True
+    assert m.step_py(s, F_READ, 1, 0, 3)[0] is False
+
+
+FAMILIES = {
+    "gset": (GSet(),
+             lambda r: gen_gset_history(r, n_ops=18, n_procs=4)),
+    "unordered-queue": (UnorderedQueue(),
+                        lambda r: gen_queue_history(r, n_ops=14, n_procs=4,
+                                                    fifo=False)),
+    "fifo-queue": (FIFOQueue(),
+                   lambda r: gen_queue_history(r, n_ops=14, n_procs=4,
+                                               fifo=True)),
+    "multi-register": (MultiRegister(),
+                       lambda r: gen_multireg_history(r, n_ops=16,
+                                                      n_procs=4)),
+}
+
+
+# -- step/step_py agreement over the whole encodable op space -------------
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_step_matches_step_py(family):
+    model, gen = FAMILIES[family]
+    rng = random.Random(7)
+    rows, states = [], []
+    for _ in range(4):
+        enc = encode_history(model.prepare_history(gen(rng)), model,
+                             k_slots=16)
+        ev = enc.events[: enc.n_events]
+        bound = model.state_bound(enc.max_value)
+        for row in ev:
+            rows.append(row[2:6].tolist())
+            states.append(rng.randrange(bound + 1) - model.state_offset)
+    rows_np = np.asarray(rows, np.int32)
+    states_np = np.asarray(states, np.int32)
+    legal, nxt = jax.vmap(
+        lambda s, r: model.step(s, r[0], r[1], r[2], r[3]))(
+            jnp.asarray(states_np), jnp.asarray(rows_np))
+    for i in range(len(rows)):
+        pl, pn = model.step_py(int(states_np[i]), *rows_np[i].tolist())
+        assert bool(legal[i]) == bool(pl), (family, i, rows[i], states[i])
+        if pl:
+            assert int(nxt[i]) == int(pn), (family, i, rows[i], states[i])
+
+
+# -- golden histories ------------------------------------------------------
+
+def test_gset_golden_invalid_read():
+    # add(1) acked, then a read that misses it: not linearizable.
+    h = ops((INVOKE, "add", 1, 0), (OK, "add", 1, 0),
+            (INVOKE, "read", None, 1), (OK, "read", [], 1))
+    model = GSet()
+    res = Linearizable(model=model).check({}, h)
+    assert res["valid"] is False
+    assert "read" in res.get("failed_op", "read")
+
+
+def test_gset_golden_concurrent_read_may_miss():
+    # add(1) still pending when the read starts: {} and {1} both legal.
+    h = ops((INVOKE, "add", 1, 0), (INVOKE, "read", None, 1),
+            (OK, "read", [], 1), (OK, "add", 1, 0))
+    assert Linearizable(model=GSet()).check({}, h)["valid"] is True
+
+
+def test_fifo_golden_reorder_invalid():
+    h = ops((INVOKE, "enqueue", 0, 0), (OK, "enqueue", 0, 0),
+            (INVOKE, "enqueue", 1, 0), (OK, "enqueue", 1, 0),
+            (INVOKE, "dequeue", None, 1), (OK, "dequeue", 1, 1))
+    assert Linearizable(model=FIFOQueue()).check({}, h)["valid"] is False
+    # Same delivery is fine in the unordered model (values unique).
+    assert Linearizable(model=UnorderedQueue()).check({}, h)["valid"] is True
+
+
+def test_fifo_golden_in_order_valid():
+    h = ops((INVOKE, "enqueue", 0, 0), (OK, "enqueue", 0, 0),
+            (INVOKE, "enqueue", 1, 0), (OK, "enqueue", 1, 0),
+            (INVOKE, "dequeue", None, 1), (OK, "dequeue", 0, 1),
+            (INVOKE, "dequeue", None, 1), (OK, "dequeue", 1, 1))
+    assert Linearizable(model=FIFOQueue()).check({}, h)["valid"] is True
+
+
+def test_queue_golden_duplicate_delivery_invalid():
+    h = ops((INVOKE, "enqueue", 3, 0), (OK, "enqueue", 3, 0),
+            (INVOKE, "dequeue", None, 1), (OK, "dequeue", 3, 1),
+            (INVOKE, "dequeue", None, 1), (OK, "dequeue", 3, 1))
+    assert Linearizable(model=UnorderedQueue()).check({}, h)["valid"] is False
+
+
+def test_queue_golden_phantom_delivery_invalid():
+    h = ops((INVOKE, "dequeue", None, 1), (OK, "dequeue", 2, 1))
+    assert Linearizable(model=UnorderedQueue()).check({}, h)["valid"] is False
+    assert Linearizable(model=FIFOQueue()).check({}, h)["valid"] is False
+
+
+def test_multi_register_golden_cross_register_leak():
+    # Write lands in r0; reading r1 must still see nil, reading r0 sees it.
+    h = ops((INVOKE, "write", (0, 2), 0), (OK, "write", (0, 2), 0),
+            (INVOKE, "read", (1, None), 1), (OK, "read", (1, 2), 1))
+    assert Linearizable(model=MultiRegister()).check({}, h)["valid"] is False
+    h2 = ops((INVOKE, "write", (0, 2), 0), (OK, "write", (0, 2), 0),
+             (INVOKE, "read", (0, None), 1), (OK, "read", (0, 2), 1))
+    assert Linearizable(model=MultiRegister()).check({}, h2)["valid"] is True
+
+
+def test_indeterminate_add_may_land_later():
+    # :info add is open forever: a later read may observe it or not.
+    h = ops((INVOKE, "add", 2, 0), (INFO, "add", 2, 0),
+            (INVOKE, "read", None, 1), (OK, "read", [2], 1),
+            (INVOKE, "read", None, 1), (OK, "read", [2], 1))
+    assert Linearizable(model=GSet()).check({}, h)["valid"] is True
+    h2 = ops((INVOKE, "add", 2, 0), (INFO, "add", 2, 0),
+             (INVOKE, "read", None, 1), (OK, "read", [], 1))
+    assert Linearizable(model=GSet()).check({}, h2)["valid"] is True
+    # But once observed, it cannot un-land.
+    h3 = ops((INVOKE, "add", 2, 0), (INFO, "add", 2, 0),
+             (INVOKE, "read", None, 1), (OK, "read", [2], 1),
+             (INVOKE, "read", None, 1), (OK, "read", [], 1))
+    assert Linearizable(model=GSet()).check({}, h3)["valid"] is False
+
+
+def test_indeterminate_dequeue_rejected():
+    h = ops((INVOKE, "dequeue", None, 1), (INFO, "dequeue", None, 1))
+    for model in (UnorderedQueue(), FIFOQueue()):
+        with pytest.raises(EncodeError):
+            encode_history(model.prepare_history(h), model)
+
+
+def test_unordered_queue_rejects_duplicate_enqueues():
+    h = ops((INVOKE, "enqueue", 4, 0), (OK, "enqueue", 4, 0),
+            (INVOKE, "enqueue", 4, 0), (OK, "enqueue", 4, 0))
+    with pytest.raises(EncodeError):
+        UnorderedQueue().prepare_history(h)
+
+
+def test_fifo_rejects_overflowing_history():
+    m = FIFOQueue(max_value=1, capacity=2)
+    h = ops(*[(t, "enqueue", v % 2, p)
+              for p, v in enumerate(range(3)) for t in (INVOKE, OK)])
+    with pytest.raises(EncodeError):
+        m.prepare_history(h)
+
+
+# -- fuzz differential: oracle vs brute force vs JAX checker ---------------
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_fuzz_differential(family):
+    model, gen = FAMILIES[family]
+    checker = Linearizable(model=model, backend="jax")
+    n_invalid = 0
+    for seed in range(25):
+        rng = random.Random(0xFA0 + seed)
+        for mutate in (False, True):
+            h = gen(rng)
+            if mutate:
+                h = mutate_family_history(rng, h, family)
+            enc = encode_history(model.prepare_history(h), model, k_slots=16)
+            want = check_events_oracle(enc, model).valid
+            bf = brute_force_check(enc, model, max_ops=10)
+            if bf is not None:
+                assert bf == want, (family, seed, mutate)
+            got = checker.check({}, h)
+            assert got["valid"] == want, (family, seed, mutate, got)
+            n_invalid += (want is False)
+    assert n_invalid >= 5, f"{family}: mutations too weak ({n_invalid})"
+
+
+def test_dense_kernel_reached_by_small_geometries():
+    # gset over values 0..4 => 32-state table: the dense kernel must serve.
+    model, gen = FAMILIES["gset"]
+    res = Linearizable(model=model).check({}, gen(random.Random(3)))
+    assert res["backend"].startswith("jax-dense")
+    # Tiny fifo geometry is dense too.
+    m = FIFOQueue(max_value=1, capacity=2)
+    h = gen_queue_history(random.Random(4), n_ops=10, n_procs=3, fifo=True,
+                          value_range=2, max_enqueues=2)
+    res = Linearizable(model=m).check({}, h)
+    assert res["backend"].startswith("jax-dense")
+
+
+def test_registry_constructs_all_families():
+    for name in ("gset", "unordered-queue", "fifo-queue", "multi-register"):
+        assert get_model(name).name == name
+
+
+def test_witness_speaks_model_language(tmp_path):
+    h = ops((INVOKE, "enqueue", 0, 0), (OK, "enqueue", 0, 0),
+            (INVOKE, "enqueue", 1, 0), (OK, "enqueue", 1, 0),
+            (INVOKE, "dequeue", None, 1), (OK, "dequeue", 1, 1))
+    res = Linearizable(model=FIFOQueue()).check(
+        {}, h, {"store_dir": str(tmp_path)})
+    assert res["valid"] is False
+    assert res["failed_op"] == "dequeue -> 1"
+    assert (tmp_path / "linear.json").exists()
+    svg = (tmp_path / "linear.svg").read_text()
+    assert "enqueue(" in svg or "dequeue" in svg
