@@ -85,6 +85,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="[fake] inject stale non-quorum reads")
     t.add_argument("--lost-write-prob", type=float, default=0.0,
                    help="[fake] inject acked-but-lost updates")
+    t.add_argument("--reorder-prob", type=float, default=0.0,
+                   help="[fake] queue dequeues pop a random position "
+                        "(FIFO violation)")
+    t.add_argument("--duplicate-delivery-prob", type=float, default=0.0,
+                   help="[fake] queue dequeues deliver without removing")
 
     a = sub.add_parser("analyze", help="re-check a stored history")
     a.add_argument("run_dir", help="store/<name>/<ts> directory")
@@ -124,6 +129,8 @@ def _test_opts(args) -> dict:
         "ssh": {"username": args.username, "private_key": args.private_key},
         "stale_read_prob": args.stale_read_prob,
         "lost_write_prob": args.lost_write_prob,
+        "reorder_prob": args.reorder_prob,
+        "duplicate_delivery_prob": args.duplicate_delivery_prob,
     }
 
 

@@ -7,6 +7,7 @@ src/jepsen/etcdemo/set.clj:10-40).
 
 from .base import Client, ClientError, Timeout, NotFound  # noqa: F401
 from .fake_kv import FakeKVStore  # noqa: F401
+from .queue_client import QueueClient  # noqa: F401
 from .register import RegisterClient  # noqa: F401
 from .set_client import SetClient  # noqa: F401
 from .etcd import EtcdClient, EtcdError  # noqa: F401

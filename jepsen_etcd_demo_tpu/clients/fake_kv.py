@@ -41,16 +41,21 @@ class FakeKVStore:
                  stale_read_prob: float = 0.0,
                  lost_write_prob: float = 0.0,
                  duplicate_cas_prob: float = 0.0,
+                 reorder_prob: float = 0.0,
+                 duplicate_delivery_prob: float = 0.0,
                  partial_apply_prob: float = 0.5,
                  op_delay_s: float = 0.0):
         self.nodes = nodes or ["n1", "n2", "n3", "n4", "n5"]
         self.data: dict[str, Any] = {}
+        self.queues: dict[str, list[Any]] = {}
         self.snapshots: list[dict[str, Any]] = []
         self.isolated: set[str] = set()
         self.rng = random.Random(seed)
         self.stale_read_prob = stale_read_prob
         self.lost_write_prob = lost_write_prob
         self.duplicate_cas_prob = duplicate_cas_prob
+        self.reorder_prob = reorder_prob
+        self.duplicate_delivery_prob = duplicate_delivery_prob
         self.partial_apply_prob = partial_apply_prob
         self.op_delay_s = op_delay_s
         self.lock = asyncio.Lock()
@@ -165,6 +170,42 @@ class FakeKVStore:
         if self.op_delay_s:
             await asyncio.sleep(self.op_delay_s * self.rng.random())
         return out
+
+    # -- queue surface (queue workload; no reference counterpart — the
+    # fifo/unordered-queue MODELS mirror knossos's model family) ----------
+    async def enqueue(self, node: str, key: str, value: Any) -> None:
+        """Append to the queue under `key`. Same indeterminacy model as
+        reset(): on a partitioned node the op may land and then time out."""
+        maybe_timeout = node in self.isolated
+        if maybe_timeout and self.rng.random() >= self.partial_apply_prob:
+            raise Timeout(f"node {node} partitioned")
+        async with self.lock:
+            self.queues.setdefault(key, []).append(value)
+        if maybe_timeout:
+            raise Timeout(f"node {node} partitioned (op applied)")
+        if self.op_delay_s:
+            await asyncio.sleep(self.op_delay_s * self.rng.random())
+
+    async def dequeue(self, node: str, key: str) -> Any:
+        """Pop the queue head. DELIBERATELY fail-before-effect under
+        partition (unlike reset/cas): an indeterminate dequeue removes an
+        unknown element, which no sound history encoding can express
+        (models/queues.py) — so this fake guarantees a timed-out dequeue
+        had no effect and the client may map it to :fail. Injectable bugs:
+          reorder_prob            — pops a random position, not the head
+                                    (FIFO violation)
+          duplicate_delivery_prob — returns the head without removing it
+                                    (element delivered twice)"""
+        await self._enter(node)
+        async with self.lock:
+            q = self.queues.get(key)
+            if not q:
+                raise NotFound(key)
+            i = (self.rng.randrange(len(q))
+                 if self.rng.random() < self.reorder_prob else 0)
+            if self.rng.random() < self.duplicate_delivery_prob:
+                return q[i]
+            return q.pop(i)
 
     async def swap(self, node: str, key: str, fn) -> Any:
         """Atomic read-modify-write retry loop — verschlimmbesserung's swap!

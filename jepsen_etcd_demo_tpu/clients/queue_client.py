@@ -1,0 +1,63 @@
+"""Queue-workload client: enqueue / dequeue over independent per-key queues.
+
+No reference-demo counterpart (the demo ships register and set workloads,
+src/jepsen/etcdemo.clj:128-131) — this drives the fifo/unordered-queue
+MODELS that mirror the rest of the knossos model family the reference
+depends on (knossos 0.3.7, jepsen.etcdemo.iml:58; models/queues.py).
+
+Error mapping follows the reference client's logic (src/jepsen/etcdemo.clj:
+100-105) adapted to queue semantics:
+  * enqueue timeout -> :info (indeterminate, like a register write)
+  * dequeue timeout -> :fail — REQUIRES a fail-before-effect dequeue on
+    the backend (the fake store guarantees it; an at-least-once real queue
+    would need client-side dedup tokens to justify this mapping), because
+    an indeterminate dequeue is unencodable (models/queues.py)
+  * empty queue     -> :fail :empty (the op definitely had no effect)
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..ops.op import Op
+from .base import Client, ClientError, NotFound, Timeout, completed
+
+
+class QueueClient(Client):
+    """conn_factory(test, node) -> an object with async enqueue/dequeue."""
+
+    def __init__(self, conn_factory: Callable, conn=None):
+        self.conn_factory = conn_factory
+        self.conn = conn
+
+    async def open(self, test: dict, node: str) -> "QueueClient":
+        conn = self.conn_factory(test, node)
+        if hasattr(conn, "__await__"):
+            conn = await conn
+        return QueueClient(self.conn_factory, conn)
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        k, v = op.value
+        try:
+            if op.f == "enqueue":
+                await self.conn.enqueue(str(k), v)
+                return completed(op, "ok")
+            if op.f == "dequeue":
+                got = await self.conn.dequeue(str(k))
+                return completed(op, "ok", value=(k, got))
+            raise ValueError(f"unknown op f={op.f!r}")
+        except Timeout:
+            if op.f == "dequeue":
+                return completed(op, "fail", error="timeout")
+            return completed(op, "info", error="timeout")
+        except NotFound:
+            return completed(op, "fail", error="empty")
+        except ClientError as e:
+            return completed(op, "fail", error=str(e))
+
+    async def close(self, test: dict) -> None:
+        close = getattr(self.conn, "close", None)
+        if close is not None:
+            res = close()
+            if hasattr(res, "__await__"):
+                await res

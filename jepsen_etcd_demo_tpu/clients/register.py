@@ -92,6 +92,12 @@ class _BoundFakeConn:
     async def txn(self, mops):
         return await self.store.txn(self.node, mops)
 
+    async def enqueue(self, key, value):
+        return await self.store.enqueue(self.node, key, value)
+
+    async def dequeue(self, key):
+        return await self.store.dequeue(self.node, key)
+
 
 def fake_conn_factory(store):
     def factory(test, node):

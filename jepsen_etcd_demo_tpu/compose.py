@@ -137,10 +137,51 @@ def append_workload(opts: dict, conn_factory: Callable) -> dict:
     }
 
 
+def queue_workload(opts: dict, conn_factory: Callable) -> dict:
+    """FIFO-queue workload over independent per-key queues: enqueues of
+    random small values and dequeues, checked {linear: TPU-WGL fifo-queue,
+    timeline} per key. No reference-demo counterpart — the queue MODELS
+    mirror knossos's model family (models/queues.py).
+
+    Per-key enqueue count is capped at the model's bounded capacity
+    (FIFOQueue.prepare_history rejects histories that could overflow the
+    bit-packed state), so each key's history stays checkable; the
+    independent wrapper supplies the scale axis instead of history length.
+    """
+    from .clients.queue_client import QueueClient
+    from .models import FIFOQueue
+
+    model = FIFOQueue()  # values 0..4, capacity 10
+    per_key_ops = min(int(opts.get("ops_per_key", 100)), 2 * model.capacity)
+
+    def per_key(k):
+        budget = {"enq": model.capacity}
+
+        def step(ctx):
+            if budget["enq"] > 0 and ctx.rng.random() < 0.55:
+                budget["enq"] -= 1
+                return {"f": "enqueue",
+                        "value": ctx.rng.randrange(model.max_value + 1)}
+            return {"f": "dequeue", "value": None}
+
+        return gen.limit(per_key_ops, gen.repeat(step))
+
+    return {
+        "client": QueueClient(conn_factory),
+        "checker": IndependentChecker(Compose({
+            "linear": Linearizable(model, backend="jax"),
+            "timeline": TimelineChecker(),
+        })),
+        "generator": gen.concurrent_generator(10, _key_stream(), per_key),
+        "final_generator": None,
+    }
+
+
 WORKLOADS = {
     "register": register_workload,
     "set": set_workload,
     "append": append_workload,
+    "queue": queue_workload,
 }
 
 
@@ -272,7 +313,11 @@ def fake_test(opts: dict, store: Optional[FakeKVStore] = None) -> dict:
                             lost_write_prob=float(
                                 opts.get("lost_write_prob", 0.0)),
                             duplicate_cas_prob=float(
-                                opts.get("duplicate_cas_prob", 0.0)))
+                                opts.get("duplicate_cas_prob", 0.0)),
+                            reorder_prob=float(
+                                opts.get("reorder_prob", 0.0)),
+                            duplicate_delivery_prob=float(
+                                opts.get("duplicate_delivery_prob", 0.0)))
     test = compose_test(opts, fake_conn_factory(store))
     test["db"] = FakeDB()
     test["nemesis"] = pick_nemesis(test, store=store)
