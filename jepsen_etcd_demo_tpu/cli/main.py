@@ -178,11 +178,19 @@ def cmd_analyze(args) -> int:
     return 0 if result.get("valid") is True else 1
 
 
+# Which linearizability model re-checks a stored run's per-key histories,
+# by the workload recorded in its test.json. Workloads whose checker is
+# not per-key linearizability (set durability, elle) are skipped.
+CORPUS_MODELS = {"register": "cas-register", "queue": "fifo-queue"}
+
+
 def cmd_corpus(args) -> int:
     """Corpus replay (BASELINE configs[4]): gather every stored run's
-    per-key register histories and verify them all in ONE batched launch
-    of the dense kernel — the framework's answer to re-checking a store
-    full of histories after a checker change."""
+    per-key histories and verify them in ONE batched launch of the dense
+    kernel per model — the framework's answer to re-checking a store full
+    of histories after a checker change. Each run's model comes from the
+    workload its test.json records (--model overrides it for register
+    runs only, preserving `corpus <root> --model register` style checks)."""
     import time
 
     from ..checkers import Linearizable
@@ -190,39 +198,58 @@ def cmd_corpus(args) -> int:
     from ..ops import wgl3_pallas
     from ..store.store import Store
 
-    # Linearizable.encode: model op-translation + slot-table escalation
-    # (a run whose partitions piled up >32 forever-pending :info ops must
-    # not crash the whole corpus pass).
-    lin = Linearizable(model=args.model)
-    entries = []   # (run_name, key, encoded)
+    by_model: dict[str, list] = {}   # model name -> [(run, key, encoded)]
+    runs_seen = set()
     for run in Store(args.store_root).runs():
+        try:
+            workload = run.read_test().get("workload", "register")
+        except (ValueError, OSError):
+            workload = "register"
+        model_name = CORPUS_MODELS.get(workload)
+        if model_name is None:
+            print(f"# skipping {run.path}: workload {workload!r} is not "
+                  f"linearizability-checked per key", file=sys.stderr)
+            continue
+        if workload == "register":
+            model_name = args.model
+        # Linearizable.encode: model op-translation + slot-table escalation
+        # (a run whose partitions piled up >32 forever-pending :info ops
+        # must not crash the whole corpus pass).
+        lin = Linearizable(model=model_name)
         try:
             keyed = split_by_key(run.read_history())
         except (ValueError, OSError) as e:
             print(f"# skipping {run.path}: {e}", file=sys.stderr)
             continue
+        runs_seen.add(str(run.path))
         for k, h in sorted(keyed.items(), key=lambda kv: str(kv[0])):
             try:
-                entries.append((str(run.path), k, lin.encode(h)))
+                entry = (str(run.path), k, lin.encode(h))
             except ValueError as e:
                 print(f"# skipping {run.path} key {k}: {e}",
                       file=sys.stderr)
-    if not entries:
+                continue
+            by_model.setdefault(model_name, []).append(entry)
+    if not by_model:
         print(json.dumps({"valid": True, "runs": 0, "keys": 0}))
         return 0
     t0 = time.perf_counter()
-    results, kernel = wgl3_pallas.check_batch_encoded_auto(
-        [e[2] for e in entries], lin.model)
+    invalid, kernels, n_keys = [], set(), 0
+    for model_name, entries in sorted(by_model.items()):
+        results, kernel = wgl3_pallas.check_batch_encoded_auto(
+            [e[2] for e in entries], Linearizable(model=model_name).model)
+        kernels.add(kernel)
+        n_keys += len(entries)
+        invalid.extend({"run": r, "key": k, "model": model_name}
+                       for (r, k, _), one in zip(entries, results)
+                       if one["valid"] is not True)
     wall = time.perf_counter() - t0
-    invalid = [{"run": r, "key": k}
-               for (r, k, _), one in zip(entries, results)
-               if one["valid"] is not True]
     print(json.dumps({
         "valid": not invalid,
-        "runs": len({r for r, _, _ in entries}),
-        "keys": len(entries),
+        "runs": len(runs_seen),
+        "keys": n_keys,
         "invalid": invalid,
-        "kernel": kernel,
+        "kernel": kernels.pop() if len(kernels) == 1 else "mixed",
         "wall_s": round(wall, 3),
     }))
     return 0 if not invalid else 1

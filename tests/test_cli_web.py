@@ -171,6 +171,33 @@ def test_corpus_replay_batches_all_runs(tmp_path, capsys):
     assert out["invalid"] and out["runs"] == 3
 
 
+def test_corpus_replay_routes_models_by_workload(tmp_path, capsys):
+    """A store mixing register and queue runs corpus-replays each run
+    under its own model (test.json workload -> CORPUS_MODELS); a buggy
+    queue run flips the verdict and is named with its model."""
+    import json as _json
+
+    store = str(tmp_path / "store")
+    assert main(["test", "-w", "register", "--fake", "--no-nemesis",
+                 "--time-limit", "1.0", "--rate", "150",
+                 "--store", store, "--seed", "31"]) == 0
+    assert main(["test", "-w", "queue", "--fake", "--no-nemesis",
+                 "--time-limit", "1.0", "--rate", "150",
+                 "--store", store, "--seed", "32"]) == 0
+    rc = main(["corpus", store])
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["valid"] is True and out["runs"] == 2
+
+    assert main(["test", "-w", "queue", "--fake", "--no-nemesis",
+                 "--time-limit", "1.0", "--rate", "150",
+                 "--store", store, "--seed", "33",
+                 "--reorder-prob", "0.7"]) == 1
+    rc = main(["corpus", store])
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1 and out["valid"] is False
+    assert any(e["model"] == "fifo-queue" for e in out["invalid"])
+
+
 def test_index_shows_failure_detail(tmp_path):
     """The run index's detail column surfaces WHY an invalid run failed
     (the per-key failing op from the witness)."""
