@@ -85,6 +85,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="[fake] inject stale non-quorum reads")
     t.add_argument("--lost-write-prob", type=float, default=0.0,
                    help="[fake] inject acked-but-lost updates")
+    t.add_argument("--duplicate-cas-prob", type=float, default=0.0,
+                   help="[fake] a failed CAS may actually have applied")
     t.add_argument("--reorder-prob", type=float, default=0.0,
                    help="[fake] queue dequeues pop a random position "
                         "(FIFO violation)")
@@ -129,6 +131,7 @@ def _test_opts(args) -> dict:
         "ssh": {"username": args.username, "private_key": args.private_key},
         "stale_read_prob": args.stale_read_prob,
         "lost_write_prob": args.lost_write_prob,
+        "duplicate_cas_prob": args.duplicate_cas_prob,
         "reorder_prob": args.reorder_prob,
         "duplicate_delivery_prob": args.duplicate_delivery_prob,
     }
