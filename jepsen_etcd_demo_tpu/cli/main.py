@@ -59,6 +59,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ops per key before rotating (default 100)")
     t.add_argument("--nodes", default="n1,n2,n3,n4,n5",
                    help="comma-separated node list")
+    t.add_argument("--nodes-file", default=None,
+                   help="file with one node per line (overrides --nodes; "
+                        "the jepsen-standard flag)")
     t.add_argument("--time-limit", type=positive_float, default=30.0,
                    help="main-phase wall clock budget in seconds")
     t.add_argument("--concurrency", type=positive_int, default=10,
@@ -114,13 +117,20 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _read_nodes(args) -> list[str]:
+    if args.nodes_file:
+        with open(args.nodes_file) as f:
+            return [ln.strip() for ln in f if ln.strip()]
+    return [n.strip() for n in args.nodes.split(",") if n.strip()]
+
+
 def _test_opts(args) -> dict:
     return {
         "workload": args.workload,
         "quorum": args.quorum,
         "rate": args.rate,
         "ops_per_key": args.ops_per_key,
-        "nodes": [n.strip() for n in args.nodes.split(",") if n.strip()],
+        "nodes": _read_nodes(args),
         "time_limit": args.time_limit,
         "concurrency": args.concurrency,
         "seed": args.seed,

@@ -143,6 +143,19 @@ class TestWebServer:
             httpd.shutdown()
 
 
+def test_nodes_file_overrides_nodes(tmp_path):
+    from jepsen_etcd_demo_tpu.cli.main import build_parser, _test_opts
+
+    nf = tmp_path / "nodes.txt"
+    nf.write_text("na\nnb\n\nnc\n")
+    args = build_parser().parse_args(
+        ["test", "-w", "register", "--nodes-file", str(nf)])
+    assert _test_opts(args)["nodes"] == ["na", "nb", "nc"]
+    args = build_parser().parse_args(["test", "-w", "register",
+                                      "--nodes", "x1,x2"])
+    assert _test_opts(args)["nodes"] == ["x1", "x2"]
+
+
 def test_corpus_replay_batches_all_runs(tmp_path, capsys):
     """`corpus` re-checks every stored run's per-key histories in one
     batched launch (BASELINE configs[4]): a healthy store exits 0; adding
