@@ -20,6 +20,9 @@ prefixes, so any read totally orders every append it observed —
     (anti-dependency) edge reader -> writer(e')
 
 Anomalies (elle's taxonomy):
+  * internal               — a txn's own read contradicts its own earlier
+                             appends in the same txn (the observed list
+                             must end with the txn's appends-so-far)
   * G1a aborted read       — read observes a value appended by a :fail txn
   * G1b intermediate read  — read observes a txn's non-final state of a key
   * incompatible-order     — reads of one key disagree beyond prefixing
@@ -115,6 +118,23 @@ class ElleChecker(Checker):
                 for mop in value:
                     if mop[0] == "append":
                         failed_vals.add((mop[1], mop[2]))
+
+        # Internal consistency: within one txn, a read of k must observe
+        # the txn's own earlier appends to k as the list's suffix (elle's
+        # :internal anomaly — checked on the txn's own completed micro-op
+        # order, before any cross-txn inference).
+        for i, (_, _, value) in enumerate(oks):
+            own: dict[Any, list] = defaultdict(list)
+            for mop in value:
+                if mop[0] == "append":
+                    own[mop[1]].append(mop[2])
+                elif mop[0] == "r" and mop[2] is not None:
+                    o = own[mop[1]]
+                    vs = list(mop[2])
+                    if o and vs[len(vs) - len(o):] != o:
+                        anomalies["internal"].append(
+                            {"key": mop[1], "expected_suffix": list(o),
+                             "read": vs, "txn": i})
 
         # Reads grouped per key: (reader_idx, observed tuple).
         reads: dict[Any, list] = defaultdict(list)

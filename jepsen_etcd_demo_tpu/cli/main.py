@@ -98,9 +98,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     a = sub.add_parser("analyze", help="re-check a stored history")
     a.add_argument("run_dir", help="store/<name>/<ts> directory")
-    a.add_argument("-w", "--workload", default="register",
-                   choices=sorted(WORKLOADS))
-    a.add_argument("--model", default="cas-register")
+    a.add_argument("-w", "--workload", default=None,
+                   choices=sorted(WORKLOADS),
+                   help="default: the workload the run's test.json records")
+    a.add_argument("--model", default=None,
+                   help="linearizability model (default: the workload's — "
+                        "cas-register for register, fifo-queue for queue)")
     a.add_argument("--backend", default="jax", choices=["jax", "oracle"])
 
     c = sub.add_parser(
@@ -171,10 +174,17 @@ def cmd_analyze(args) -> int:
 
     run = RunDir(args.run_dir)
     history = run.read_history()
-    if args.workload == "set":
+    workload = args.workload
+    if workload is None:
+        try:
+            workload = run.read_test().get("workload", "register")
+        except (ValueError, OSError):
+            workload = "register"
+    model = args.model or CORPUS_MODELS.get(workload, "cas-register")
+    if workload == "set":
         sub = SetChecker()
         checker = Compose({"perf": PerfChecker(), "indep": sub})
-    elif args.workload == "append":
+    elif workload == "append":
         checker = Compose({"perf": PerfChecker(),
                            "indep": Compose({
                                "elle": ElleChecker(),
@@ -182,7 +192,7 @@ def cmd_analyze(args) -> int:
     else:
         checker = Compose({"perf": PerfChecker(),
                            "indep": IndependentChecker(Compose({
-                               "linear": Linearizable(args.model,
+                               "linear": Linearizable(model,
                                                       backend=args.backend),
                                "timeline": TimelineChecker()}))})
     result = checker.check({}, history, {"store_dir": str(run.path)})

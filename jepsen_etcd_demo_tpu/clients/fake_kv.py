@@ -155,8 +155,8 @@ class FakeKVStore:
                     src = self.data
                     # Stale reads never hide the txn's OWN earlier append
                     # (read-your-writes inside a txn is assumed even by
-                    # the buggy store; elle's "internal" check is out of
-                    # scope here).
+                    # the buggy store, so the checker's :internal anomaly
+                    # never fires on fake runs — it is golden-tested).
                     if (k not in written and self.snapshots
                             and self.rng.random() < self.stale_read_prob):
                         src = self.rng.choice(self.snapshots)

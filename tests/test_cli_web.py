@@ -156,6 +156,21 @@ def test_nodes_file_overrides_nodes(tmp_path):
     assert _test_opts(args)["nodes"] == ["x1", "x2"]
 
 
+def test_analyze_autodetects_workload_and_model(tmp_path, capsys):
+    """`analyze <run>` with no -w/--model re-checks under the workload the
+    run's test.json records (a queue run must NOT be checked as a
+    cas-register)."""
+    store = str(tmp_path / "store")
+    assert main(["test", "-w", "queue", "--fake", "--no-nemesis",
+                 "--time-limit", "1.0", "--rate", "150",
+                 "--store", store, "--seed", "41"]) == 0
+    run_dir = str((tmp_path / "store" / "latest").resolve())
+    assert main(["analyze", run_dir]) == 0
+    import json as _json
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["valid"] is True
+
+
 def test_corpus_replay_batches_all_runs(tmp_path, capsys):
     """`corpus` re-checks every stored run's per-key histories in one
     batched launch (BASELINE configs[4]): a healthy store exits 0; adding

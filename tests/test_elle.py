@@ -86,6 +86,27 @@ def test_info_append_observed_is_not_g1a():
     assert res["valid"] is True
 
 
+def test_internal_read_contradicts_own_append():
+    # The second read misses the txn's own append of 2: elle :internal.
+    res = anomalies_of(
+        ("ok", [("append", "x", 1), ("r", "x", (1,)),
+                ("append", "x", 2), ("r", "x", (1,))]),
+    )
+    assert res["valid"] is False
+    assert "internal" in res["anomaly_types"]
+    bad = res["anomalies"]["internal"][0]
+    assert bad["expected_suffix"] == [1, 2] and bad["read"] == [1]
+
+
+def test_internal_suffix_after_external_prefix_is_valid():
+    # Own appends observed as the SUFFIX after another txn's prefix: fine.
+    res = anomalies_of(
+        ("ok", [("append", "x", 9)]),
+        ("ok", [("append", "x", 1), ("r", "x", (9, 1))]),
+    )
+    assert "internal" not in res["anomaly_types"]
+
+
 def test_g1b_intermediate_read():
     res = anomalies_of(
         ("ok", [("append", "x", 1), ("append", "x", 2)]),
