@@ -58,6 +58,11 @@ class Linearizable(Checker):
     # -- checking ---------------------------------------------------------
     def check(self, test: dict, history: Sequence[Op],
               opts: dict | None = None) -> dict[str, Any]:
+        # Fault-plane ops (nemesis start/stop) are not client operations —
+        # drop them like knossos does [dep]. Workloads under the
+        # independent wrapper never see them (split_by_key filters), but a
+        # bare whole-history checker (multiregister workload) does.
+        history = [op for op in history if op.process != "nemesis"]
         # Translate ONCE (e.g. mutex acquire/release -> cas) so the
         # witness replay below sees the same op language the encoder did.
         history = self.model.prepare_history(history)

@@ -184,6 +184,14 @@ def cmd_analyze(args) -> int:
     if workload == "set":
         sub = SetChecker()
         checker = Compose({"perf": PerfChecker(), "indep": sub})
+    elif workload == "multiregister":
+        # One whole-store history — no independent-key split.
+        checker = Compose({"perf": PerfChecker(),
+                           "indep": Compose({
+                               "linear": Linearizable(
+                                   args.model or "multi-register",
+                                   backend=args.backend),
+                               "timeline": TimelineChecker()})})
     elif workload == "append":
         checker = Compose({"perf": PerfChecker(),
                            "indep": Compose({

@@ -70,6 +70,54 @@ class RegisterClient(Client):
                 await res
 
 
+class MultiRegisterClient(Client):
+    """Whole-store client for the multi-register workload: ops address
+    register i of a small register file — read (i, None)->(i, v) /
+    write (i, v) — mapped onto KV keys "r<i>". Unlike RegisterClient the
+    values are NOT independent-key tuples: the whole run is ONE history
+    checked against the multi-register model (models/multi_register.py),
+    so cross-register ordering violations are visible to the checker.
+    Error mapping identical to RegisterClient (reference
+    src/jepsen/etcdemo.clj:100-105)."""
+
+    def __init__(self, conn_factory: Callable, conn=None):
+        self.conn_factory = conn_factory
+        self.conn = conn
+
+    async def open(self, test: dict, node: str) -> "MultiRegisterClient":
+        conn = self.conn_factory(test, node)
+        if hasattr(conn, "__await__"):
+            conn = await conn
+        return MultiRegisterClient(self.conn_factory, conn)
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        i, v = op.value
+        try:
+            if op.f == "read":
+                raw = await self.conn.get(f"r{i}",
+                                          quorum=bool(test.get("quorum")))
+                return completed(op, "ok", value=(i, parse_long(raw)))
+            if op.f == "write":
+                await self.conn.reset(f"r{i}", str(v))
+                return completed(op, "ok")
+            raise ValueError(f"unknown op f={op.f!r}")
+        except Timeout:
+            if op.f == "read":
+                return completed(op, "fail", error="timeout")
+            return completed(op, "info", error="timeout")
+        except NotFound:
+            return completed(op, "fail", error="not-found")
+        except ClientError as e:
+            return completed(op, "fail", error=str(e))
+
+    async def close(self, test: dict) -> None:
+        close = getattr(self.conn, "close", None)
+        if close is not None:
+            res = close()
+            if hasattr(res, "__await__"):
+                await res
+
+
 class _BoundFakeConn:
     """FakeKVStore bound to one node, presenting async get/reset/cas/swap."""
 

@@ -177,11 +177,41 @@ def queue_workload(opts: dict, conn_factory: Callable) -> dict:
     }
 
 
+def multiregister_workload(opts: dict, conn_factory: Callable) -> dict:
+    """Whole-store linearizability: reads/writes over a small register
+    file, checked as ONE history against the multi-register model
+    (models/multi_register.py — knossos's multi-register family). Unlike
+    the independent-keys register workload, cross-register ordering
+    violations are in scope here: the model state is the whole file."""
+    from .clients.register import MultiRegisterClient
+    from .models import MultiRegister
+
+    model = MultiRegister()  # 3 registers over values 0..4
+
+    def step(ctx):
+        i = ctx.rng.randrange(model.n_registers)
+        if ctx.rng.random() < 0.5:
+            return {"f": "read", "value": (i, None)}
+        return {"f": "write",
+                "value": (i, ctx.rng.randrange(model.max_value + 1))}
+
+    return {
+        "client": MultiRegisterClient(conn_factory),
+        "checker": Compose({
+            "linear": Linearizable(model, backend="jax"),
+            "timeline": TimelineChecker(),
+        }),
+        "generator": gen.repeat(step),
+        "final_generator": None,
+    }
+
+
 WORKLOADS = {
     "register": register_workload,
     "set": set_workload,
     "append": append_workload,
     "queue": queue_workload,
+    "multiregister": multiregister_workload,
 }
 
 

@@ -67,3 +67,31 @@ def test_queue_run_detects_duplicate_delivery(tmp_path):
                                 duplicate_delivery_prob=0.7))
     result = run(test)
     assert result["valid"] is False
+
+
+# -- multiregister workload (whole-store linearizability) -----------------
+
+def mr_opts(tmp_path, **kw):
+    opts = queue_opts(tmp_path, workload="multiregister", seed=17)
+    # One history for the whole run: keep it small enough for the packed
+    # sort kernel's frontier at 10-way concurrency.
+    opts.update({"time_limit": 1.0, "rate": 120.0})
+    opts.update(kw)
+    return opts
+
+
+def test_multiregister_run_healthy_is_linearizable(tmp_path):
+    test = fake_test(mr_opts(tmp_path, no_nemesis=True))
+    result = run(test)
+    assert result["valid"] is True
+    hist = Store(test["store_root"]).latest().read_history()
+    assert any(o.f == "read" and o.type == "ok" for o in hist)
+
+
+def test_multiregister_run_detects_stale_reads(tmp_path):
+    test = fake_test(mr_opts(tmp_path, no_nemesis=True, seed=18,
+                             stale_read_prob=0.6))
+    result = run(test)
+    assert result["valid"] is False
+    lin = result["indep"]["linear"]
+    assert "read(r" in lin.get("failed_op", "")
