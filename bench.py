@@ -11,13 +11,16 @@ the role of the JVM hot loop. vs_baseline = kernel events/sec ÷ oracle
 events/sec on the same histories.
 
 Workloads:
-  * corpus — 64 fuzzed 150-op histories (valid by construction: the checker
-    must run to completion, the worst case for the search), checked in ONE
-    batched launch of the dense lattice kernel (ops/wgl3.py) on one chip.
-    This is BASELINE.json configs[2] (independent keys as one vmap).
+  * corpus — 1024 fuzzed 150-op cas-register histories (valid by
+    construction: the checker must run to completion, the worst case for
+    the search), checked in ONE batched launch of the dense lattice kernel
+    (ops/wgl3.py) on one chip. BASELINE.json configs[2]/[4] (independent
+    keys as one vmap, corpus-replay scale).
   * long history — 1k-op and 10k-op single-register histories through the
     single-history dense kernel (BASELINE.json configs[3]; north star:
     10k ops < 60 s where knossos-CPU DNFs).
+  * gset corpus — 256 grow-only-set histories through the same batched
+    kernel (model-family lane, models/gset.py).
 """
 
 from __future__ import annotations
@@ -52,17 +55,17 @@ def build_corpus():
         for _ in range(CORPUS)]
 
 
-def bench_corpus(model):
+def _measure_corpus(encs, model):
+    """Shared measurement harness for batched-corpus lanes: one batched
+    launch via the production routing point (wgl3_pallas dispatch), best
+    of REPEATS with ONE packed device->host fetch per launch (per-fetch
+    round trips dominate wall time on tunneled backends), then the oracle
+    over the same histories. The corpus must be valid by construction
+    (the checker runs to completion — the search's worst case)."""
     from jepsen_etcd_demo_tpu.checkers.oracle import check_events_oracle
     from jepsen_etcd_demo_tpu.ops import wgl3, wgl3_pallas
 
-    encs = build_corpus()
-    total_events = int(sum(e.n_events for e in encs))
     cfg, arrays, _steps = wgl3.batch_arrays3(encs, model)
-    # Production routing (single dispatch point in wgl3_pallas): fused
-    # pallas kernel on a live TPU, XLA kernel otherwise. Both return packed
-    # i32[B,5] (ONE device->host fetch — per-fetch round trips dominate
-    # wall time on tunneled backends).
     check, kernel_name = wgl3_pallas.packed_batch_checker(
         model, cfg, n_steps=arrays[2].shape[1], batch=arrays[2].shape[0])
     out = wgl3.unpack_np(check(*arrays))  # compile + warmup
@@ -75,22 +78,47 @@ def bench_corpus(model):
 
     t0 = time.perf_counter()
     for enc in encs:
-        res = check_events_oracle(enc, model)
-        assert res.valid
+        assert check_events_oracle(enc, model).valid
     oracle_s = time.perf_counter() - t0
     return {
-        "events": total_events,
         "kernel_s": best,
         "oracle_s": oracle_s,
         "kernel": kernel_name,
         "k_slots": cfg.k_slots,
         "table_cells": cfg.n_states * cfg.n_masks,
-        "histories_per_sec": CORPUS / best,
         # §5.1 checker metric: configs explored per second of kernel wall
         # time (the search's unit of work; the oracle reports the same
         # counter for an apples-to-apples view).
         "configs_per_sec": float(out["configs_explored"].sum()) / best,
     }
+
+
+def bench_corpus(model):
+    encs = build_corpus()
+    m = _measure_corpus(encs, model)
+    m["events"] = int(sum(e.n_events for e in encs))
+    m["histories_per_sec"] = CORPUS / m["kernel_s"]
+    return m
+
+
+def bench_gset_corpus():
+    """Model-family lane: 256 grow-only-set histories through the same
+    batched dense kernel (models/gset.py — the set state is its int32
+    bitmask, 32-state table). Proves the family kernels run at corpus
+    scale, not only under test geometries."""
+    from jepsen_etcd_demo_tpu.models import GSet
+    from jepsen_etcd_demo_tpu.ops.encode import encode_history
+    from jepsen_etcd_demo_tpu.utils.fuzz import gen_gset_history
+
+    model = GSet()
+    rng = random.Random(0x65E7)
+    encs = [encode_history(
+        gen_gset_history(rng, n_ops=N_OPS, n_procs=N_PROCS, p_info=0.002),
+        model, k_slots=32) for _ in range(256)]
+    m = _measure_corpus(encs, model)
+    return {"histories": len(encs), "kernel_s": round(m["kernel_s"], 4),
+            "oracle_s": round(m["oracle_s"], 4), "kernel": m["kernel"],
+            "table_cells": m["table_cells"]}
 
 
 def bench_long(model, n_ops: int, oracle_too: bool):
@@ -141,6 +169,7 @@ def main():
     else:
         corpus = bench_corpus(model)
     longs = [bench_long(model, n, oracle_too=(n <= 1000)) for n in LONG_OPS]
+    gset = bench_gset_corpus()
 
     kernel_eps = corpus["events"] / corpus["kernel_s"]
     oracle_eps = corpus["events"] / corpus["oracle_s"]
@@ -163,6 +192,7 @@ def main():
             "long_history": [
                 {k: (round(v, 4) if isinstance(v, float) else v)
                  for k, v in d.items()} for d in longs],
+            "gset_corpus": gset,
         },
     }))
 

@@ -458,18 +458,17 @@ def check_steps3(rs: ReturnSteps, model: Model | None = None,
     return out
 
 
-def check_encoded3(enc: EncodedHistory, model: Model | None = None,
-                   cfg: DenseConfig | None = None) -> dict:
-    """Tighten the slot table to the history's real concurrency, bucket the
-    scan length, and run the dense kernel.
-
-    `cfg` (when the caller already computed the feasibility decision) must
-    come from dense_config(model, tight_k_slots(enc), enc.max_value)."""
+def prepare_dense(enc: EncodedHistory, model: Model,
+                  cfg: DenseConfig | None = None
+                  ) -> tuple[DenseConfig, ReturnSteps]:
+    """Host-side single-history prep shared by check_encoded3 and the
+    driver entry (__graft_entry__): tighten the slot table to the
+    history's real concurrency, decide dense feasibility, and bucket the
+    scan length. `cfg` (when the caller already computed the feasibility
+    decision) must come from dense_config(model, tight_k_slots(enc),
+    enc.max_value)."""
     from .encode import reslot_events
 
-    if model is None:
-        from ..models import CASRegister
-        model = CASRegister()
     k = tight_k_slots(enc)
     if cfg is None:
         cfg = dense_config(model, k, enc.max_value)
@@ -480,7 +479,17 @@ def check_encoded3(enc: EncodedHistory, model: Model | None = None,
     if enc.k_slots != k:
         enc = reslot_events(enc, k)
     rs = encode_return_steps(enc)
-    rs = rs.padded_to(step_bucket(rs.n_steps))
+    return cfg, rs.padded_to(step_bucket(rs.n_steps))
+
+
+def check_encoded3(enc: EncodedHistory, model: Model | None = None,
+                   cfg: DenseConfig | None = None) -> dict:
+    """Tighten the slot table to the history's real concurrency, bucket the
+    scan length, and run the dense kernel."""
+    if model is None:
+        from ..models import CASRegister
+        model = CASRegister()
+    cfg, rs = prepare_dense(enc, model, cfg)
     return check_steps3(rs, model, cfg)
 
 
