@@ -38,6 +38,12 @@ Anomalies (elle's taxonomy):
   * G1c circular info      — cycle in ww|wr (with >= 1 wr)
   * G-single               — cycle in ww|wr|rw with exactly one rw
   * G2-item                — cycle with >= 2 rw edges
+  * …-realtime variants    — with ElleChecker(realtime=True), wall-clock
+                             order joins the edge set (A completed before B
+                             invoked => A precedes B): cycles that need a
+                             realtime edge are the strict-serializability
+                             anomalies elle reports as G0/G1c/G-single/
+                             G2-item-realtime
 
 Cycle search runs on the dense adjacency matrix via MXU matrix-squaring
 closure (ops/cycles.py); the found cycle is reconstructed host-side as the
@@ -65,10 +71,12 @@ class TxnEncodeError(ValueError):
 def _pair_txns(history: Sequence[Op]):
     """Invoke/completion pairing by process (the runner guarantees one
     outstanding op per process). Returns list of
-    (invoke_value, completion_type, completion_value)."""
-    pending: dict[Any, Op] = {}
+    (invoke_value, completion_type, completion_value, invoke_pos,
+    complete_pos) — positions are history indices (complete_pos = -1 when
+    the txn never completed), the raw material for realtime edges."""
+    pending: dict[Any, tuple[int, Op]] = {}
     txns = []
-    for op in history:
+    for pos, op in enumerate(history):
         if op.process == "nemesis":   # fault-plane channel, not a txn
             continue
         if op.f != "txn":
@@ -76,22 +84,32 @@ def _pair_txns(history: Sequence[Op]):
         if op.type == "invoke":
             if op.process in pending:
                 raise TxnEncodeError(f"process {op.process} double-invoke")
-            pending[op.process] = op
+            pending[op.process] = (pos, op)
         elif op.type in ("ok", "fail", "info"):
-            inv = pending.pop(op.process, None)
-            if inv is None:
+            got = pending.pop(op.process, None)
+            if got is None:
                 raise TxnEncodeError(f"completion without invoke: {op}")
+            inv_pos, inv = got
             txns.append((inv.value, op.type,
-                         op.value if op.type == "ok" else inv.value))
-    for inv in pending.values():   # still-open at history end = info
-        txns.append((inv.value, "info", inv.value))
+                         op.value if op.type == "ok" else inv.value,
+                         inv_pos, pos))
+    for inv_pos, inv in pending.values():  # still-open at history end = info
+        txns.append((inv.value, "info", inv.value, inv_pos, -1))
     return txns
 
 
 class ElleChecker(Checker):
-    """checker/elle equivalent over list-append txn histories."""
+    """checker/elle equivalent over list-append txn histories.
+
+    realtime=True additionally asserts STRICT serializability: wall-clock
+    completion-before-invocation order joins the dependency graph, so a
+    serialization that reorders non-overlapping txns becomes a cycle
+    (reported under the elle "-realtime" anomaly names)."""
 
     name = "elle"
+
+    def __init__(self, realtime: bool = False):
+        self.realtime = realtime
 
     def check(self, test: dict, history: Sequence[Op],
               opts: dict | None = None) -> dict[str, Any]:
@@ -104,7 +122,7 @@ class ElleChecker(Checker):
         append_of: dict[tuple, int] = {}      # (k, v) -> ok txn idx
         failed_vals: set[tuple] = set()
         multi_appends: dict[tuple, list] = defaultdict(list)  # per (txn,k)
-        for i, (_, _, value) in enumerate(oks):
+        for i, (_, _, value, *_pos) in enumerate(oks):
             for mop in value:
                 if mop[0] == "append":
                     k, v = mop[1], mop[2]
@@ -113,7 +131,7 @@ class ElleChecker(Checker):
                             f"append value {v!r} reused for key {k!r}")
                     append_of[(k, v)] = i
                     multi_appends[(i, k)].append(v)
-        for value, typ, _ in txns:
+        for value, typ, *_rest in txns:
             if typ == "fail":
                 for mop in value:
                     if mop[0] == "append":
@@ -123,7 +141,7 @@ class ElleChecker(Checker):
         # the txn's own earlier appends to k as the list's suffix (elle's
         # :internal anomaly — checked on the txn's own completed micro-op
         # order, before any cross-txn inference).
-        for i, (_, _, value) in enumerate(oks):
+        for i, (_, _, value, *_pos) in enumerate(oks):
             own: dict[Any, list] = defaultdict(list)
             for mop in value:
                 if mop[0] == "append":
@@ -138,7 +156,7 @@ class ElleChecker(Checker):
 
         # Reads grouped per key: (reader_idx, observed tuple).
         reads: dict[Any, list] = defaultdict(list)
-        for i, (_, _, value) in enumerate(oks):
+        for i, (_, _, value, *_pos) in enumerate(oks):
             for mop in value:
                 if mop[0] == "r" and mop[2] is not None:
                     reads[mop[1]].append((i, tuple(mop[2])))
@@ -223,54 +241,82 @@ class ElleChecker(Checker):
                     if wb is not None and wb != reader:
                         rw[reader, wb] = True
 
-        self._find_cycles(ww, wr, rw, oks, anomalies)
+        rt = None
+        if self.realtime and n:
+            inv_pos = np.array([t[3] for t in oks])
+            comp_pos = np.array([t[4] for t in oks])
+            rt = comp_pos[:, None] < inv_pos[None, :]
+        self._find_cycles(ww, wr, rw, oks, anomalies, rt)
 
         types = sorted(anomalies)
+        edge_counts = {"ww": int(ww.sum()), "wr": int(wr.sum()),
+                       "rw": int(rw.sum())}
+        if rt is not None:
+            edge_counts["rt"] = int(rt.sum())
         return {
             "valid": not types,
             "anomaly_types": types,
             "anomalies": {t: anomalies[t] for t in types},
             "txn_count": n,
-            "edge_counts": {"ww": int(ww.sum()), "wr": int(wr.sum()),
-                            "rw": int(rw.sum())},
+            "realtime": self.realtime,
+            "edge_counts": edge_counts,
             "backend": "jax-mxu-closure",
         }
 
     # -- cycle classification --------------------------------------------
-    def _find_cycles(self, ww, wr, rw, oks, anomalies):
+    def _find_cycles(self, ww, wr, rw, oks, anomalies, rt=None):
         def witness(cyc):
             return {"cycle": cyc,
                     "txns": [list(oks[i][2]) for i in cyc[:-1]]}
 
+        # Serializable pass first; if it is clean and realtime is on, run
+        # the same ladder again with rt joined into every tier (any cycle
+        # then NEEDS a realtime edge — elle's "-realtime" anomaly family).
+        if self._classify(ww, wr, rw, None, "", witness, anomalies):
+            return
+        if rt is not None:
+            self._classify(ww, wr, rw, rt, "-realtime", witness, anomalies)
+
+    @staticmethod
+    def _classify(ww, wr, rw, rt, suffix, witness, anomalies) -> bool:
+        """One G0/G1c/G-single/G2-item classification ladder over
+        ww|wr|rw (plus rt when given, with `suffix` on the anomaly
+        names). Returns True iff a cycle was found."""
+        def with_rt(adj):
+            return adj if rt is None else adj | rt
+
         # Full graph first: acyclic full graph implies every subset is
         # acyclic — ONE closure launch on the (common) valid path.
-        full = ww | wr | rw
+        full = with_rt(ww | wr | rw)
         reach_f, cyc_f = reach_and_cycles(full)
         if not cyc_f.any():
-            return
-        reach_ww, cyc_ww = reach_and_cycles(ww)
-        if cyc_ww.any():
-            anomalies["G0"].append(witness(
-                extract_cycle(ww, reach_ww, cyc_ww)))
-        g1 = ww | wr
+            return False
+        g0 = with_rt(ww)
+        reach_g0, cyc_g0 = reach_and_cycles(g0)
+        if cyc_g0.any():
+            anomalies["G0" + suffix].append(witness(
+                extract_cycle(g0, reach_g0, cyc_g0)))
+        g1 = with_rt(ww | wr)
         reach_g1, cyc_g1 = reach_and_cycles(g1)
-        if cyc_g1.any() and not cyc_ww.any():
-            anomalies["G1c"].append(witness(
+        if cyc_g1.any() and not cyc_g0.any():
+            anomalies["G1c" + suffix].append(witness(
                 extract_cycle(g1, reach_g1, cyc_g1)))
         if not cyc_g1.any():
             # Cycles need rw edges. G-single holds iff SOME rw edge is
-            # closed by a ww|wr-only path (exactly one anti-dependency) —
-            # exact, unlike counting rw edges on one arbitrary extracted
-            # cycle, which can mis-classify when 1-rw and 2-rw cycles
-            # coexist.
+            # closed by a (ww|wr|rt)-only path (exactly one
+            # anti-dependency) — exact, unlike counting rw edges on one
+            # arbitrary extracted cycle, which can mis-classify when 1-rw
+            # and 2-rw cycles coexist.
             for a, b in zip(*np.nonzero(rw & ~g1)):
                 if reach_g1[b, a]:
                     back = bfs_path(g1, int(b), int(a))  # [b, ..., a]
-                    anomalies["G-single"].append(witness([int(a)] + back))
+                    anomalies["G-single" + suffix].append(
+                        witness([int(a)] + back))
                     break
             else:
-                anomalies["G2-item"].append(witness(
+                anomalies["G2-item" + suffix].append(witness(
                     extract_cycle(full, reach_f, cyc_f)))
+        return True
 
 
 # -- pure-Python oracle (differential tests) -----------------------------

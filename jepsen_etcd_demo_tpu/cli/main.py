@@ -88,6 +88,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="[fake] inject stale non-quorum reads")
     t.add_argument("--lost-write-prob", type=float, default=0.0,
                    help="[fake] inject acked-but-lost updates")
+    t.add_argument("--elle-realtime", action="store_true",
+                   help="append workload: assert STRICT serializability "
+                        "(wall-clock order joins the elle dependency graph)")
     t.add_argument("--duplicate-cas-prob", type=float, default=0.0,
                    help="[fake] a failed CAS may actually have applied")
     t.add_argument("--reorder-prob", type=float, default=0.0,
@@ -145,6 +148,7 @@ def _test_opts(args) -> dict:
         "stale_read_prob": args.stale_read_prob,
         "lost_write_prob": args.lost_write_prob,
         "duplicate_cas_prob": args.duplicate_cas_prob,
+        "elle_realtime": args.elle_realtime,
         "reorder_prob": args.reorder_prob,
         "duplicate_delivery_prob": args.duplicate_delivery_prob,
     }
@@ -174,12 +178,11 @@ def cmd_analyze(args) -> int:
 
     run = RunDir(args.run_dir)
     history = run.read_history()
-    workload = args.workload
-    if workload is None:
-        try:
-            workload = run.read_test().get("workload", "register")
-        except (ValueError, OSError):
-            workload = "register"
+    try:
+        stored_test = run.read_test()
+    except (ValueError, OSError):
+        stored_test = {}
+    workload = args.workload or stored_test.get("workload", "register")
     model = args.model or CORPUS_MODELS.get(workload, "cas-register")
     if workload == "set":
         sub = SetChecker()
@@ -193,9 +196,12 @@ def cmd_analyze(args) -> int:
                                    backend=args.backend),
                                "timeline": TimelineChecker()})})
     elif workload == "append":
+        # Re-check under the same strictness the run recorded (a strict-
+        # serializability run must not silently downgrade on analyze).
         checker = Compose({"perf": PerfChecker(),
                            "indep": Compose({
-                               "elle": ElleChecker(),
+                               "elle": ElleChecker(realtime=bool(
+                                   stored_test.get("elle_realtime"))),
                                "timeline": TimelineChecker()})})
     else:
         checker = Compose({"perf": PerfChecker(),

@@ -126,7 +126,8 @@ def append_workload(opts: dict, conn_factory: Callable) -> dict:
 
     return {
         "client": TxnClient(conn_factory),
-        "checker": Compose({"elle": ElleChecker(),
+        "checker": Compose({"elle": ElleChecker(
+                                realtime=bool(opts.get("elle_realtime"))),
                             "timeline": TimelineChecker()}),
         "generator": gen.repeat(txn_gen),
         # Final phase: one read-everything txn after healing, so the tail

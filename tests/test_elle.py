@@ -351,3 +351,71 @@ def test_append_workload_requires_txn_conn():
     client = TxnClient(lambda test, node: NoTxnConn())
     with pytest.raises(RuntimeError, match="transactional"):
         asyncio.run(client.open({}, "n1"))
+
+
+# -- strict serializability (realtime) ------------------------------------
+
+RT_CHECK = ElleChecker(realtime=True)
+
+
+def test_realtime_stale_empty_read_is_g_single_realtime():
+    """T2 invoked AFTER T1's append completed yet observes nothing: fine
+    for serializability (T2 may serialize first), a strict-serializability
+    violation once wall-clock order joins the graph. T3's anchoring read
+    places the append in the version order (rw inference needs an observed
+    order — the workload's final read-everything phase plays this role)."""
+    h = txn_history(("ok", [("append", "x", 1)]),
+                    ("ok", [("r", "x", ())]),
+                    ("ok", [("r", "x", (1,))]))
+    assert ElleChecker().check({}, h)["valid"] is True
+    res = RT_CHECK.check({}, h)
+    assert res["valid"] is False
+    assert res["anomaly_types"] == ["G-single-realtime"]
+    assert res["edge_counts"]["rt"] >= 1 and res["realtime"] is True
+
+
+def test_realtime_future_read_is_g1c_realtime():
+    """T1 completes a read observing an append that is only invoked LATER:
+    wr says writer precedes reader, realtime says reader precedes writer."""
+    h = txn_history(("ok", [("r", "x", (1,))]),
+                    ("ok", [("append", "x", 1)]))
+    assert ElleChecker().check({}, h)["valid"] is True
+    res = RT_CHECK.check({}, h)
+    assert res["valid"] is False
+    assert "G1c-realtime" in res["anomaly_types"]
+
+
+def test_realtime_serial_fuzz_stays_valid():
+    """Serial execution satisfies strict serializability: the realtime
+    checker must not fabricate anomalies from rt edges alone."""
+    rng = random.Random(0x5E1B)
+    for _ in range(5):
+        store: dict = {}
+        counters: dict = {}
+        txns = []
+        for _ in range(30):
+            mops = []
+            for _ in range(1 + rng.randrange(3)):
+                k = f"k{rng.randrange(3)}"
+                if rng.random() < 0.5:
+                    mops.append(("r", k, tuple(store.get(k, ()))))
+                else:
+                    counters[k] = counters.get(k, 0) + 1
+                    v = counters[k]
+                    store[k] = tuple(store.get(k, ())) + (v,)
+                    mops.append(("append", k, v))
+            txns.append(("ok", mops))
+        res = RT_CHECK.check({}, txn_history(*txns))
+        assert res["valid"] is True, res["anomaly_types"]
+
+
+def test_realtime_append_run_e2e(tmp_path):
+    """End-to-end: the fake store is linearizable, so even under realtime
+    the append workload must verify (elle_realtime opt threads through)."""
+    from jepsen_etcd_demo_tpu.compose import fake_test
+
+    test = fake_test(fast_opts(tmp_path, elle_realtime=True,
+                               no_nemesis=True))
+    result = asyncio.run(run_test(test))
+    assert result["valid"] is True
+    assert result["indep"]["elle"]["realtime"] is True
