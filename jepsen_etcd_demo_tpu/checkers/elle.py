@@ -269,12 +269,19 @@ class ElleChecker(Checker):
             return {"cycle": cyc,
                     "txns": [list(oks[i][2]) for i in cyc[:-1]]}
 
-        # Serializable pass first; if it is clean and realtime is on, run
-        # the same ladder again with rt joined into every tier (any cycle
-        # then NEEDS a realtime edge — elle's "-realtime" anomaly family).
-        if self._classify(ww, wr, rw, None, "", witness, anomalies):
+        if rt is None:
+            self._classify(ww, wr, rw, None, "", witness, anomalies)
             return
-        if rt is not None:
+        # Realtime mode, still ONE closure launch on the (common) valid
+        # path: full|rt is a superset of every tier of both ladders, so
+        # acyclic(full|rt) clears them all at once. On a cycle, run the
+        # serializable ladder first (its anomaly names are stronger); only
+        # when the cycle NEEDS a realtime edge does the "-realtime" ladder
+        # name it.
+        _, cyc = reach_and_cycles(ww | wr | rw | rt)
+        if not cyc.any():
+            return
+        if not self._classify(ww, wr, rw, None, "", witness, anomalies):
             self._classify(ww, wr, rw, rt, "-realtime", witness, anomalies)
 
     @staticmethod

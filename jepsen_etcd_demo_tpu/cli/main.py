@@ -180,7 +180,9 @@ def cmd_analyze(args) -> int:
     history = run.read_history()
     try:
         stored_test = run.read_test()
-    except (ValueError, OSError):
+    except (ValueError, OSError) as e:
+        print(f"# warning: cannot read test.json ({e}); assuming register "
+              f"workload, serializable elle", file=sys.stderr)
         stored_test = {}
     workload = args.workload or stored_test.get("workload", "register")
     model = args.model or CORPUS_MODELS.get(workload, "cas-register")

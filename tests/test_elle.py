@@ -412,8 +412,6 @@ def test_realtime_serial_fuzz_stays_valid():
 def test_realtime_append_run_e2e(tmp_path):
     """End-to-end: the fake store is linearizable, so even under realtime
     the append workload must verify (elle_realtime opt threads through)."""
-    from jepsen_etcd_demo_tpu.compose import fake_test
-
     test = fake_test(fast_opts(tmp_path, elle_realtime=True,
                                no_nemesis=True))
     result = asyncio.run(run_test(test))

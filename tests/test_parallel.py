@@ -107,3 +107,35 @@ def test_grid_sharded_checker_2d_mesh():
     got = [bool(s) for s in np.asarray(out["survived"])]
     assert not np.asarray(out["overflow"]).any()
     assert got == expected
+
+
+def test_mesh_paths_are_model_generic():
+    """The sharded checkers take any Model: a gset corpus and a gset
+    frontier-sharded check must match the oracle on the 8-device mesh
+    (model families x parallelism, SURVEY.md §2.4 x knossos model table)."""
+    from jepsen_etcd_demo_tpu.models import GSet
+    from jepsen_etcd_demo_tpu.ops.encode import encode_history
+    from jepsen_etcd_demo_tpu.utils.fuzz import (gen_gset_history,
+                                                 mutate_family_history)
+
+    model = GSet()
+    rng = random.Random(21)
+    encs, expected = [], []
+    for i in range(5):
+        h = gen_gset_history(rng, n_ops=20, n_procs=4)
+        if i % 2 == 0:
+            h = mutate_family_history(rng, h, "gset")
+        enc = encode_history(h, model, k_slots=32)
+        encs.append(enc)
+        expected.append(check_events_oracle(enc, model).valid)
+    e_cap = max(e.events.shape[0] for e in encs)
+    events = np.stack([e.padded_to(e_cap).events for e in encs])
+    mesh = make_mesh(8)
+    out = check_corpus(events, model, WGLConfig(32, 128), mesh)
+    assert [bool(s) for s in out["survived"]] == expected
+
+    mesh_f = make_mesh(4, axes=("frontier",))
+    check = make_frontier_sharded_checker(model, WGLConfig(32, 256), mesh_f)
+    for enc, want in zip(encs[:3], expected[:3]):
+        got = check(jax.numpy.asarray(enc.events))
+        assert bool(np.asarray(got["survived"])) == want
