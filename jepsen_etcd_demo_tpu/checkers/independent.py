@@ -82,7 +82,8 @@ class IndependentChecker(Checker):
                         batchable[name] = sub
 
         batched: dict[str | None, dict[Any, dict]] = {
-            name: _batched_linearizable(lin, keyed)
+            name: _batched_linearizable(lin, keyed,
+                                        (opts or {}).get("store_dir"))
             for name, lin in batchable.items()
         }
 
@@ -116,8 +117,8 @@ class IndependentChecker(Checker):
                 **sub_results}
 
 
-def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]]
-                          ) -> dict[Any, dict]:
+def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]],
+                          store_dir=None) -> dict[Any, dict]:
     """Encode every key's history into the return-major form, pad to one
     step count, run one vmapped kernel launch over the key batch.
 
@@ -129,6 +130,12 @@ def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]]
     import jax.numpy as jnp
 
     event_encs = {k: lin.encode(h) for k, h in keyed.items()}
+    if store_dir:
+        from ..store.store import write_encoded_tensor
+
+        for k, e in event_encs.items():
+            if e.n_events:
+                write_encoded_tensor(store_dir, k, e, lin.model.name)
     max_value = max(e.max_value for e in event_encs.values())
 
     # Dense path: one table geometry serves the whole batch — mask width =

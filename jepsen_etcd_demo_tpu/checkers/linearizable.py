@@ -67,6 +67,12 @@ class Linearizable(Checker):
         # witness replay below sees the same op language the encoder did.
         history = self.model.prepare_history(history)
         enc = self._encode_translated(history)
+        store_dir = (opts or {}).get("store_dir")
+        if store_dir and enc.n_events:
+            from ..store.store import write_encoded_tensor
+
+            write_encoded_tensor(store_dir, (opts or {}).get("key"), enc,
+                                 self.model.name)
         if enc.n_events == 0:
             return {"valid": True, "op_count": 0, "backend": self.backend}
         if self.backend == "oracle":

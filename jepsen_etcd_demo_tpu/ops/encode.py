@@ -389,7 +389,3 @@ def encode_return_steps(enc: EncodedHistory) -> ReturnSteps:
         n_steps=len(ret_pos), n_ops=enc.n_ops, k_slots=k,
         max_pending=enc.max_pending, max_value=enc.max_value)
 
-
-def encode_register_history_steps(history: Sequence[Op], k_slots: int = 32
-                                  ) -> ReturnSteps:
-    return encode_return_steps(encode_register_history(history, k_slots))

@@ -109,3 +109,22 @@ class Store:
                 if run.is_dir() and not run.is_symlink():
                     out.append(RunDir(run))
         return out
+
+
+def write_encoded_tensor(store_dir, key, enc, model_name: str) -> None:
+    """Persist the checker's device input alongside the run (the
+    history-tensor artifact of SURVEY.md §5.4: the store is JSONL for the
+    host plane plus the encoded int32 event tensor for the device plane).
+    `key` is the independent-wrapper key (None for whole-run histories).
+
+    WRITE-ONCE: an existing artifact is the record of what the run-time
+    check actually consumed — a later `analyze` under --model/--workload
+    overrides (or a second checker pass over the same key) must not
+    clobber it."""
+    name = "history" if key is None else f"history-{key}"
+    if (Path(store_dir) / f"{name}.npz").exists():
+        return
+    RunDir(store_dir).write_history_tensor(
+        name, np.asarray(enc.events[: enc.n_events]),
+        k_slots=enc.k_slots, n_ops=enc.n_ops, max_pending=enc.max_pending,
+        max_value=enc.max_value, model=model_name)

@@ -95,3 +95,35 @@ def test_multiregister_run_detects_stale_reads(tmp_path):
     assert result["valid"] is False
     lin = result["indep"]["linear"]
     assert "read(r" in lin.get("failed_op", "")
+
+
+def test_history_tensor_artifacts_round_trip(tmp_path):
+    """The store keeps the checker's device input alongside the JSONL
+    history (SURVEY.md §5.4): per-key history-<key>.npz for independent
+    workloads, history.npz for whole-run ones, matching a fresh re-encode."""
+    import numpy as np
+
+    from jepsen_etcd_demo_tpu.checkers.independent import split_by_key
+    from jepsen_etcd_demo_tpu.models import get_model
+    from jepsen_etcd_demo_tpu.ops.encode import encode_history
+
+    test = fake_test(queue_opts(tmp_path, workload="register", seed=19,
+                                no_nemesis=True))
+    assert run(test)["valid"] is True
+    rd = Store(test["store_root"]).latest()
+    npzs = sorted(p.name for p in rd.path.glob("history-*.npz"))
+    assert npzs, "per-key tensors missing"
+    keyed = split_by_key(rd.read_history())
+    k0 = sorted(keyed)[0]
+    with np.load(rd.path / f"history-{k0}.npz") as z:
+        model = get_model(str(z["model"]))
+        enc = encode_history(keyed[k0], model, k_slots=int(z["k_slots"]))
+        assert (z["events"] == enc.events[: enc.n_events]).all()
+        assert int(z["n_ops"]) == enc.n_ops
+
+    test = fake_test(mr_opts(tmp_path, no_nemesis=True, seed=20))
+    assert run(test)["valid"] is True
+    rd = Store(test["store_root"]).latest()
+    with np.load(rd.path / "history.npz") as z:
+        assert str(z["model"]) == "multi-register"
+        assert int(z["n_ops"]) > 0
