@@ -115,6 +115,9 @@ def build_parser() -> argparse.ArgumentParser:
              "batched kernel launch (corpus replay)")
     c.add_argument("store_root", help="results store root directory")
     c.add_argument("--model", default="cas-register")
+    c.add_argument("--reencode", action="store_true",
+                   help="re-encode from history.jsonl instead of loading "
+                        "stored history-*.npz tensors")
 
     s = sub.add_parser("serve", help="serve the results store over http")
     s.add_argument("--port", type=int, default=8080)
@@ -229,16 +232,21 @@ def cmd_corpus(args) -> int:
     kernel per model — the framework's answer to re-checking a store full
     of histories after a checker change. Each run's model comes from the
     workload its test.json records (--model overrides it for register
-    runs only, preserving `corpus <root> --model register` style checks)."""
+    runs only, preserving `corpus <root> --model register` style checks).
+
+    Histories load from the stored device-plane tensors (history-*.npz,
+    SURVEY.md §5.4) when present and model-matching — no host re-encode;
+    --reencode forces the JSONL path (e.g. after an encoder fix)."""
     import time
 
     from ..checkers import Linearizable
     from ..checkers.independent import split_by_key
     from ..ops import wgl3_pallas
-    from ..store.store import Store
+    from ..store.store import Store, read_encoded_tensors
 
     by_model: dict[str, list] = {}   # model name -> [(run, key, encoded)]
     runs_seen = set()
+    n_from_tensors = 0
     for run in Store(args.store_root).runs():
         try:
             workload = run.read_test().get("workload", "register")
@@ -251,6 +259,21 @@ def cmd_corpus(args) -> int:
             continue
         if workload == "register":
             model_name = args.model
+        if not args.reencode:
+            # The tensor set must COVER the run (an interrupted original
+            # check may have persisted only some keys): the run-time
+            # results.json records how many keys the check saw.
+            tensors = read_encoded_tensors(run.path, model_name)
+            try:
+                expected = run.read_results()["indep"]["key_count"]
+            except (ValueError, OSError, KeyError, TypeError):
+                expected = None
+            if tensors and len(tensors) == expected:
+                runs_seen.add(str(run.path))
+                n_from_tensors += len(tensors)
+                by_model.setdefault(model_name, []).extend(
+                    (str(run.path), k, enc) for k, enc in tensors)
+                continue
         # Linearizable.encode: model op-translation + slot-table escalation
         # (a run whose partitions piled up >32 forever-pending :info ops
         # must not crash the whole corpus pass).
@@ -263,7 +286,9 @@ def cmd_corpus(args) -> int:
         runs_seen.add(str(run.path))
         for k, h in sorted(keyed.items(), key=lambda kv: str(kv[0])):
             try:
-                entry = (str(run.path), k, lin.encode(h))
+                # str(k): one key identity whichever load path ran (the
+                # tensor path's keys are filename-derived strings).
+                entry = (str(run.path), str(k), lin.encode(h))
             except ValueError as e:
                 print(f"# skipping {run.path} key {k}: {e}",
                       file=sys.stderr)
@@ -289,6 +314,7 @@ def cmd_corpus(args) -> int:
         "keys": n_keys,
         "invalid": invalid,
         "kernel": kernels.pop() if len(kernels) == 1 else "mixed",
+        "from_tensors": n_from_tensors,
         "wall_s": round(wall, 3),
     }))
     return 0 if not invalid else 1

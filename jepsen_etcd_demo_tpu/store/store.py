@@ -111,6 +111,35 @@ class Store:
         return out
 
 
+def read_encoded_tensors(store_dir, model_name: str):
+    """Load a run's per-key device-plane tensors (the write_encoded_tensor
+    artifacts) back into EncodedHistory objects as (key, enc) pairs with
+    STRING keys, in str-sorted key order (the same order the JSONL path's
+    sorted(..., key=str) produces). Returns [] when none exist, any fails
+    to load (e.g. a truncated .npz from an interrupted run — np.load
+    raises zipfile.BadZipFile, hence the broad except), or any was encoded
+    under a DIFFERENT model (its event fields follow that model's op
+    language — the caller must re-encode from JSONL instead)."""
+    from ..ops.encode import EncodedHistory
+
+    out = []
+    for path in sorted(Path(store_dir).glob("history*.npz")):
+        try:
+            with np.load(path) as z:
+                if str(z["model"]) != model_name:
+                    return []
+                name = path.stem
+                key = name[len("history-"):] if "-" in name else None
+                out.append((key, EncodedHistory(
+                    events=z["events"], n_events=int(z["events"].shape[0]),
+                    n_ops=int(z["n_ops"]), k_slots=int(z["k_slots"]),
+                    max_pending=int(z["max_pending"]),
+                    max_value=int(z["max_value"]))))
+        except Exception:
+            return []
+    return out
+
+
 def write_encoded_tensor(store_dir, key, enc, model_name: str) -> None:
     """Persist the checker's device input alongside the run (the
     history-tensor artifact of SURVEY.md §5.4: the store is JSONL for the

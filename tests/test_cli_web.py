@@ -215,6 +215,13 @@ def test_corpus_replay_routes_models_by_workload(tmp_path, capsys):
     rc = main(["corpus", store])
     out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0 and out["valid"] is True and out["runs"] == 2
+    # Runs persist their device-plane tensors; corpus loads them directly.
+    assert out["from_tensors"] == out["keys"] > 0
+    # --reencode (the post-encoder-fix path) must reach the same verdict.
+    rc = main(["corpus", store, "--reencode"])
+    out2 = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out2["valid"] is True
+    assert out2["from_tensors"] == 0 and out2["keys"] == out["keys"]
 
     assert main(["test", "-w", "queue", "--fake", "--no-nemesis",
                  "--time-limit", "1.0", "--rate", "150",
