@@ -192,12 +192,13 @@ def cmd_analyze(args) -> int:
     if workload == "set":
         sub = SetChecker()
         checker = Compose({"perf": PerfChecker(), "indep": sub})
-    elif workload == "multiregister":
-        # One whole-store history — no independent-key split.
+    elif workload in WHOLE_HISTORY_MODELS:
+        # One whole-run history — no independent-key split.
         checker = Compose({"perf": PerfChecker(),
                            "indep": Compose({
                                "linear": Linearizable(
-                                   args.model or "multi-register",
+                                   args.model or
+                                   WHOLE_HISTORY_MODELS[workload],
                                    backend=args.backend),
                                "timeline": TimelineChecker()})})
     elif workload == "append":
@@ -222,8 +223,14 @@ def cmd_analyze(args) -> int:
 
 # Which linearizability model re-checks a stored run's per-key histories,
 # by the workload recorded in its test.json. Workloads whose checker is
-# not per-key linearizability (set durability, elle) are skipped.
+# not per-key linearizability (set durability, elle, the whole-history
+# models below) are skipped by `corpus`.
 CORPUS_MODELS = {"register": "cas-register", "queue": "fifo-queue"}
+
+# Workloads checked as ONE whole-run history (no independent-key split),
+# and the model each re-checks under.
+WHOLE_HISTORY_MODELS = {"multiregister": "multi-register", "gset": "gset",
+                        "mutex": "mutex"}
 
 
 def cmd_corpus(args) -> int:

@@ -1,0 +1,68 @@
+"""Mutex-workload client: acquire / release a distributed lock.
+
+No reference-demo counterpart (the demo ships register and set workloads,
+src/jepsen/etcdemo.clj:128-131) — this drives the mutex MODEL from
+knossos's family (models/mutex.py). The lock is a CAS register on the
+backing store (acquire = cas 0->1, release = cas 1->0 — exactly the
+translation the model applies), so the same etcd/fake connections serve.
+
+Error mapping follows the reference client (src/jepsen/etcdemo.clj:
+100-105): a CAS that returned false is :fail (definitely didn't happen);
+a timeout is :info (the lock MAY have been taken/released — the model's
+pending-forever semantics carry it).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..ops.op import Op
+from .base import Client, ClientError, NotFound, Timeout, completed
+
+LOCK_KEY = "a-lock"
+UNLOCKED, LOCKED = "0", "1"
+
+
+class MutexClient(Client):
+    def __init__(self, conn_factory: Callable, conn=None):
+        self.conn_factory = conn_factory
+        self.conn = conn
+
+    async def open(self, test: dict, node: str) -> "MutexClient":
+        conn = self.conn_factory(test, node)
+        if hasattr(conn, "__await__"):
+            conn = await conn
+        return MutexClient(self.conn_factory, conn)
+
+    async def setup(self, test: dict) -> None:
+        # Initialize-and-verify: setup must succeed even against a backend
+        # with injected lost-write bugs (the run's assertions are about the
+        # RUN, not setup).
+        for _ in range(16):
+            await self.conn.reset(LOCK_KEY, UNLOCKED)
+            if await self.conn.get(LOCK_KEY, quorum=True) is not None:
+                return
+        raise RuntimeError("MutexClient.setup could not initialize the lock")
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        try:
+            if op.f == "acquire":
+                ok = await self.conn.cas(LOCK_KEY, UNLOCKED, LOCKED)
+            elif op.f == "release":
+                ok = await self.conn.cas(LOCK_KEY, LOCKED, UNLOCKED)
+            else:
+                raise ValueError(f"unknown op f={op.f!r}")
+            return completed(op, "ok" if ok else "fail")
+        except Timeout:
+            return completed(op, "info", error="timeout")
+        except NotFound:
+            return completed(op, "fail", error="not-found")
+        except ClientError as e:
+            return completed(op, "fail", error=str(e))
+
+    async def close(self, test: dict) -> None:
+        close = getattr(self.conn, "close", None)
+        if close is not None:
+            res = close()
+            if hasattr(res, "__await__"):
+                await res

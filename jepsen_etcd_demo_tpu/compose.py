@@ -207,12 +207,70 @@ def multiregister_workload(opts: dict, conn_factory: Callable) -> dict:
     }
 
 
+def gset_workload(opts: dict, conn_factory: Callable) -> dict:
+    """Set ops checked for READ LINEARIZABILITY under the gset model
+    (models/gset.py): every read must observe exactly the adds linearized
+    before it. Complements the `set` workload, which owns durability
+    attribution (unique successive values, reference set.clj:46 algebra);
+    here values cycle over the reference's small domain (rand-int 5,
+    src/jepsen/etcdemo.clj:68) — adds are idempotent, the whole 32-state
+    space fits the dense lattice kernel in one VPU tile, and the target
+    bug class is stale/invented READS, which durability checking cannot
+    see."""
+    counter = {"i": 0}
+
+    def step(ctx):
+        if ctx.rng.random() < 0.3:
+            return {"f": "read", "value": None}
+        counter["i"] += 1
+        return {"f": "add", "value": counter["i"] % 5}
+
+    return {
+        "client": SetClient(conn_factory),
+        "checker": Compose({
+            "linear": Linearizable("gset", backend="jax"),
+            "timeline": TimelineChecker(),
+        }),
+        "generator": gen.repeat(step),
+        "final_generator": gen.once({"f": "read", "value": None}),
+    }
+
+
+def mutex_workload(opts: dict, conn_factory: Callable) -> dict:
+    """Distributed-lock workload over the mutex model (knossos model
+    family, models/mutex.py): every worker thread alternates
+    acquire/release forever (failed CASes drop out of the history; the
+    model judges the acknowledged ones), checked as ONE whole-run history."""
+    from .clients.mutex_client import MutexClient
+
+    state: dict[int, int] = {}
+
+    def step(ctx):
+        conc = int((ctx.test or {}).get("concurrency", 10))
+        t = int(ctx.process) % conc
+        i = state.get(t, 0)
+        state[t] = i + 1
+        return {"f": "acquire" if i % 2 == 0 else "release", "value": None}
+
+    return {
+        "client": MutexClient(conn_factory),
+        "checker": Compose({
+            "linear": Linearizable("mutex", backend="jax"),
+            "timeline": TimelineChecker(),
+        }),
+        "generator": gen.repeat(step),
+        "final_generator": None,
+    }
+
+
 WORKLOADS = {
     "register": register_workload,
     "set": set_workload,
+    "gset": gset_workload,
     "append": append_workload,
     "queue": queue_workload,
     "multiregister": multiregister_workload,
+    "mutex": mutex_workload,
 }
 
 

@@ -27,6 +27,15 @@ class Mutex(CASRegister):
     def __init__(self):
         super().__init__(initial=UNLOCKED)
 
+    def describe_op(self, f, a1, a2, rv):
+        from ..ops.encode import F_CAS
+
+        if f == F_CAS and (a1, a2) == (UNLOCKED, LOCKED):
+            return "acquire"
+        if f == F_CAS and (a1, a2) == (LOCKED, UNLOCKED):
+            return "release"
+        return super().describe_op(f, a1, a2, rv)
+
     def prepare_history(self, history: Sequence[Op]) -> list[Op]:
         out = []
         for op in history:

@@ -127,3 +127,55 @@ def test_history_tensor_artifacts_round_trip(tmp_path):
     with np.load(rd.path / "history.npz") as z:
         assert str(z["model"]) == "multi-register"
         assert int(z["n_ops"]) > 0
+
+
+# -- gset + mutex workloads (whole-run model checks) ----------------------
+
+def test_gset_run_healthy_is_linearizable(tmp_path):
+    test = fake_test(queue_opts(tmp_path, workload="gset", seed=23,
+                                no_nemesis=True, time_limit=1.0))
+    result = run(test)
+    assert result["valid"] is True
+    # The small value domain keeps the whole state space in the dense
+    # kernel (one VPU tile) — the geometry the gset model is designed for.
+    assert result["indep"]["linear"]["backend"].startswith("jax-dense")
+
+
+def test_gset_run_detects_stale_reads(tmp_path):
+    """A stale set read is invisible to durability checking (the final
+    read is fine) but a linearizability violation under the gset model —
+    the strengthening this workload exists for. The tiny value domain
+    saturates the set quickly, so an individual schedule can get lucky;
+    the asyncio schedule isn't bit-deterministic either — allow a couple
+    of attempts (measured: 7 of 8 seeds detect on the first try)."""
+    for attempt, seed in enumerate((25, 27, 28)):
+        test = fake_test(queue_opts(tmp_path, workload="gset", seed=seed,
+                                    no_nemesis=True, time_limit=1.0,
+                                    stale_read_prob=0.5))
+        result = run(test)
+        if result["indep"]["linear"]["valid"] is False:
+            assert "read" in result["indep"]["linear"].get("failed_op", "")
+            return
+    raise AssertionError("stale set reads went undetected on 3 schedules")
+
+
+def test_mutex_run_healthy_is_linearizable(tmp_path):
+    test = fake_test(queue_opts(tmp_path, workload="mutex", seed=25,
+                                no_nemesis=True, time_limit=1.0))
+    result = run(test)
+    assert result["valid"] is True
+    hist = Store(test["store_root"]).latest().read_history()
+    assert any(o.f == "acquire" and o.type == "ok" for o in hist)
+    assert any(o.f == "release" and o.type == "ok" for o in hist)
+
+
+def test_mutex_run_detects_double_grant(tmp_path):
+    """Lost-update on the lock CAS (acquire acked ok but not applied) lets
+    two workers hold the lock at once: the mutex model must reject it."""
+    test = fake_test(queue_opts(tmp_path, workload="mutex", seed=26,
+                                no_nemesis=True, time_limit=1.0,
+                                lost_write_prob=0.5))
+    result = run(test)
+    assert result["valid"] is False
+    assert result["indep"]["linear"].get("failed_op") in ("acquire",
+                                                          "release")
