@@ -124,18 +124,15 @@ def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]],
 
     Prefers the dense lattice kernel (wgl3) — exact, no overflow — whenever
     the shared config table is feasible; falls back to the sort kernel."""
-    from ..ops import wgl, wgl2, wgl3
-    from ..ops.encode import (encode_return_steps, encode_history,
-                              reslot_events, ReturnSteps)
-    import jax.numpy as jnp
+    from ..ops import wgl3
 
     event_encs = {k: lin.encode(h) for k, h in keyed.items()}
     if store_dir:
         from ..store.store import write_encoded_tensor
 
         for k, e in event_encs.items():
-            if e.n_events:
-                write_encoded_tensor(store_dir, k, e, lin.model.name)
+            # Empty encodings included (corpus tensor-coverage contract).
+            write_encoded_tensor(store_dir, k, e, lin.model.name)
     max_value = max(e.max_value for e in event_encs.values())
 
     # Dense path: one table geometry serves the whole batch — mask width =
@@ -162,40 +159,31 @@ def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]],
             for k, one in zip(keys, batch)
         }
 
-    # Sort-kernel path: every key must share k_slots (ragged [R,K,4]
-    # tensors cannot stack); re-encode any key whose per-key escalation
-    # picked a smaller table.
-    k_slots = max(e.k_slots for e in event_encs.values())
-    encs: dict[Any, ReturnSteps] = {}
-    for k, e in event_encs.items():
-        if e.k_slots != k_slots:
-            # Re-encode through the model's op translation (mutex
-            # acquire/release -> cas) exactly as lin.encode did above.
-            e = encode_history(lin.model.prepare_history(keyed[k]),
-                               lin.model, k_slots=k_slots)
-        encs[k] = encode_return_steps(e)
-    r_cap = max(1, max(e.slot_tabs.shape[0] for e in encs.values()))
-    keys = list(encs)
-    padded = [encs[k].padded_to(r_cap) for k in keys]
-    tabs = jnp.asarray(np.stack([p.slot_tabs for p in padded]))
-    act = jnp.asarray(np.stack([p.slot_active for p in padded]))
-    tgt = jnp.asarray(np.stack([p.targets for p in padded]))
-    check = wgl2.cached_batch_checker2(
-        lin.model, wgl2.make_config(lin.model, k_slots, lin.f_cap,
-                                    max_value))
-    out = {name: np.asarray(v) for name, v in check(tabs, act, tgt).items()}
+    # Sort-kernel path: the shared batched general pass (one copy of the
+    # pad/stack/launch/verdict logic, with its row-budget chunking and
+    # LONG_SCAN_MAX guard — wgl3_pallas._batch_general). Keys it could not
+    # settle (overflow at lin.f_cap, or too long for one scan program) are
+    # simply absent: _check_key's pick() re-runs the per-key ladder, which
+    # escalates exactly and writes witnesses.
+    from ..ops.wgl3_pallas import _batch_general
+
+    keys = list(event_encs)
+    slots: list = [None] * len(keys)
+    _batch_general([event_encs[k] for k in keys], list(range(len(keys))),
+                   lin.model, slots, set(), f_cap=lin.f_cap)
     results = {}
-    for i, k in enumerate(keys):
-        one = {name: out[name][i].item() for name in out}
+    for k, one in zip(keys, slots):
+        if one is None:
+            continue
         # Keys mirror the single-history jax path's normalized schema
         # (linearizable.py) so consumers see one shape whatever path ran.
         results[k] = {
-            "valid": wgl.verdict(one),
+            "valid": one["valid"],
             "backend": "jax-batched",
-            "op_count": encs[k].n_ops,
+            "op_count": one["op_count"],
             "dead_step": one["dead_step"],
             "max_frontier": one["max_frontier"],
             "overflow": one["overflow"],
-            "f_cap": lin.f_cap,
+            "f_cap": one["f_cap"],
         }
     return results

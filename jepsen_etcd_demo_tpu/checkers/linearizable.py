@@ -68,7 +68,10 @@ class Linearizable(Checker):
         history = self.model.prepare_history(history)
         enc = self._encode_translated(history)
         store_dir = (opts or {}).get("store_dir")
-        if store_dir and enc.n_events:
+        if store_dir:
+            # Empty encodings included: the artifact records the checker's
+            # input for EVERY key, so corpus replay's tensor-coverage
+            # check (len(tensors) == key_count) holds.
             from ..store.store import write_encoded_tensor
 
             write_encoded_tensor(store_dir, (opts or {}).get("key"), enc,
