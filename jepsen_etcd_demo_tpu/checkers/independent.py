@@ -161,16 +161,24 @@ def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]],
 
     # Sort-kernel path: the shared batched general pass (one copy of the
     # pad/stack/launch/verdict logic, with its row-budget chunking and
-    # LONG_SCAN_MAX guard — wgl3_pallas._batch_general). Keys it could not
-    # settle (overflow at lin.f_cap, or too long for one scan program) are
-    # simply absent: _check_key's pick() re-runs the per-key ladder, which
-    # escalates exactly and writes witnesses.
-    from ..ops.wgl3_pallas import _batch_general
+    # LONG_SCAN_MAX guard — wgl3_pallas._batch_general). Keys the tiers
+    # could not settle run the exact ladder HERE, seeded past the
+    # proven-dead capacities; only their invalid/unknown outcomes stay
+    # absent so _check_key's pick() re-runs the single path for witness
+    # extraction.
+    from ..ops.wgl3_pallas import _batch_general, check_encoded_general
 
     keys = list(event_encs)
     slots: list = [None] * len(keys)
-    _batch_general([event_encs[k] for k in keys], list(range(len(keys))),
-                   lin.model, slots, set(), f_cap=lin.f_cap)
+    overflowed, too_long, top = _batch_general(
+        [event_encs[k] for k in keys], list(range(len(keys))),
+        lin.model, slots, set(), f_cap=lin.f_cap)
+    for idx, seed_cap in ([(i, 4 * top) for i in overflowed]
+                          + [(i, lin.f_cap) for i in too_long]):
+        one = check_encoded_general(event_encs[keys[idx]], lin.model,
+                                    f_cap=seed_cap)
+        if one["valid"] is True:
+            slots[idx] = one
     results = {}
     for k, one in zip(keys, slots):
         if one is None:

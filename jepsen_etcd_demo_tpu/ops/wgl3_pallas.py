@@ -529,34 +529,44 @@ def check_batch_encoded_auto(encs: Sequence[EncodedHistory],
                     results[i] = one
                 kernels.add(name)
     if general_idx:
-        overflowed, too_long = _batch_general(encs, general_idx, model,
-                                              results, kernels)
+        overflowed, too_long, top = _batch_general(encs, general_idx, model,
+                                                   results, kernels)
         for i in too_long:
             one = check_encoded_general(encs[i], model)
             results[i] = one
             kernels.add(one["kernel"])
         for i in overflowed:
-            # The batched pass PROVED the default capacity overflows for
-            # these: start the ladder past the dead rung.
-            one = check_encoded_general(encs[i], model, f_cap=4 * 256)
+            # The batched tiers PROVED capacities up to `top` overflow for
+            # these: start the ladder past every dead rung.
+            one = check_encoded_general(encs[i], model, f_cap=4 * top)
             results[i] = one
             kernels.add(one["kernel"])
     return results, (kernels.pop() if len(kernels) == 1 else "mixed")
 
 
-def _batch_general(encs, idxs, model, results, kernels,
-                   f_cap: int = 256) -> tuple[list[int], list[int]]:
-    """First pass for the NON-dense partition of a batch (wide pending
+# Batched-tier capacities for the non-dense pass. Start small: sort cost
+# per launch is linear in f_cap (measured on a 256-history fifo corpus:
+# 3.2 s at f_cap=256 vs 1.1 s at 64), typical frontiers are tiny, and an
+# overflowed history re-batches at the next tier — still one launch per
+# tier, vs ~0.5 s per history for a per-history ladder run.
+GENERAL_TIERS = (64, 256, 1024)
+
+
+def _batch_general(encs, idxs, model, results, kernels, f_cap: int = 256
+                   ) -> tuple[list[int], list[int], int]:
+    """Batched pass for the NON-dense partition of a batch (wide pending
     sets / huge-value states — queue and multi-register corpora live
     here): vmapped sort-kernel launches over a shared geometry instead of
-    a sequential per-history ladder. Exact verdicts (survived, or dead
-    without overflow — soundness argument in ops/wgl2.py) land in
-    `results`; returns (overflowed, too_long) index lists the caller must
-    ladder per history — `overflowed` saw verdict "unknown" at this
-    f_cap, `too_long` exceed one scan program (LONG_SCAN_MAX) and were
-    never launched. Batches are chunked so batch*f_cap*(k_slots+1) stays
-    inside the tested-good sort-row budget (the axon worker faults past
-    ~2M rows) AND the stacked slot tables stay a few hundred MB."""
+    a sequential per-history ladder, escalating the frontier capacity in
+    BATCHED tiers (GENERAL_TIERS, extended to cover the caller's f_cap).
+    Exact verdicts (survived, or dead without overflow — soundness
+    argument in ops/wgl2.py) land in `results`; returns (overflowed,
+    too_long, top_tier): `overflowed` stayed "unknown" at every tier,
+    `too_long` exceed one scan program (LONG_SCAN_MAX) and were never
+    launched — both must ladder per history. Launches are chunked so
+    batch*f_cap*(k_slots+1) stays inside the tested-good sort-row budget
+    (the axon worker faults past ~2M rows) AND the stacked slot tables
+    stay a few hundred MB."""
     import jax.numpy as jnp
 
     from . import wgl, wgl2, wgl3
@@ -564,8 +574,7 @@ def _batch_general(encs, idxs, model, results, kernels,
 
     sub = [(i, encs[i]) for i in idxs]
     k = max(wgl2.sort_k_slots(e) for _, e in sub)
-    cfg = wgl2.make_config(model, k, f_cap,
-                           max(e.max_value for _, e in sub))
+    max_value = max(e.max_value for _, e in sub)
     steps, too_long = [], []
     for i, e in sub:
         rs = encode_return_steps(
@@ -575,39 +584,60 @@ def _batch_general(encs, idxs, model, results, kernels,
         else:
             steps.append((i, rs))
     if not steps:
-        return [], too_long
+        return [], too_long, GENERAL_TIERS[-1]
     r_cap = min(wgl3.step_bucket(max(1, max(s.n_steps for _, s in steps))),
                 wgl3.LONG_SCAN_MAX)
-    chunk = max(1, min(
-        (1 << 21) // (f_cap * (k + 1)),          # sort-row budget
-        (1 << 26) // max(1, r_cap * (k + 1))))   # stacked-input elements
-    check = wgl2.cached_batch_checker2(model, cfg)
-    overflowed: list[int] = []
-    for c0 in range(0, len(steps), chunk):
-        part = steps[c0:c0 + chunk]
-        # Bucket the batch axis too: bounded recompiles across corpora of
-        # varying size (pad histories are all-pad scans — no search work).
-        b_cap = min(wgl3.step_bucket(len(part), floor=8), chunk)
-        padded = [s.padded_to(r_cap) for _, s in part]
-        tabs = np.zeros((b_cap,) + padded[0].slot_tabs.shape, np.int32)
-        act = np.zeros((b_cap,) + padded[0].slot_active.shape, bool)
-        tgt = np.full((b_cap, r_cap), -1, np.int32)
-        for j, p in enumerate(padded):
-            tabs[j], act[j], tgt[j] = p.slot_tabs, p.slot_active, p.targets
-        out = {name: np.asarray(v) for name, v in check(
-            jnp.asarray(tabs), jnp.asarray(act), jnp.asarray(tgt)).items()}
-        for j, (i, s) in enumerate(part):
-            one = {name: out[name][j].item() for name in out}
-            v = wgl.verdict(one)
-            if v == "unknown":
-                overflowed.append(i)
-                continue
-            results[i] = {
-                "valid": v, "survived": one["survived"],
-                "overflow": one["overflow"], "dead_step": one["dead_step"],
-                "max_frontier": one["max_frontier"], "op_count": s.n_ops,
-                "f_cap": cfg.f_cap, "escalations": 0,
-                "kernel": "wgl2-sort-batched",
-            }
-            kernels.add("wgl2-sort-batched")
-    return overflowed, too_long
+    tiers = [t for t in GENERAL_TIERS if t <= max(f_cap, GENERAL_TIERS[0])]
+    if f_cap > tiers[-1]:
+        tiers.append(f_cap)
+    # No tier may exceed the sort-row budget for ONE history — chunking
+    # shrinks the batch, never a single lane's f_cap*(k+1) rows.
+    cap_max = max(GENERAL_TIERS[0], (1 << 21) // (k + 1))
+    tiers = sorted({min(t, cap_max) for t in tiers})
+
+    def launch(tier_steps, tier_cap):
+        cfg = wgl2.make_config(model, k, tier_cap, max_value)
+        chunk = max(1, min(
+            (1 << 21) // (tier_cap * (k + 1)),       # sort-row budget
+            (1 << 26) // max(1, r_cap * (k + 1))))   # stacked elements
+        check = wgl2.cached_batch_checker2(model, cfg)
+        overflowed = []
+        for c0 in range(0, len(tier_steps), chunk):
+            part = tier_steps[c0:c0 + chunk]
+            # Bucket the batch axis too: bounded recompiles across corpora
+            # of varying size (pad histories are all-pad scans — no work).
+            b_cap = min(wgl3.step_bucket(len(part), floor=8), chunk)
+            padded = [s.padded_to(r_cap) for _, s in part]
+            tabs = np.zeros((b_cap,) + padded[0].slot_tabs.shape, np.int32)
+            act = np.zeros((b_cap,) + padded[0].slot_active.shape, bool)
+            tgt = np.full((b_cap, r_cap), -1, np.int32)
+            for j, p in enumerate(padded):
+                tabs[j] = p.slot_tabs
+                act[j] = p.slot_active
+                tgt[j] = p.targets
+            out = {name: np.asarray(v) for name, v in check(
+                jnp.asarray(tabs), jnp.asarray(act),
+                jnp.asarray(tgt)).items()}
+            for j, (i, s) in enumerate(part):
+                one = {name: out[name][j].item() for name in out}
+                v = wgl.verdict(one)
+                if v == "unknown":
+                    overflowed.append((i, s))
+                    continue
+                results[i] = {
+                    "valid": v, "survived": one["survived"],
+                    "overflow": one["overflow"],
+                    "dead_step": one["dead_step"],
+                    "max_frontier": one["max_frontier"], "op_count": s.n_ops,
+                    "f_cap": tier_cap, "escalations": 0,
+                    "kernel": "wgl2-sort-batched",
+                }
+                kernels.add("wgl2-sort-batched")
+        return overflowed
+
+    remaining = steps
+    for tier_cap in tiers:
+        remaining = launch(remaining, tier_cap)
+        if not remaining:
+            break
+    return [i for i, _ in remaining], too_long, tiers[-1]

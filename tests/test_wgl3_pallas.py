@@ -145,3 +145,39 @@ def test_batched_general_path_matches_ladder():
     results, kernel = wgl3_pallas.check_batch_encoded_auto(encs, model)
     assert [r["valid"] for r in results] == expected
     assert any(r["kernel"] == "wgl2-sort-batched" for r in results)
+
+
+def test_batched_general_overflow_escalates_exactly():
+    """A frontier-heavy history (12 forever-pending enqueues => 2^12
+    reachable subsets > f_cap) overflows the batched sort pass and must
+    escalate through the per-history ladder to an EXACT verdict, without
+    disturbing its batch-mates."""
+    import random
+
+    from jepsen_etcd_demo_tpu.checkers.oracle import check_events_oracle
+    from jepsen_etcd_demo_tpu.models import UnorderedQueue
+    from jepsen_etcd_demo_tpu.ops import wgl3_pallas
+    from jepsen_etcd_demo_tpu.ops.encode import encode_history
+    from jepsen_etcd_demo_tpu.ops.op import Op
+    from jepsen_etcd_demo_tpu.utils.fuzz import gen_queue_history
+
+    model = UnorderedQueue()
+    heavy = []
+    for p in range(12):
+        heavy.append(Op(type="invoke", f="enqueue", value=p, process=p))
+    for p in range(12):
+        heavy.append(Op(type="info", f="enqueue", value=p, process=p))
+    heavy.append(Op(type="invoke", f="dequeue", value=None, process=20))
+    heavy.append(Op(type="ok", f="dequeue", value=3, process=20))
+    rng = random.Random(5)
+    encs = [encode_history(model.prepare_history(h), model, k_slots=16)
+            for h in ([heavy]
+                      + [gen_queue_history(rng, n_ops=10, n_procs=3,
+                                           fifo=False) for _ in range(3)])]
+    expected = [check_events_oracle(e, model).valid for e in encs]
+    results, _ = wgl3_pallas.check_batch_encoded_auto(encs, model)
+    assert [r["valid"] for r in results] == expected
+    # The heavy history escalated (its kernel names a ladder rung, not the
+    # batched pass) and its verdict is exact, not "unknown".
+    assert results[0]["kernel"] != "wgl2-sort-batched"
+    assert results[0]["valid"] in (True, False)
