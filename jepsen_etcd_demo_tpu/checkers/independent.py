@@ -102,11 +102,16 @@ class IndependentChecker(Checker):
             # A batched result settles the key only when valid: invalid keys
             # re-run the single-history path, which reconstructs and stores
             # the counterexample witness (linear-<key>.json/svg); "unknown"
-            # re-runs for the escalation ladder.
+            # re-runs for the escalation ladder, seeded past the capacities
+            # the batched tiers already proved dead (f_cap_floor).
             pre = batched.get(name, {}).get(key)
             if pre is not None and pre["valid"] is True:
                 return pre
-            return checker.check(test, sub_history, opts)
+            sub_opts = opts
+            if pre and pre.get("f_cap_floor"):
+                sub_opts = dict(opts)
+                sub_opts["f_cap_floor"] = pre["f_cap_floor"]
+            return checker.check(test, sub_history, sub_opts)
 
         if not isinstance(self.sub_checker, Compose):
             return pick(None, self.sub_checker)
@@ -162,24 +167,21 @@ def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]],
     # Sort-kernel path: the shared batched general pass (one copy of the
     # pad/stack/launch/verdict logic, with its row-budget chunking and
     # LONG_SCAN_MAX guard — wgl3_pallas._batch_general). Keys the tiers
-    # could not settle run the exact ladder HERE, seeded past the
-    # proven-dead capacities; only their invalid/unknown outcomes stay
-    # absent so _check_key's pick() re-runs the single path for witness
-    # extraction.
-    from ..ops.wgl3_pallas import _batch_general, check_encoded_general
+    # could not settle get an "unknown" marker carrying an f_cap_floor:
+    # _check_key's pick() threads it into the single-path re-run, so the
+    # ladder there starts past the capacities the tiers proved dead (one
+    # ladder run per unsettled key, witnesses included).
+    from ..ops.wgl3_pallas import LADDER_SEED_FACTOR, _batch_general
 
     keys = list(event_encs)
     slots: list = [None] * len(keys)
     overflowed, too_long, top = _batch_general(
         [event_encs[k] for k in keys], list(range(len(keys))),
         lin.model, slots, set(), f_cap=lin.f_cap)
-    for idx, seed_cap in ([(i, 4 * top) for i in overflowed]
-                          + [(i, lin.f_cap) for i in too_long]):
-        one = check_encoded_general(event_encs[keys[idx]], lin.model,
-                                    f_cap=seed_cap)
-        if one["valid"] is True:
-            slots[idx] = one
     results = {}
+    for i in overflowed:
+        results[keys[i]] = {"valid": "unknown",
+                            "f_cap_floor": LADDER_SEED_FACTOR * top}
     for k, one in zip(keys, slots):
         if one is None:
             continue

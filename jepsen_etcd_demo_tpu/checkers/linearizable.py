@@ -84,7 +84,11 @@ class Linearizable(Checker):
             res["backend"] = "oracle"
             res["op_count"] = enc.n_ops
         else:
-            res = self._check_jax(enc)
+            # f_cap_floor: a batched pre-pass (checkers/independent.py)
+            # may have proven smaller frontier capacities dead — start the
+            # escalation ladder past them.
+            res = self._check_jax(
+                enc, f_cap_floor=int((opts or {}).get("f_cap_floor", 0)))
         if res.get("valid") is False:
             self._explain(res, enc, history, opts)
         return res
@@ -106,7 +110,8 @@ class Linearizable(Checker):
             res["witness_file"] = write_witness(
                 store_dir, (opts or {}).get("key"), w)
 
-    def _check_jax(self, enc: EncodedHistory) -> dict[str, Any]:
+    def _check_jax(self, enc: EncodedHistory,
+                   f_cap_floor: int = 0) -> dict[str, Any]:
         from ..ops import wgl2, wgl3
         from ..ops.encode import encode_return_steps
 
@@ -142,8 +147,8 @@ class Linearizable(Checker):
         # (SURVEY.md §5.4/§5.7).
         from ..ops import wgl3_pallas
 
-        out = wgl3_pallas.check_encoded_general(enc, self.model,
-                                                f_cap=self.f_cap)
+        out = wgl3_pallas.check_encoded_general(
+            enc, self.model, f_cap=max(self.f_cap, f_cap_floor))
         res = {"valid": out["valid"], "backend": "jax",
                "op_count": out["op_count"],
                "dead_step": out["dead_step"],

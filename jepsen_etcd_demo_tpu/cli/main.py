@@ -259,22 +259,28 @@ def cmd_corpus(args) -> int:
             workload = run.read_test().get("workload", "register")
         except (ValueError, OSError):
             workload = "register"
-        model_name = CORPUS_MODELS.get(workload)
+        whole = workload in WHOLE_HISTORY_MODELS
+        model_name = (WHOLE_HISTORY_MODELS[workload] if whole
+                      else CORPUS_MODELS.get(workload))
         if model_name is None:
             print(f"# skipping {run.path}: workload {workload!r} is not "
-                  f"linearizability-checked per key", file=sys.stderr)
+                  f"linearizability-checked", file=sys.stderr)
             continue
         if workload == "register":
             model_name = args.model
         if not args.reencode:
             # The tensor set must COVER the run (an interrupted original
             # check may have persisted only some keys): the run-time
-            # results.json records how many keys the check saw.
+            # results.json records how many keys the check saw; a
+            # whole-history run has exactly one tensor (history.npz).
             tensors = read_encoded_tensors(run.path, model_name)
-            try:
-                expected = run.read_results()["indep"]["key_count"]
-            except (ValueError, OSError, KeyError, TypeError):
-                expected = None
+            if whole:
+                expected = 1
+            else:
+                try:
+                    expected = run.read_results()["indep"]["key_count"]
+                except (ValueError, OSError, KeyError, TypeError):
+                    expected = None
             if tensors and len(tensors) == expected:
                 runs_seen.add(str(run.path))
                 n_from_tensors += len(tensors)
@@ -286,7 +292,12 @@ def cmd_corpus(args) -> int:
         # must not crash the whole corpus pass).
         lin = Linearizable(model=model_name)
         try:
-            keyed = split_by_key(run.read_history())
+            history = run.read_history()
+            if whole:
+                keyed = {None: [op for op in history
+                                if op.process != "nemesis"]}
+            else:
+                keyed = split_by_key(history)
         except (ValueError, OSError) as e:
             print(f"# skipping {run.path}: {e}", file=sys.stderr)
             continue
@@ -295,7 +306,8 @@ def cmd_corpus(args) -> int:
             try:
                 # str(k): one key identity whichever load path ran (the
                 # tensor path's keys are filename-derived strings).
-                entry = (str(run.path), str(k), lin.encode(h))
+                entry = (str(run.path), None if k is None else str(k),
+                         lin.encode(h))
             except ValueError as e:
                 print(f"# skipping {run.path} key {k}: {e}",
                       file=sys.stderr)

@@ -538,10 +538,17 @@ def check_batch_encoded_auto(encs: Sequence[EncodedHistory],
         for i in overflowed:
             # The batched tiers PROVED capacities up to `top` overflow for
             # these: start the ladder past every dead rung.
-            one = check_encoded_general(encs[i], model, f_cap=4 * top)
+            one = check_encoded_general(encs[i], model,
+                                        f_cap=LADDER_SEED_FACTOR * top)
             results[i] = one
             kernels.add(one["kernel"])
     return results, (kernels.pop() if len(kernels) == 1 else "mixed")
+
+
+# First ladder rung after the batched tiers prove `top` overflows — shared
+# by check_batch_encoded_auto and the independent checker's f_cap_floor
+# threading (checkers/independent.py) so the seeding policy has one copy.
+LADDER_SEED_FACTOR = 4
 
 
 # Batched-tier capacities for the non-dense pass. Start small: sort cost
@@ -587,13 +594,13 @@ def _batch_general(encs, idxs, model, results, kernels, f_cap: int = 256
         return [], too_long, GENERAL_TIERS[-1]
     r_cap = min(wgl3.step_bucket(max(1, max(s.n_steps for _, s in steps))),
                 wgl3.LONG_SCAN_MAX)
-    tiers = [t for t in GENERAL_TIERS if t <= max(f_cap, GENERAL_TIERS[0])]
-    if f_cap > tiers[-1]:
-        tiers.append(f_cap)
-    # No tier may exceed the sort-row budget for ONE history — chunking
+    # Every GENERAL_TIERS rung runs regardless of the caller's f_cap (the
+    # point of tiering is re-batching overflows instead of laddering them
+    # per history); f_cap joins as an extra rung when it is larger. No
+    # tier may exceed the sort-row budget for ONE history — chunking
     # shrinks the batch, never a single lane's f_cap*(k+1) rows.
     cap_max = max(GENERAL_TIERS[0], (1 << 21) // (k + 1))
-    tiers = sorted({min(t, cap_max) for t in tiers})
+    tiers = sorted({min(t, cap_max) for t in (*GENERAL_TIERS, f_cap)})
 
     def launch(tier_steps, tier_cap):
         cfg = wgl2.make_config(model, k, tier_cap, max_value)

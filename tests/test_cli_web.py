@@ -223,6 +223,14 @@ def test_corpus_replay_routes_models_by_workload(tmp_path, capsys):
     assert rc == 0 and out2["valid"] is True
     assert out2["from_tensors"] == 0 and out2["keys"] == out["keys"]
 
+    # Whole-history workloads join the corpus too (one tensor per run).
+    assert main(["test", "-w", "mutex", "--fake", "--no-nemesis",
+                 "--time-limit", "1.0", "--rate", "150",
+                 "--store", store, "--seed", "34"]) == 0
+    rc = main(["corpus", store])
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["valid"] is True and out["runs"] == 3
+
     assert main(["test", "-w", "queue", "--fake", "--no-nemesis",
                  "--time-limit", "1.0", "--rate", "150",
                  "--store", store, "--seed", "33",
