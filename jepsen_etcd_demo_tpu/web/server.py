@@ -31,6 +31,12 @@ def _run_summary(results: dict) -> str:
         if isinstance(lin, dict) and lin.get("valid") is False:
             op = lin.get("failed_op")
             bits.append(f"key {key}: {op}" if op else f"key {key}: invalid")
+    # Whole-history workloads (gset/mutex/multiregister) have no per-key
+    # results — the failing op sits directly under indep.linear.
+    whole_lin = indep.get("linear") or {}
+    if whole_lin.get("valid") is False:
+        op = whole_lin.get("failed_op")
+        bits.append(str(op) if op else "invalid")
     elle = indep.get("elle") or {}
     if elle.get("anomaly_types"):
         bits.append("anomalies: " + ", ".join(elle["anomaly_types"]))

@@ -258,3 +258,21 @@ def test_index_shows_failure_detail(tmp_path):
         assert " ops" in idx        # perf count rendered
     finally:
         httpd.shutdown()
+
+
+def test_index_shows_whole_history_failure_detail(tmp_path):
+    """A failed mutex (whole-history) run's index row names the failing op
+    — there are no per-key results for these workloads."""
+    store = str(tmp_path / "store")
+    assert main(["test", "-w", "mutex", "--fake", "--no-nemesis",
+                 "--time-limit", "1.0", "--rate", "150",
+                 "--store", store, "--seed", "63",
+                 "--lost-write-prob", "0.5"]) == 1
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(store))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        idx = urllib.request.urlopen(
+            f"http://127.0.0.1:{httpd.server_address[1]}/").read().decode()
+        assert "acquire" in idx or "release" in idx
+    finally:
+        httpd.shutdown()
