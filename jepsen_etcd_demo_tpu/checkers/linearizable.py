@@ -33,13 +33,18 @@ def _event_to_step(enc: EncodedHistory, dead_event: int) -> int:
 
 class Linearizable(Checker):
     def __init__(self, model: Model | str = "cas-register",
-                 backend: str = "jax", k_slots: int = 24, f_cap: int = 256):
+                 backend: str = "jax", k_slots: int = 24, f_cap: int = 256,
+                 time_budget_s: float | None = None):
         self.model = get_model(model) if isinstance(model, str) else model
         if backend not in ("jax", "oracle"):
             raise ValueError(f"unknown backend {backend!r}")
         self.backend = backend
         self.k_slots = k_slots
         self.f_cap = f_cap
+        # Wall-clock bound on the sort-ladder search; expiry yields the
+        # honest tri-state "unknown" (combinatorial frontiers DNF every
+        # WGL implementation, knossos included — ops/wgl2.py).
+        self.time_budget_s = time_budget_s
 
     # -- encoding ---------------------------------------------------------
     def encode(self, history: Sequence[Op]) -> EncodedHistory:
@@ -148,7 +153,8 @@ class Linearizable(Checker):
         from ..ops import wgl3_pallas
 
         out = wgl3_pallas.check_encoded_general(
-            enc, self.model, f_cap=max(self.f_cap, f_cap_floor))
+            enc, self.model, f_cap=max(self.f_cap, f_cap_floor),
+            time_budget_s=self.time_budget_s)
         res = {"valid": out["valid"], "backend": "jax",
                "op_count": out["op_count"],
                "dead_step": out["dead_step"],

@@ -255,7 +255,17 @@ def mutex_workload(opts: dict, conn_factory: Callable) -> dict:
     return {
         "client": MutexClient(conn_factory),
         "checker": Compose({
-            "linear": Linearizable("mutex", backend="jax"),
+            # Long partitions pile up indeterminate acquires AND releases,
+            # whose interleavings explode combinatorially (~C(2m, m)
+            # configs for m of each) — a genuinely knossos-DNF shape. The
+            # time budget converts that grind into the honest tri-state
+            # "unknown" (run exits nonzero either way).
+            "linear": Linearizable(
+                "mutex", backend="jax",
+                time_budget_s=(float(opts["check_budget_s"])
+                               if opts.get("check_budget_s")
+                               else (None if "check_budget_s" in opts
+                                     else 120.0))),
             "timeline": TimelineChecker(),
         }),
         "generator": gen.repeat(step),

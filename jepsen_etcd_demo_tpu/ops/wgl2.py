@@ -307,16 +307,27 @@ def _migrate_carry(carry: _Carry2, f_new: int) -> _Carry2:
 
 def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
                           f_cap: int = 256, chunk: int = 256,
-                          f_cap_max: int = 1 << 20) -> dict[str, Any]:
+                          f_cap_max: int = 1 << 20,
+                          time_budget_s: float | None = None
+                          ) -> dict[str, Any]:
     """Exact verdict via chunked scan + checkpointed capacity escalation.
 
     Never falls back to the Python oracle: capacity grows 4x per overflow,
     resuming from the last good chunk boundary, until the frontier fits or
     f_cap_max is exceeded (at which point the search genuinely does not fit
-    device memory and raises)."""
+    device memory and raises MemoryError). `time_budget_s` bounds WALL
+    time the same way — combinatorial frontiers (dozens of forever-pending
+    ops interleaving factorially, e.g. a mutex history full of
+    indeterminate acquires AND releases) otherwise grind through ever-
+    bigger sorts for hours; on expiry the same MemoryError is raised so
+    callers take their exact-or-unknown fallback, mirroring how knossos
+    DNFs on these histories."""
+    import time as _time
+
     if model is None:
         from ..models import CASRegister
         model = CASRegister()
+    t0 = _time.monotonic()
     r = rs.n_steps
     padded = rs.padded_to(((r + chunk - 1) // chunk or 1) * chunk)
     tabs, act, tgt = steps_arrays(padded)
@@ -327,6 +338,12 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
         sl = slice(c0, c0 + chunk)
         idxs = jnp.arange(c0, c0 + chunk, dtype=jnp.int32)
         while True:
+            if (time_budget_s is not None
+                    and _time.monotonic() - t0 > time_budget_s):
+                raise MemoryError(
+                    f"WGL search exceeded its {time_budget_s:.0f}s time "
+                    f"budget at return step {c0} (f_cap={f_cap}); the "
+                    f"frontier is growing combinatorially")
             out = cached_chunk2(model, cfg)(
                 carry, tabs[sl], act[sl], tgt[sl], idxs)
             if not bool(out.overflow):
@@ -369,7 +386,9 @@ def sort_k_slots(enc: EncodedHistory) -> int:
 
 def check_encoded_resumable(enc: EncodedHistory, model: Model | None = None,
                             f_cap: int = 256,
-                            f_cap_max: int = 1 << 20) -> dict[str, Any]:
+                            f_cap_max: int = 1 << 20,
+                            time_budget_s: float | None = None
+                            ) -> dict[str, Any]:
     """The general-geometry production path (huge values or wide pending
     sets where the dense lattice is infeasible): tighten the slot table to
     the history's real concurrency, then run the resumable chunked sort
@@ -390,6 +409,7 @@ def check_encoded_resumable(enc: EncodedHistory, model: Model | None = None,
     # run its first sort past the very limit f_cap_max protects.
     f_cap = max(4, min(f_cap, f_cap_max))
     out = check_steps_resumable(encode_return_steps(enc), model,
-                                f_cap=f_cap, f_cap_max=f_cap_max)
+                                f_cap=f_cap, f_cap_max=f_cap_max,
+                                time_budget_s=time_budget_s)
     out["op_count"] = enc.n_ops
     return out

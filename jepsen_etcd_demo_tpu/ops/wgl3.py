@@ -294,14 +294,21 @@ def _chunk_fn(model: Model, cfg: DenseConfig):
 
 
 def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
-                      chunk: int | None = None) -> dict:
+                      chunk: int | None = None,
+                      time_budget_s: float | None = None) -> dict:
     """Single-history dense check for histories whose step count exceeds
     one scan program: pad to a chunk multiple, loop chunks host-side.
     Bit-identical to check_steps3 (same step fn; pads contribute nothing).
 
     Chunk size scales inversely with table width so one chunk's wall time
     stays far under the axon worker's program-kill threshold (sweep cost
-    per step is proportional to the cell count)."""
+    per step is proportional to the cell count). `time_budget_s` bounds
+    wall time between chunks; expiry returns the honest tri-state
+    "unknown" with overflow=True (same contract as the sort ladder,
+    ops/wgl2.py)."""
+    import time as _time
+
+    t0 = _time.monotonic()
     if chunk is None:
         # Floor 128: at the 2^26-cell budget ceiling a step costs ~70 ms,
         # so even the floor chunk stays ~10 s — safely under the axon
@@ -319,6 +326,14 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
     carry = _init_carry3(model, cfg)
     cfgs_dev = None
     for c in range(n_pad // chunk):
+        if (time_budget_s is not None
+                and _time.monotonic() - t0 > time_budget_s):
+            return {"valid": "unknown", "survived": False, "overflow": True,
+                    "dead_step": -1, "max_frontier": -1,
+                    "configs_explored": -1, "kernel": "exhausted",
+                    "error": f"dense-chunked sweep exceeded its "
+                             f"{time_budget_s:.0f}s time budget at return "
+                             f"step {c * chunk}"}
         sl = slice(c * chunk, (c + 1) * chunk)
         carry, part = run(carry, jnp.asarray(rs.slot_tabs[sl]),
                           jnp.asarray(rs.slot_active[sl]),

@@ -394,7 +394,8 @@ def check_batch_encoded_pallas(encs: Sequence[EncodedHistory],
 
 def check_encoded_general(enc: EncodedHistory, model: Model,
                           f_cap: int = 256,
-                          f_cap_max: int | None = None) -> dict:
+                          f_cap_max: int | None = None,
+                          time_budget_s: float | None = None) -> dict:
     """The exact-verdict ladder for geometries OUTSIDE the dense budget
     (wide pending sets / huge values):
 
@@ -419,6 +420,15 @@ def check_encoded_general(enc: EncodedHistory, model: Model,
 
     tight = wgl2.sort_k_slots(enc)   # f_cap_max sizing must match the
     #                                  width the sort kernel really uses
+    # A CHUNKED dense lattice under the relaxed 2^26-cell budget, when one
+    # exists, beats the sort kernel's high rungs: past a few thousand live
+    # configs each expansion round sorts f_cap*(k+1) keys, while the dense
+    # sweep's bit-parallel cost is fixed — combinatorial frontiers (e.g. a
+    # mutex history with m indeterminate acquires AND releases pending:
+    # ~C(2m, m) reachable configs) DNF the sort ladder but sweep in
+    # seconds. So cap the sort rungs early when dense-chunked is waiting.
+    cfg_dense = wgl3.dense_config(model, tight, enc.max_value,
+                                  budget=1 << 26)
     if f_cap_max is None:
         # The ~2M-key sort allocation fault is an axon-TPU-worker limit;
         # other backends take the sort kernel as far as memory goes.
@@ -426,28 +436,43 @@ def check_encoded_general(enc: EncodedHistory, model: Model,
             f_cap_max = max(4096, min(1 << 20, (1 << 21) // (tight + 1)))
         else:
             f_cap_max = 1 << 20
+        if cfg_dense is not None:
+            # Stop the sort ladder where the dense sweep becomes cheaper:
+            # a sort rung costs ~f_cap*(k+1) sorted keys per step, the
+            # dense sweep a fixed ~cells bit-ops per step. (Only for the
+            # computed default — an explicit caller f_cap_max stands.)
+            cells = cfg_dense.n_states * cfg_dense.n_masks
+            f_cap_max = min(f_cap_max, max(f_cap, cells // (tight + 1)))
+
+    def dense_chunked(enc):
+        if enc.k_slots != tight:
+            enc = reslot_events(enc, tight)
+        out = wgl3.check_steps3_long(encode_return_steps(enc), model,
+                                     cfg_dense,
+                                     time_budget_s=time_budget_s)
+        out["op_count"] = enc.n_ops
+        out["f_cap"] = cfg_dense.n_states * cfg_dense.n_masks
+        out["escalations"] = 0
+        if out.get("valid") != "unknown":
+            out["kernel"] = "wgl3-dense-chunked"
+        return out
+
     try:
         out = wgl2.check_encoded_resumable(enc, model, f_cap=f_cap,
-                                           f_cap_max=f_cap_max)
+                                           f_cap_max=f_cap_max,
+                                           time_budget_s=time_budget_s)
         out["kernel"] = "wgl2-sort-resumable"
         return out
     except MemoryError as e:
-        cfg = wgl3.dense_config(model, tight, enc.max_value,
-                                budget=1 << 26)
-        if cfg is None:
+        # Capacity OR time exhausted: the dense-chunked rung (no frontier
+        # capacity at all) when one exists, else the honest tri-state.
+        if cfg_dense is None:
             return {"valid": "unknown", "survived": False, "overflow": True,
                     "dead_step": -1, "max_frontier": -1,
                     "op_count": enc.n_ops, "f_cap": f_cap_max,
                     "escalations": -1, "kernel": "exhausted",
                     "error": str(e)}
-        if enc.k_slots != tight:
-            enc = reslot_events(enc, tight)
-        out = wgl3.check_steps3_long(encode_return_steps(enc), model, cfg)
-        out["op_count"] = enc.n_ops
-        out["f_cap"] = cfg.n_states * cfg.n_masks
-        out["escalations"] = 0
-        out["kernel"] = "wgl3-dense-chunked"
-        return out
+        return dense_chunked(enc)
 
 
 def packed_batch_checker(model: Model, cfg: DenseConfig,

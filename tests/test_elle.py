@@ -417,3 +417,13 @@ def test_realtime_append_run_e2e(tmp_path):
     result = asyncio.run(run_test(test))
     assert result["valid"] is True
     assert result["indep"]["elle"]["realtime"] is True
+
+
+def test_realtime_append_run_with_partitions_is_valid(tmp_path):
+    """Under partitions, indeterminate txns contribute no realtime edges
+    (they never complete), so a correct store must still verify under
+    strict serializability."""
+    test = fake_test(fast_opts(tmp_path, elle_realtime=True, seed=13))
+    result = asyncio.run(run_test(test))
+    assert result["valid"] is True
+    assert result["indep"]["elle"]["realtime"] is True
