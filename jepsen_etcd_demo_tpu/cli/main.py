@@ -33,6 +33,13 @@ def positive_float(s: str) -> float:
     return v
 
 
+def nonnegative_float(s: str) -> float:
+    v = float(s)
+    if v < 0:
+        raise argparse.ArgumentTypeError("must be >= 0 (0 = unbounded)")
+    return v
+
+
 def positive_int(s: str) -> int:
     v = int(s)
     if v <= 0:
@@ -88,6 +95,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="[fake] inject stale non-quorum reads")
     t.add_argument("--lost-write-prob", type=float, default=0.0,
                    help="[fake] inject acked-but-lost updates")
+    t.add_argument("--check-budget-s", type=nonnegative_float, default=120.0,
+                   help="wall-clock bound per linearizability search "
+                        "(0 = unbounded); expiry yields the tri-state "
+                        "'unknown' verdict instead of grinding on "
+                        "combinatorial frontiers")
     t.add_argument("--elle-realtime", action="store_true",
                    help="append workload: assert STRICT serializability "
                         "(wall-clock order joins the elle dependency graph)")
@@ -152,6 +164,7 @@ def _test_opts(args) -> dict:
         "lost_write_prob": args.lost_write_prob,
         "duplicate_cas_prob": args.duplicate_cas_prob,
         "elle_realtime": args.elle_realtime,
+        "check_budget_s": args.check_budget_s,
         "reorder_prob": args.reorder_prob,
         "duplicate_delivery_prob": args.duplicate_delivery_prob,
     }
@@ -189,6 +202,10 @@ def cmd_analyze(args) -> int:
         stored_test = {}
     workload = args.workload or stored_test.get("workload", "register")
     model = args.model or CORPUS_MODELS.get(workload, "cas-register")
+    # Re-check under the run's own search budget (combinatorial mutex
+    # histories would otherwise grind unbounded on analyze).
+    from ..compose import _check_budget
+    budget = _check_budget(stored_test)
     if workload == "set":
         sub = SetChecker()
         checker = Compose({"perf": PerfChecker(), "indep": sub})
@@ -212,8 +229,9 @@ def cmd_analyze(args) -> int:
     else:
         checker = Compose({"perf": PerfChecker(),
                            "indep": IndependentChecker(Compose({
-                               "linear": Linearizable(model,
-                                                      backend=args.backend),
+                               "linear": Linearizable(
+                                   model, backend=args.backend,
+                                   time_budget_s=budget),
                                "timeline": TimelineChecker()}))})
     result = checker.check({}, history, {"store_dir": str(run.path)})
     run.write_results(result)

@@ -61,6 +61,17 @@ def cas(ctx):
     return {"f": "cas", "value": (ctx.rng.randrange(5), ctx.rng.randrange(5))}
 
 
+def _check_budget(opts: dict) -> Optional[float]:
+    """Wall-clock bound for the linearizability search (None = unbounded,
+    the default 120 s catches combinatorially exploding frontiers —
+    PARITY.md "Wall-clock search budgets"). check_budget_s=0/None in opts
+    disables it."""
+    if "check_budget_s" in opts:
+        v = opts["check_budget_s"]
+        return float(v) if v else None
+    return 120.0
+
+
 def register_workload(opts: dict, conn_factory: Callable) -> dict:
     """Register workload (reference :110-126): mixed r/w/cas over many
     independent keys, checked {linear: TPU-WGL cas-register, timeline: html}
@@ -68,7 +79,8 @@ def register_workload(opts: dict, conn_factory: Callable) -> dict:
     return {
         "client": RegisterClient(conn_factory),
         "checker": IndependentChecker(Compose({
-            "linear": Linearizable("cas-register", backend="jax"),
+            "linear": Linearizable("cas-register", backend="jax",
+                                   time_budget_s=_check_budget(opts)),
             "timeline": TimelineChecker(),
         })),
         "generator": gen.concurrent_generator(
@@ -170,7 +182,8 @@ def queue_workload(opts: dict, conn_factory: Callable) -> dict:
     return {
         "client": QueueClient(conn_factory),
         "checker": IndependentChecker(Compose({
-            "linear": Linearizable(model, backend="jax"),
+            "linear": Linearizable(model, backend="jax",
+                                   time_budget_s=_check_budget(opts)),
             "timeline": TimelineChecker(),
         })),
         "generator": gen.concurrent_generator(10, _key_stream(), per_key),
@@ -199,7 +212,8 @@ def multiregister_workload(opts: dict, conn_factory: Callable) -> dict:
     return {
         "client": MultiRegisterClient(conn_factory),
         "checker": Compose({
-            "linear": Linearizable(model, backend="jax"),
+            "linear": Linearizable(model, backend="jax",
+                                   time_budget_s=_check_budget(opts)),
             "timeline": TimelineChecker(),
         }),
         "generator": gen.repeat(step),
@@ -228,7 +242,8 @@ def gset_workload(opts: dict, conn_factory: Callable) -> dict:
     return {
         "client": SetClient(conn_factory),
         "checker": Compose({
-            "linear": Linearizable("gset", backend="jax"),
+            "linear": Linearizable("gset", backend="jax",
+                                   time_budget_s=_check_budget(opts)),
             "timeline": TimelineChecker(),
         }),
         "generator": gen.repeat(step),
@@ -260,12 +275,8 @@ def mutex_workload(opts: dict, conn_factory: Callable) -> dict:
             # configs for m of each) — a genuinely knossos-DNF shape. The
             # time budget converts that grind into the honest tri-state
             # "unknown" (run exits nonzero either way).
-            "linear": Linearizable(
-                "mutex", backend="jax",
-                time_budget_s=(float(opts["check_budget_s"])
-                               if opts.get("check_budget_s")
-                               else (None if "check_budget_s" in opts
-                                     else 120.0))),
+            "linear": Linearizable("mutex", backend="jax",
+                                   time_budget_s=_check_budget(opts)),
             "timeline": TimelineChecker(),
         }),
         "generator": gen.repeat(step),
