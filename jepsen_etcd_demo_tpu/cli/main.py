@@ -204,8 +204,8 @@ def cmd_analyze(args) -> int:
     model = args.model or CORPUS_MODELS.get(workload, "cas-register")
     # Re-check under the run's own search budget (combinatorial mutex
     # histories would otherwise grind unbounded on analyze).
-    from ..compose import _check_budget
-    budget = _check_budget(stored_test)
+    from ..compose import check_budget
+    budget = check_budget(stored_test)
     if workload == "set":
         sub = SetChecker()
         checker = Compose({"perf": PerfChecker(), "indep": sub})
@@ -216,7 +216,8 @@ def cmd_analyze(args) -> int:
                                "linear": Linearizable(
                                    args.model or
                                    WHOLE_HISTORY_MODELS[workload],
-                                   backend=args.backend),
+                                   backend=args.backend,
+                                   time_budget_s=budget),
                                "timeline": TimelineChecker()})})
     elif workload == "append":
         # Re-check under the same strictness the run recorded (a strict-

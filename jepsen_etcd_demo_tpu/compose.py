@@ -61,7 +61,7 @@ def cas(ctx):
     return {"f": "cas", "value": (ctx.rng.randrange(5), ctx.rng.randrange(5))}
 
 
-def _check_budget(opts: dict) -> Optional[float]:
+def check_budget(opts: dict) -> Optional[float]:
     """Wall-clock bound for the linearizability search (None = unbounded,
     the default 120 s catches combinatorially exploding frontiers —
     PARITY.md "Wall-clock search budgets"). check_budget_s=0/None in opts
@@ -80,7 +80,7 @@ def register_workload(opts: dict, conn_factory: Callable) -> dict:
         "client": RegisterClient(conn_factory),
         "checker": IndependentChecker(Compose({
             "linear": Linearizable("cas-register", backend="jax",
-                                   time_budget_s=_check_budget(opts)),
+                                   time_budget_s=check_budget(opts)),
             "timeline": TimelineChecker(),
         })),
         "generator": gen.concurrent_generator(
@@ -183,7 +183,7 @@ def queue_workload(opts: dict, conn_factory: Callable) -> dict:
         "client": QueueClient(conn_factory),
         "checker": IndependentChecker(Compose({
             "linear": Linearizable(model, backend="jax",
-                                   time_budget_s=_check_budget(opts)),
+                                   time_budget_s=check_budget(opts)),
             "timeline": TimelineChecker(),
         })),
         "generator": gen.concurrent_generator(10, _key_stream(), per_key),
@@ -213,7 +213,7 @@ def multiregister_workload(opts: dict, conn_factory: Callable) -> dict:
         "client": MultiRegisterClient(conn_factory),
         "checker": Compose({
             "linear": Linearizable(model, backend="jax",
-                                   time_budget_s=_check_budget(opts)),
+                                   time_budget_s=check_budget(opts)),
             "timeline": TimelineChecker(),
         }),
         "generator": gen.repeat(step),
@@ -243,7 +243,7 @@ def gset_workload(opts: dict, conn_factory: Callable) -> dict:
         "client": SetClient(conn_factory),
         "checker": Compose({
             "linear": Linearizable("gset", backend="jax",
-                                   time_budget_s=_check_budget(opts)),
+                                   time_budget_s=check_budget(opts)),
             "timeline": TimelineChecker(),
         }),
         "generator": gen.repeat(step),
@@ -258,14 +258,16 @@ def mutex_workload(opts: dict, conn_factory: Callable) -> dict:
     model judges the acknowledged ones), checked as ONE whole-run history."""
     from .clients.mutex_client import MutexClient
 
-    state: dict[int, int] = {}
+    def thread_gen():
+        state = {"i": 0}
 
-    def step(ctx):
-        conc = int((ctx.test or {}).get("concurrency", 10))
-        t = int(ctx.process) % conc
-        i = state.get(t, 0)
-        state[t] = i + 1
-        return {"f": "acquire" if i % 2 == 0 else "release", "value": None}
+        def step(ctx):
+            i = state["i"]
+            state["i"] = i + 1
+            return {"f": "acquire" if i % 2 == 0 else "release",
+                    "value": None}
+
+        return gen.repeat(step)
 
     return {
         "client": MutexClient(conn_factory),
@@ -276,10 +278,10 @@ def mutex_workload(opts: dict, conn_factory: Callable) -> dict:
             # time budget converts that grind into the honest tri-state
             # "unknown" (run exits nonzero either way).
             "linear": Linearizable("mutex", backend="jax",
-                                   time_budget_s=_check_budget(opts)),
+                                   time_budget_s=check_budget(opts)),
             "timeline": TimelineChecker(),
         }),
-        "generator": gen.repeat(step),
+        "generator": gen.each_thread(thread_gen),
         "final_generator": None,
     }
 

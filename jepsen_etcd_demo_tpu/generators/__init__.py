@@ -31,6 +31,7 @@ from .core import (  # noqa: F401
     fn_gen, lift, Mix, Limit, Once, TimeLimit, Stagger, Sleep, Log, Seq,
     Cycle, Repeat, OnNemesis, OnClients, Phases,
     mix, limit, once, time_limit, stagger, sleep, log, seq, cycle, repeat,
+    each_thread,
     nemesis_gen, clients_gen, phases,
 )
 from .independent import ConcurrentGenerator, concurrent_generator, tuple_gen  # noqa: F401

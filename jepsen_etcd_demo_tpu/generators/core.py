@@ -290,6 +290,35 @@ class Repeat(Gen):
         return self.gen.next_for(ctx)
 
 
+class EachThread(Gen):
+    """One independent sub-generator per worker THREAD — jepsen's
+    gen/each-thread. The factory is called once per thread (thread =
+    process mod concurrency: jepsen reincarnates a crashed process as
+    p + concurrency on the SAME thread, which keeps its generator).
+    The canonical use is a per-thread state machine like the mutex
+    workload's acquire/release alternation (compose.py)."""
+
+    def __init__(self, factory: Callable[[], Any]):
+        self.factory = factory
+        self.per_thread: dict[int, Gen] = {}
+
+    def next_for(self, ctx: GenContext) -> NextResult:
+        if ctx.process == NEMESIS:
+            return Pending(None)
+        # Default 10 MUST match the runner's (runner/core.py): thread
+        # identity across process reincarnation (p + concurrency) breaks
+        # if the two disagree.
+        conc = int((ctx.test or {}).get("concurrency", 10))
+        thread = int(ctx.process) % conc
+        if thread not in self.per_thread:
+            self.per_thread[thread] = lift(self.factory())
+        return self.per_thread[thread].next_for(ctx)
+
+
+def each_thread(factory: Callable[[], Any]) -> Gen:
+    return EachThread(factory)
+
+
 class OnNemesis(Gen):
     """Route a generator to the nemesis channel only — gen/nemesis
     (reference src/jepsen/etcdemo.clj:138). Client askers see Pending."""

@@ -189,3 +189,31 @@ def test_full_schedule_determinism():
 
     assert build(3) == build(3)
     assert build(3) != build(4)
+
+
+def test_each_thread_gives_every_thread_its_own_generator():
+    """gen/each-thread equivalent: one independent sub-generator per worker
+    thread, shared across process reincarnations on that thread."""
+    import random as _random
+
+    from jepsen_etcd_demo_tpu.generators import each_thread, repeat
+    from jepsen_etcd_demo_tpu.generators.core import GenContext, NEMESIS, Pending
+
+    def factory():
+        state = {"i": 0}
+
+        def step(ctx):
+            state["i"] += 1
+            return {"f": "op", "value": state["i"]}
+
+        return repeat(step)
+
+    g = each_thread(factory)
+    ctx = lambda p: GenContext(0, p, _random.Random(0),
+                               {"concurrency": 4})
+    assert g.next_for(ctx(0)).value == 1
+    assert g.next_for(ctx(1)).value == 1       # own counter per thread
+    assert g.next_for(ctx(0)).value == 2
+    # Reincarnated process 4 = thread 0: continues thread 0's generator.
+    assert g.next_for(ctx(4)).value == 3
+    assert isinstance(g.next_for(ctx(NEMESIS)), Pending)
