@@ -59,6 +59,35 @@ class Client(abc.ABC):
         pass
 
 
+class ConnClient(Client):
+    """Client whose per-process state is one connection from
+    conn_factory(test, node). Shares the open/close lifecycle every
+    concrete client repeats; subclasses implement invoke() (and setup()
+    when the workload needs data-plane init)."""
+
+    def __init__(self, conn_factory, conn=None):
+        self.conn_factory = conn_factory
+        self.conn = conn
+
+    async def open(self, test: dict, node: str) -> "ConnClient":
+        conn = self.conn_factory(test, node)
+        if hasattr(conn, "__await__"):
+            conn = await conn
+        self._check_conn(conn)
+        return type(self)(self.conn_factory, conn)
+
+    def _check_conn(self, conn) -> None:
+        """Hook: fail fast on an incompatible connection (e.g. the txn
+        client against a non-transactional store)."""
+
+    async def close(self, test: dict) -> None:
+        close = getattr(self.conn, "close", None)
+        if close is not None:
+            res = close()
+            if hasattr(res, "__await__"):
+                await res
+
+
 def completed(op: Op, type_: str, value: Any = None, error: Any = None) -> Op:
     """Build the completion record for an invocation."""
     return Op(type=type_, f=op.f,

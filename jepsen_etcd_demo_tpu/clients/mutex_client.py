@@ -14,25 +14,15 @@ pending-forever semantics carry it).
 
 from __future__ import annotations
 
-from typing import Callable
-
 from ..ops.op import Op
-from .base import Client, ClientError, NotFound, Timeout, completed
+from .base import ConnClient, ClientError, NotFound, Timeout, completed
 
 LOCK_KEY = "a-lock"
 UNLOCKED, LOCKED = "0", "1"
 
 
-class MutexClient(Client):
-    def __init__(self, conn_factory: Callable, conn=None):
-        self.conn_factory = conn_factory
-        self.conn = conn
-
-    async def open(self, test: dict, node: str) -> "MutexClient":
-        conn = self.conn_factory(test, node)
-        if hasattr(conn, "__await__"):
-            conn = await conn
-        return MutexClient(self.conn_factory, conn)
+class MutexClient(ConnClient):
+    """conn_factory(test, node) -> an object with async get/reset/cas."""
 
     async def setup(self, test: dict) -> None:
         # Initialize-and-verify: setup must succeed even against a backend
@@ -59,10 +49,3 @@ class MutexClient(Client):
             return completed(op, "fail", error="not-found")
         except ClientError as e:
             return completed(op, "fail", error=str(e))
-
-    async def close(self, test: dict) -> None:
-        close = getattr(self.conn, "close", None)
-        if close is not None:
-            res = close()
-            if hasattr(res, "__await__"):
-                await res

@@ -17,24 +17,12 @@ Error mapping follows the reference client's logic (src/jepsen/etcdemo.clj:
 
 from __future__ import annotations
 
-from typing import Callable
-
 from ..ops.op import Op
-from .base import Client, ClientError, NotFound, Timeout, completed
+from .base import ConnClient, ClientError, NotFound, Timeout, completed
 
 
-class QueueClient(Client):
+class QueueClient(ConnClient):
     """conn_factory(test, node) -> an object with async enqueue/dequeue."""
-
-    def __init__(self, conn_factory: Callable, conn=None):
-        self.conn_factory = conn_factory
-        self.conn = conn
-
-    async def open(self, test: dict, node: str) -> "QueueClient":
-        conn = self.conn_factory(test, node)
-        if hasattr(conn, "__await__"):
-            conn = await conn
-        return QueueClient(self.conn_factory, conn)
 
     async def invoke(self, test: dict, op: Op) -> Op:
         k, v = op.value
@@ -54,10 +42,3 @@ class QueueClient(Client):
             return completed(op, "fail", error="empty")
         except ClientError as e:
             return completed(op, "fail", error=str(e))
-
-    async def close(self, test: dict) -> None:
-        close = getattr(self.conn, "close", None)
-        if close is not None:
-            res = close()
-            if hasattr(res, "__await__"):
-                await res

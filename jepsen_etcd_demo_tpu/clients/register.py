@@ -13,10 +13,10 @@ the stored string to an int, None surviving for missing keys (:71-74,:87-90).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Optional
 
 from ..ops.op import Op
-from .base import Client, ClientError, NotFound, Timeout, completed
+from .base import ConnClient, ClientError, NotFound, Timeout, completed
 
 
 def parse_long(s: Optional[str]):
@@ -24,19 +24,9 @@ def parse_long(s: Optional[str]):
     return None if s is None else int(s)
 
 
-class RegisterClient(Client):
+class RegisterClient(ConnClient):
     """conn_factory(test, node) -> an object with async get/reset/cas
     (FakeKV bound connection or EtcdClient)."""
-
-    def __init__(self, conn_factory: Callable, conn=None):
-        self.conn_factory = conn_factory
-        self.conn = conn
-
-    async def open(self, test: dict, node: str) -> "RegisterClient":
-        conn = self.conn_factory(test, node)
-        if hasattr(conn, "__await__"):
-            conn = await conn
-        return RegisterClient(self.conn_factory, conn)
 
     async def invoke(self, test: dict, op: Op) -> Op:
         k, v = op.value
@@ -62,15 +52,8 @@ class RegisterClient(Client):
         except ClientError as e:
             return completed(op, "fail", error=str(e))
 
-    async def close(self, test: dict) -> None:
-        close = getattr(self.conn, "close", None)
-        if close is not None:
-            res = close()
-            if hasattr(res, "__await__"):
-                await res
 
-
-class MultiRegisterClient(Client):
+class MultiRegisterClient(ConnClient):
     """Whole-store client for the multi-register workload: ops address
     register i of a small register file — read (i, None)->(i, v) /
     write (i, v) — mapped onto KV keys "r<i>". Unlike RegisterClient the
@@ -79,16 +62,6 @@ class MultiRegisterClient(Client):
     so cross-register ordering violations are visible to the checker.
     Error mapping identical to RegisterClient (reference
     src/jepsen/etcdemo.clj:100-105)."""
-
-    def __init__(self, conn_factory: Callable, conn=None):
-        self.conn_factory = conn_factory
-        self.conn = conn
-
-    async def open(self, test: dict, node: str) -> "MultiRegisterClient":
-        conn = self.conn_factory(test, node)
-        if hasattr(conn, "__await__"):
-            conn = await conn
-        return MultiRegisterClient(self.conn_factory, conn)
 
     async def invoke(self, test: dict, op: Op) -> Op:
         i, v = op.value
@@ -109,13 +82,6 @@ class MultiRegisterClient(Client):
             return completed(op, "fail", error="not-found")
         except ClientError as e:
             return completed(op, "fail", error=str(e))
-
-    async def close(self, test: dict) -> None:
-        close = getattr(self.conn, "close", None)
-        if close is not None:
-            res = close()
-            if hasattr(res, "__await__"):
-                await res
 
 
 class _BoundFakeConn:

@@ -11,10 +11,8 @@ same idea, host-language-native encoding)."""
 from __future__ import annotations
 
 import json
-from typing import Callable
-
 from ..ops.op import Op
-from .base import Client, ClientError, NotFound, Timeout, completed
+from .base import ConnClient, ClientError, NotFound, Timeout, completed
 
 SET_KEY = "a-set"
 
@@ -27,16 +25,8 @@ def _loads(raw: str) -> set:
     return set(json.loads(raw))
 
 
-class SetClient(Client):
-    def __init__(self, conn_factory: Callable, conn=None):
-        self.conn_factory = conn_factory
-        self.conn = conn
+class SetClient(ConnClient):
 
-    async def open(self, test: dict, node: str) -> "SetClient":
-        conn = self.conn_factory(test, node)
-        if hasattr(conn, "__await__"):
-            conn = await conn
-        return SetClient(self.conn_factory, conn)
 
     async def setup(self, test: dict) -> None:
         # Initialize, then read back and retry: setup must succeed even
@@ -69,10 +59,3 @@ class SetClient(Client):
             return completed(op, "fail", error="not-found")
         except ClientError as e:
             return completed(op, "fail", error=str(e))
-
-    async def close(self, test: dict) -> None:
-        close = getattr(self.conn, "close", None)
-        if close is not None:
-            res = close()
-            if hasattr(res, "__await__"):
-                await res

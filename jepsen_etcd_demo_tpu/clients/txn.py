@@ -10,23 +10,14 @@ have written is indeterminate -> :info; a pure-read txn can safely
 
 from __future__ import annotations
 
-from typing import Callable
-
 from ..ops.op import Op
-from .base import Client, ClientError, Timeout, completed
+from .base import ConnClient, ClientError, Timeout, completed
 
 
-class TxnClient(Client):
+class TxnClient(ConnClient):
     """conn_factory(test, node) -> connection exposing async txn(mops)."""
 
-    def __init__(self, conn_factory: Callable, conn=None):
-        self.conn_factory = conn_factory
-        self.conn = conn
-
-    async def open(self, test: dict, node: str) -> "TxnClient":
-        conn = self.conn_factory(test, node)
-        if hasattr(conn, "__await__"):
-            conn = await conn
+    def _check_conn(self, conn) -> None:
         if not hasattr(conn, "txn"):
             # Fail fast at setup, not with an AttributeError mid-run: the
             # etcd v2 API has no transactions, so the append workload only
@@ -35,7 +26,6 @@ class TxnClient(Client):
                 "append workload requires a transactional connection "
                 f"(conn {type(conn).__name__!r} has no txn()); "
                 "use --fake or a store with multi-key transactions")
-        return TxnClient(self.conn_factory, conn)
 
     async def invoke(self, test: dict, op: Op) -> Op:
         if op.f != "txn":
@@ -50,9 +40,3 @@ class TxnClient(Client):
         except ClientError as e:
             return completed(op, "fail", error=str(e))
 
-    async def close(self, test: dict) -> None:
-        close = getattr(self.conn, "close", None)
-        if close is not None:
-            res = close()
-            if hasattr(res, "__await__"):
-                await res

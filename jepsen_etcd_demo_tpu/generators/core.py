@@ -305,9 +305,10 @@ class EachThread(Gen):
     def next_for(self, ctx: GenContext) -> NextResult:
         if ctx.process == NEMESIS:
             return Pending(None)
-        # Default 10 MUST match the runner's (runner/core.py): thread
-        # identity across process reincarnation (p + concurrency) breaks
-        # if the two disagree.
+        # The runner publishes its resolved concurrency into the test map
+        # (runner/core.py); the default here only serves generators driven
+        # outside the runner (unit tests), where processes don't
+        # reincarnate.
         conc = int((ctx.test or {}).get("concurrency", 10))
         thread = int(ctx.process) % conc
         if thread not in self.per_thread:

@@ -142,6 +142,11 @@ async def interpret_generators(test: dict, recorder: HistoryRecorder
                                ) -> list[Op]:
     """Run the generator interpreter loop to exhaustion; returns history."""
     concurrency = int(test.get("concurrency", 10))
+    # Publish the RESOLVED value: thread-identity consumers (generators
+    # mapping reincarnated process p + concurrency back to its worker
+    # thread, e.g. EachThread/ConcurrentGenerator) must never re-apply
+    # their own default.
+    test["concurrency"] = concurrency
     rng = random.Random(test.get("seed", 0))
     state = _RunState(recorder, rng)
     gen = test["generator"]
