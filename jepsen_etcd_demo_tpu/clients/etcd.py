@@ -32,6 +32,18 @@ class EtcdError(ClientError):
         self.code = code
 
 
+class IndeterminateDequeue(Timeout):
+    """A dequeue timed out AFTER its compare-and-delete was sent: the
+    in-flight DELETE may commit at any later point, so the removal is
+    indeterminate forever. Unlike a plain Timeout the CLAIMED value is
+    known, which is exactly what makes the op encodable as a
+    pending-forever dequeue (models/queues.py)."""
+
+    def __init__(self, value: str):
+        super().__init__(f"indeterminate dequeue of {value!r}")
+        self.value = value
+
+
 class EtcdClient:
     """One connection to one node's client port (2379,
     reference support.clj:14-17)."""
@@ -97,6 +109,55 @@ class EtcdClient:
             return False
         self._raise_for(body)
         return True
+
+    # -- queue surface (etcd v2 atomic in-order keys) ---------------------
+    async def enqueue(self, key: str, value: Any) -> None:
+        """Append via etcd's in-order-keys recipe: POST to the queue dir
+        creates a node named by creation index, giving a total order.
+        Timeouts are indeterminate exactly like writes (the node may have
+        been created) — QueueClient maps them to :info."""
+        body = await self._request("POST", self._url(key),
+                                   data={"value": str(value)})
+        self._raise_for(body)
+
+    async def dequeue(self, key: str) -> str:
+        """Claim the queue head: quorum-read the dir sorted by creation
+        order, compare-and-delete the first node (prevIndex); a lost race
+        (another consumer claimed it) retries on the next head.
+
+        Indeterminacy protocol (the part linearizability checking depends
+        on, models/queues.py): once the compare-and-delete has been SENT,
+        a timeout is unconditionally indeterminate — the in-flight DELETE
+        can commit arbitrarily later, so even observing the node still
+        present proves nothing. IndeterminateDequeue carries the claimed
+        value (QueueClient maps it :info, pending forever); timeouts
+        BEFORE any claim attempt stay plain Timeouts (no effect
+        possible)."""
+        for _ in range(64):
+            body = await self._request(
+                "GET", self._url(key),
+                params={"recursive": "true", "sorted": "true",
+                        "quorum": "true"})
+            if body.get("errorCode") == ETCD_KEY_MISSING:
+                raise NotFound(key)
+            self._raise_for(body)
+            nodes = body.get("node", {}).get("nodes") or []
+            if not nodes:
+                raise NotFound(key)
+            head = nodes[0]
+            value, idx = head["value"], head["modifiedIndex"]
+            node_url = f"{self.base_url}/v2/keys{head['key']}"
+            try:
+                del_body = await self._request(
+                    "DELETE", node_url, params={"prevIndex": str(idx)})
+            except Timeout as e:
+                raise IndeterminateDequeue(value) from e
+            if del_body.get("errorCode") in (ETCD_KEY_MISSING,
+                                             ETCD_CAS_FAILED):
+                continue   # lost the race to another consumer
+            self._raise_for(del_body)
+            return value
+        raise Timeout("dequeue retry budget exhausted")
 
     async def swap(self, key: str, fn) -> str:
         """Atomic read-modify-write via prevIndex CAS retries — the client-

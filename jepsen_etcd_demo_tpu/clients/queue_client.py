@@ -7,18 +7,22 @@ depends on (knossos 0.3.7, jepsen.etcdemo.iml:58; models/queues.py).
 
 Error mapping follows the reference client's logic (src/jepsen/etcdemo.clj:
 100-105) adapted to queue semantics:
-  * enqueue timeout -> :info (indeterminate, like a register write)
-  * dequeue timeout -> :fail — REQUIRES a fail-before-effect dequeue on
-    the backend (the fake store guarantees it; an at-least-once real queue
-    would need client-side dedup tokens to justify this mapping), because
-    an indeterminate dequeue is unencodable (models/queues.py)
-  * empty queue     -> :fail :empty (the op definitely had no effect)
+  * enqueue timeout       -> :info (indeterminate, like a register write)
+  * dequeue timeout       -> :fail — sound because it only surfaces when
+    no removal can have been attempted: the fake store is
+    fail-before-effect by construction, and the etcd client times out
+    plainly only BEFORE sending any compare-and-delete
+  * IndeterminateDequeue  -> :info carrying the CLAIMED value (a lost
+    compare-and-delete response after the node vanished) — the one shape
+    of indeterminate dequeue the encoder accepts (models/queues.py)
+  * empty queue           -> :fail :empty (definitely no effect)
 """
 
 from __future__ import annotations
 
 from ..ops.op import Op
 from .base import ConnClient, ClientError, NotFound, Timeout, completed
+from .etcd import IndeterminateDequeue
 
 
 class QueueClient(ConnClient):
@@ -34,6 +38,9 @@ class QueueClient(ConnClient):
                 got = await self.conn.dequeue(str(k))
                 return completed(op, "ok", value=(k, got))
             raise ValueError(f"unknown op f={op.f!r}")
+        except IndeterminateDequeue as e:
+            return completed(op, "info", value=(k, e.value),
+                             error="timeout")
         except Timeout:
             if op.f == "dequeue":
                 return completed(op, "fail", error="timeout")

@@ -67,12 +67,14 @@ class Model(abc.ABC):
     def encode_invocation(self, f_name: str, invoke_value, ok_value,
                           status: str) -> Tuple[int, int, int, int]:
         """Op-language codec: map one paired invocation to the (f, a1, a2,
-        rv) event-row fields the step functions consume. Default: the
-        register language (read/write/cas — the reference's op set,
-        src/jepsen/etcdemo.clj:67-69). Models with a different op language
-        override this; by convention code F_READ must be reserved for pure
-        observations (the encoder drops indeterminate F_READ ops as
-        constraint-free, ops/encode.py)."""
+        rv) event-row fields the step functions consume. `ok_value` is the
+        completion's value for OK *and* INFO completions (an indeterminate
+        op may still carry the value it tried to take), None otherwise.
+        Default: the register language (read/write/cas — the reference's
+        op set, src/jepsen/etcdemo.clj:67-69). Models with a different op
+        language override this; by convention code F_READ must be reserved
+        for pure observations (the encoder drops indeterminate F_READ ops
+        as constraint-free, ops/encode.py)."""
         from ..ops.encode import register_fields
 
         return register_fields(f_name, invoke_value, ok_value, status)

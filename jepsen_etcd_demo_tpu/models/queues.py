@@ -24,10 +24,12 @@ collections:
 
 Indeterminate (:info) enqueues stay pending forever, exactly like
 indeterminate register writes (reference :info mapping,
-src/jepsen/etcdemo.clj:100-102). Indeterminate DEQUEUES are rejected at
-encode time: a dequeue that may or may not have removed an unknown element
-cannot be encoded as a pending op with fixed fields, and silently dropping
-it would make the checker accept histories it shouldn't.
+src/jepsen/etcdemo.clj:100-102). Indeterminate DEQUEUES are encodable only
+when the completion records the CLAIMED element (a compare-and-delete whose
+response was lost — clients/etcd.py): the op becomes pending-forever with
+that value. Without a claimed value they are rejected at encode time —
+fixed fields cannot express "removed an unknown element", and silently
+dropping it would make the checker accept histories it shouldn't.
 """
 
 from __future__ import annotations
@@ -68,12 +70,16 @@ class UnorderedQueue(Model):
         if f_name == "enqueue":
             return F_ENQ, _element_bit(invoke_value), 0, NIL
         if f_name == "dequeue":
-            if status == INFO:
+            if status == INFO and ok_value is None:
                 raise EncodeError(
-                    "indeterminate dequeue (no observed value) cannot be "
-                    "encoded soundly; fail it or record its value")
+                    "indeterminate dequeue with no claimed value cannot be "
+                    "encoded soundly; fail it or record the candidate "
+                    "(clients/etcd.py IndeterminateDequeue)")
             if ok_value is None:
                 return F_DEQ, 0, 0, NIL  # fail: dropped by the encoder
+            # ok, or info with a known claimed element: the op may (info:
+            # may never) have removed exactly this element — a pending
+            # F_DEQ with rv set is the exact WGL encoding of that.
             return F_DEQ, 0, 0, _element_bit(ok_value)
         raise EncodeError(f"unsupported unordered-queue op f={f_name!r}")
 
@@ -148,12 +154,14 @@ class FIFOQueue(Model):
         if f_name == "enqueue":
             return F_ENQ, self._check_value(invoke_value), 0, NIL
         if f_name == "dequeue":
-            if status == INFO:
+            if status == INFO and ok_value is None:
                 raise EncodeError(
-                    "indeterminate dequeue (no observed value) cannot be "
-                    "encoded soundly; fail it or record its value")
+                    "indeterminate dequeue with no claimed value cannot be "
+                    "encoded soundly; fail it or record the candidate "
+                    "(clients/etcd.py IndeterminateDequeue)")
             if ok_value is None:
                 return F_DEQ, 0, 0, NIL  # fail: dropped by the encoder
+            # ok, or info with a known claimed element (see UnorderedQueue).
             return F_DEQ, 0, 0, self._check_value(ok_value)
         raise EncodeError(f"unsupported fifo-queue op f={f_name!r}")
 

@@ -187,9 +187,15 @@ def pair_history(history: Sequence[Op], model=None) -> list[Invocation]:
 def _make_invocation(inv: Op, comp: Optional[Op], inv_idx: int,
                      comp_idx: int, model=None) -> Invocation:
     status = comp.type if comp is not None else INFO
-    ok_value = comp.value if comp is not None and comp.type == OK else None
+    # The completion value reaches the codec for OK *and* INFO: an
+    # indeterminate op may still carry the value it tried to take (e.g. a
+    # dequeue whose compare-and-delete response was lost after claiming a
+    # known element — clients/etcd.py IndeterminateDequeue), which is what
+    # makes it encodable as a pending op.
+    comp_value = (comp.value if comp is not None
+                  and comp.type in (OK, INFO) else None)
     codec = register_fields if model is None else model.encode_invocation
-    f, a1, a2, rv = codec(inv.f, inv.value, ok_value, status)
+    f, a1, a2, rv = codec(inv.f, inv.value, comp_value, status)
     return Invocation(f=f, a1=a1, a2=a2, rv=rv, status=status,
                       invoke_index=inv_idx, complete_index=comp_idx,
                       process=inv.process)

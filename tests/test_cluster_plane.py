@@ -49,6 +49,7 @@ class StubEtcd:
         self.requests: list[dict] = []               # wire-protocol log
         self.delay_s = 0.0
         self.interfere_once = False                  # mutate before next PUT
+        self.drop_delete_response_once = False       # apply, lose the ack
         self.server: ThreadingHTTPServer | None = None
 
     def put_internal(self, key: str, value: str) -> None:
@@ -75,6 +76,7 @@ class StubEtcd:
                 stub.requests.append({
                     "method": self.command,
                     "key": u.path.rsplit("/", 1)[-1],
+                    "path": u.path[len("/v2/keys"):],
                     "params": {k: v[0] for k, v in
                                parse_qs(u.query).items()},
                     "form": {k: v[0] for k, v in form.items()},
@@ -86,14 +88,59 @@ class StubEtcd:
                     import time
                     time.sleep(stub.delay_s)
                 req = self._record({})
-                key = req["key"]
-                if key not in stub.data:
+                path = req["path"].lstrip("/")
+                children = sorted(
+                    (idx, k, v) for k, (v, idx) in stub.data.items()
+                    if k.startswith(path + "/"))
+                if path not in stub.data and not children:
                     self._reply({"errorCode": 100,
                                  "message": "Key not found"}, 404)
                     return
-                v, idx = stub.data[key]
+                if children:   # dir listing (sorted=creation order)
+                    self._reply({"action": "get", "node": {
+                        "key": f"/{path}", "dir": True,
+                        "nodes": [{"key": f"/{k}", "value": v,
+                                   "modifiedIndex": idx}
+                                  for idx, k, v in children]}})
+                    return
+                v, idx = stub.data[path]
                 self._reply({"action": "get",
-                             "node": {"key": f"/{key}", "value": v,
+                             "node": {"key": f"/{path}", "value": v,
+                                      "modifiedIndex": idx}})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                form = parse_qs(self.rfile.read(length).decode())
+                req = self._record(form)
+                path = req["path"].lstrip("/")
+                stub.index += 1
+                node = f"{path}/{stub.index:020d}"
+                stub.data[node] = (req["form"].get("value", ""), stub.index)
+                self._reply({"action": "create",
+                             "node": {"key": f"/{node}",
+                                      "value": stub.data[node][0],
+                                      "modifiedIndex": stub.index}}, 201)
+
+            def do_DELETE(self):
+                req = self._record({})
+                path, params = req["path"].lstrip("/"), req["params"]
+                if path not in stub.data:
+                    self._reply({"errorCode": 100,
+                                 "message": "Key not found"}, 404)
+                    return
+                v, idx = stub.data[path]
+                if ("prevIndex" in params
+                        and int(params["prevIndex"]) != idx):
+                    self._reply({"errorCode": 101,
+                                 "message": "Compare failed"}, 412)
+                    return
+                del stub.data[path]
+                if stub.drop_delete_response_once:
+                    stub.drop_delete_response_once = False
+                    self.connection.close()   # applied, but ack lost
+                    return
+                self._reply({"action": "delete",
+                             "node": {"key": f"/{path}", "value": v,
                                       "modifiedIndex": idx}})
 
             def do_PUT(self):
@@ -487,3 +534,52 @@ def test_pick_nemesis_registry():
     assert isinstance(pick_nemesis({"nemesis": "clock"}), ClockSkewNemesis)
     with pytest.raises(ValueError, match="unknown"):
         pick_nemesis({"nemesis": "sharknado"})
+
+
+class TestEtcdQueue:
+    """The etcd v2 atomic in-order-keys queue recipe (EtcdClient
+    enqueue/dequeue) against the stub, including the indeterminacy
+    protocol the linearizability encoding depends on."""
+
+    def test_enqueue_dequeue_fifo_order(self, stub):
+        async def t():
+            srv, client = stub, EtcdClient(stub.url)
+            await client.enqueue("q", 7)
+            await client.enqueue("q", 8)
+            assert await client.dequeue("q") == "7"
+            assert await client.dequeue("q") == "8"
+            posts = [r for r in srv.requests if r["method"] == "POST"]
+            assert [p["form"]["value"] for p in posts] == ["7", "8"]
+            deletes = [r for r in srv.requests if r["method"] == "DELETE"]
+            assert all("prevIndex" in d["params"] for d in deletes)
+            await client.close()
+        go(t())
+
+    def test_dequeue_empty_raises_notfound(self, stub):
+        async def t():
+            srv, client = stub, EtcdClient(stub.url)
+            with pytest.raises(NotFound):
+                await client.dequeue("q")
+            await client.enqueue("q", 1)
+            assert await client.dequeue("q") == "1"
+            with pytest.raises(NotFound):
+                await client.dequeue("q")
+            await client.close()
+        go(t())
+
+    def test_lost_delete_ack_is_indeterminate_with_claimed_value(self, stub):
+        """DELETE applied but the response lost: once the claim was SENT
+        the removal is indeterminate forever, so the client must surface
+        IndeterminateDequeue with the claimed value (the one encodable
+        indeterminate-dequeue shape, models/queues.py)."""
+        from jepsen_etcd_demo_tpu.clients.etcd import IndeterminateDequeue
+
+        async def t():
+            srv, client = stub, EtcdClient(stub.url)
+            await client.enqueue("q", 5)
+            srv.drop_delete_response_once = True
+            with pytest.raises(IndeterminateDequeue) as ei:
+                await client.dequeue("q")
+            assert ei.value.value == "5"
+            await client.close()
+        go(t())

@@ -296,3 +296,32 @@ def test_witness_speaks_model_language(tmp_path):
     assert (tmp_path / "linear.json").exists()
     svg = (tmp_path / "linear.svg").read_text()
     assert "enqueue(" in svg or "dequeue" in svg
+
+
+def test_indeterminate_dequeue_with_claimed_value_is_encodable():
+    """An indeterminate dequeue CARRYING its claimed element (lost
+    compare-and-delete ack, clients/etcd.py IndeterminateDequeue) encodes
+    as a pending-forever op: FIFO order may require it to have fired, or
+    it may never fire — both must check exactly."""
+    # enq 1, enq 2; deq info(claimed 1); deq ok(2): FIFO demands 1 was
+    # removed first, which the open info dequeue can explain.
+    h = ops((INVOKE, "enqueue", 1, 0), (OK, "enqueue", 1, 0),
+            (INVOKE, "enqueue", 2, 0), (OK, "enqueue", 2, 0),
+            (INVOKE, "dequeue", None, 1), (INFO, "dequeue", 1, 1),
+            (INVOKE, "dequeue", None, 2), (OK, "dequeue", 2, 2))
+    assert Linearizable(model=FIFOQueue()).check({}, h)["valid"] is True
+    # Without the info dequeue the same delivery is a FIFO violation.
+    h2 = ops((INVOKE, "enqueue", 1, 0), (OK, "enqueue", 1, 0),
+             (INVOKE, "enqueue", 2, 0), (OK, "enqueue", 2, 0),
+             (INVOKE, "dequeue", None, 2), (OK, "dequeue", 2, 2))
+    assert Linearizable(model=FIFOQueue()).check({}, h2)["valid"] is False
+    # The info dequeue may also NEVER fire: a later ok dequeue of the
+    # same element is still explainable.
+    h3 = ops((INVOKE, "enqueue", 1, 0), (OK, "enqueue", 1, 0),
+             (INVOKE, "dequeue", None, 1), (INFO, "dequeue", 1, 1),
+             (INVOKE, "dequeue", None, 2), (OK, "dequeue", 1, 2))
+    assert Linearizable(model=FIFOQueue()).check({}, h3)["valid"] is True
+    # But a VALUELESS indeterminate dequeue stays unencodable.
+    h4 = ops((INVOKE, "dequeue", None, 1), (INFO, "dequeue", None, 1))
+    with pytest.raises(EncodeError):
+        encode_history(FIFOQueue().prepare_history(h4), FIFOQueue())
