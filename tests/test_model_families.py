@@ -325,3 +325,29 @@ def test_indeterminate_dequeue_with_claimed_value_is_encodable():
     h4 = ops((INVOKE, "dequeue", None, 1), (INFO, "dequeue", None, 1))
     with pytest.raises(EncodeError):
         encode_history(FIFOQueue().prepare_history(h4), FIFOQueue())
+
+
+@pytest.mark.parametrize("fifo", [True, False])
+def test_fuzz_lost_dequeue_acks_stay_valid(fifo):
+    """Flipping any ok dequeue to :info-with-claimed-value models a lost
+    compare-and-delete ack (clients/etcd.py). The op actually fired, so a
+    valid history MUST stay valid — and every checker must agree."""
+    family = "fifo-queue" if fifo else "unordered-queue"
+    model, gen = FAMILIES[family]
+    checker = Linearizable(model=model, backend="jax")
+    flipped = 0
+    for seed in range(20):
+        rng = random.Random(0x1DE0 + seed)
+        h = gen(rng)
+        deqs = [i for i, op in enumerate(h)
+                if op.type == OK and op.f == "dequeue"]
+        if not deqs:
+            continue
+        h[rng.choice(deqs)].type = INFO
+        flipped += 1
+        enc = encode_history(model.prepare_history(h), model, k_slots=16)
+        assert check_events_oracle(enc, model).valid is True, (family, seed)
+        bf = brute_force_check(enc, model, max_ops=10)
+        assert bf in (None, True), (family, seed)
+        assert checker.check({}, h)["valid"] is True, (family, seed)
+    assert flipped >= 10
