@@ -36,6 +36,18 @@ class Timeout(Exception):
     (SocketTimeoutException edge, src/jepsen/etcdemo.clj:100-102)."""
 
 
+class IndeterminateDequeue(Timeout):
+    """A dequeue timed out AFTER its claim was sent/applied: the removal
+    is indeterminate forever. Unlike a plain Timeout the CLAIMED value is
+    known, which is exactly what makes the op encodable as a
+    pending-forever dequeue (models/queues.py). Raised by both queue
+    backends (clients/etcd.py compare-and-delete, clients/fake_kv.py)."""
+
+    def __init__(self, value):
+        super().__init__(f"indeterminate dequeue of {value!r}")
+        self.value = value
+
+
 class Client(abc.ABC):
     """Per-process client. The runner calls open() to get a fresh connected
     instance per logical process, setup() once per run for data-plane init,

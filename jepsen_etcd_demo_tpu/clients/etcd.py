@@ -20,7 +20,7 @@ from typing import Any, Optional
 
 import httpx
 
-from .base import ClientError, NotFound, Timeout
+from .base import ClientError, IndeterminateDequeue, NotFound, Timeout
 
 ETCD_KEY_MISSING = 100   # etcd v2 errorCode for absent key (reference :104)
 ETCD_CAS_FAILED = 101    # compare failed
@@ -30,18 +30,6 @@ class EtcdError(ClientError):
     def __init__(self, code: int, message: str):
         super().__init__(f"etcd error {code}: {message}")
         self.code = code
-
-
-class IndeterminateDequeue(Timeout):
-    """A dequeue timed out AFTER its compare-and-delete was sent: the
-    in-flight DELETE may commit at any later point, so the removal is
-    indeterminate forever. Unlike a plain Timeout the CLAIMED value is
-    known, which is exactly what makes the op encodable as a
-    pending-forever dequeue (models/queues.py)."""
-
-    def __init__(self, value: str):
-        super().__init__(f"indeterminate dequeue of {value!r}")
-        self.value = value
 
 
 class EtcdClient:

@@ -29,7 +29,7 @@ import asyncio
 import random
 from typing import Any, Optional
 
-from .base import NotFound, Timeout
+from .base import IndeterminateDequeue, NotFound, Timeout
 
 
 class FakeKVStore:
@@ -187,25 +187,36 @@ class FakeKVStore:
             await asyncio.sleep(self.op_delay_s * self.rng.random())
 
     async def dequeue(self, node: str, key: str) -> Any:
-        """Pop the queue head. DELIBERATELY fail-before-effect under
-        partition (unlike reset/cas): an indeterminate dequeue removes an
-        unknown element, which no sound history encoding can express
-        (models/queues.py) — so this fake guarantees a timed-out dequeue
-        had no effect and the client may map it to :fail. Injectable bugs:
+        """Pop the queue head. Under partition the same indeterminacy
+        protocol as the real etcd client (clients/etcd.py): with
+        partial_apply_prob the pop HAPPENS and the ack is lost —
+        IndeterminateDequeue carrying the claimed element (the one
+        encodable indeterminate-dequeue shape, models/queues.py) — else a
+        plain Timeout before any effect. Injectable bugs:
           reorder_prob            — pops a random position, not the head
                                     (FIFO violation)
           duplicate_delivery_prob — returns the head without removing it
                                     (element delivered twice)"""
-        await self._enter(node)
+        maybe_timeout = node in self.isolated
+        if maybe_timeout and self.rng.random() >= self.partial_apply_prob:
+            raise Timeout(f"node {node} partitioned")
         async with self.lock:
             q = self.queues.get(key)
             if not q:
+                if maybe_timeout:
+                    raise Timeout(f"node {node} partitioned")
                 raise NotFound(key)
             i = (self.rng.randrange(len(q))
                  if self.rng.random() < self.reorder_prob else 0)
             if self.rng.random() < self.duplicate_delivery_prob:
-                return q[i]
-            return q.pop(i)
+                got = q[i]
+            else:
+                got = q.pop(i)
+        if maybe_timeout:
+            raise IndeterminateDequeue(got)
+        if self.op_delay_s:
+            await asyncio.sleep(self.op_delay_s * self.rng.random())
+        return got
 
     async def swap(self, node: str, key: str, fn) -> Any:
         """Atomic read-modify-write retry loop — verschlimmbesserung's swap!

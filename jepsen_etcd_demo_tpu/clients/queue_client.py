@@ -8,10 +8,9 @@ depends on (knossos 0.3.7, jepsen.etcdemo.iml:58; models/queues.py).
 Error mapping follows the reference client's logic (src/jepsen/etcdemo.clj:
 100-105) adapted to queue semantics:
   * enqueue timeout       -> :info (indeterminate, like a register write)
-  * dequeue timeout       -> :fail — sound because it only surfaces when
-    no removal can have been attempted: the fake store is
-    fail-before-effect by construction, and the etcd client times out
-    plainly only BEFORE sending any compare-and-delete
+  * dequeue timeout       -> :fail — sound because both backends raise it
+    only when no removal can have been attempted (before any claim is
+    sent/applied)
   * IndeterminateDequeue  -> :info carrying the CLAIMED value (a lost
     compare-and-delete response after the node vanished) — the one shape
     of indeterminate dequeue the encoder accepts (models/queues.py)
@@ -21,8 +20,8 @@ Error mapping follows the reference client's logic (src/jepsen/etcdemo.clj:
 from __future__ import annotations
 
 from ..ops.op import Op
-from .base import ConnClient, ClientError, NotFound, Timeout, completed
-from .etcd import IndeterminateDequeue
+from .base import (ConnClient, ClientError, IndeterminateDequeue,
+                   NotFound, Timeout, completed)
 
 
 class QueueClient(ConnClient):

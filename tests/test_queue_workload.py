@@ -43,7 +43,9 @@ def test_queue_run_healthy_is_linearizable(tmp_path):
 
 def test_queue_run_with_partitions_is_linearizable(tmp_path):
     """The fake queue is FIFO-correct; partition timeouts are encodable
-    (indeterminate enqueues stay pending; dequeues fail-before-effect)."""
+    (indeterminate enqueues stay pending; dequeues follow the etcd
+    client's indeterminacy protocol — applied-with-lost-ack surfaces as
+    :info carrying the claimed element, else a no-effect Timeout)."""
     test = fake_test(queue_opts(tmp_path, seed=12))
     result = run(test)
     assert result["valid"] is True
