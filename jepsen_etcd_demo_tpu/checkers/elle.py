@@ -16,8 +16,10 @@ prefixes, so any read totally orders every append it observed —
   * consecutive observed values e_i, e_i+1 give a ww edge
     writer(e_i) -> writer(e_i+1)
   * a read ending at e gives a wr edge writer(e) -> reader
-  * a read ending at e, with e' next in the observed order, gives an rw
-    (anti-dependency) edge reader -> writer(e')
+  * a read observing list L gives an rw (anti-dependency) edge
+    reader -> writer(v) for EVERY committed append of a v absent from L
+    (reads return the whole list, so an append serialized before the read
+    must appear in it — this covers acked appends no read ever observed)
 
 Anomalies (elle's taxonomy):
   * internal               — a txn's own read contradicts its own earlier
@@ -228,18 +230,37 @@ class ElleChecker(Checker):
                 wa, wb = append_of.get((k, a)), append_of.get((k, b))
                 if wa is not None and wb is not None and wa != wb:
                     ww[wa, wb] = True
+        appends_by_key: dict[Any, list] = defaultdict(list)
+        for (k, v), i in append_of.items():
+            appends_by_key[k].append((v, i))
+        # rw (anti-dependency): a read returns the WHOLE list, so a
+        # committed append serialized before it must appear in it.
+        # Contrapositive: every committed append of a value ABSENT from
+        # the observed list is serialized after the read — including
+        # acked appends no read ever observed (the case the old
+        # next-observed-value rule missed, ADVICE r2: T1 appends x=1 :ok,
+        # T2 later reads x=[] — rw T2->T1 plus rt T1->T2 is the
+        # G-single-realtime cycle). The absent-writer set depends only on
+        # (key, observed tuple): memoized so many readers of the same
+        # prefix share one scan, and applied as one vectorized row
+        # assignment (self-edges cleared — a txn is not its own
+        # anti-dependency).
+        absent_writers: dict[tuple, np.ndarray] = {}
         for k, obs in reads.items():
-            longest = order[k]
             for reader, vs in obs:
                 if vs:
                     wa = append_of.get((k, vs[-1]))
                     if wa is not None and wa != reader:
                         wr[wa, reader] = True
-                nxt_idx = len(vs)
-                if nxt_idx < len(longest):
-                    wb = append_of.get((k, longest[nxt_idx]))
-                    if wb is not None and wb != reader:
-                        rw[reader, wb] = True
+                tgt = absent_writers.get((k, vs))
+                if tgt is None:
+                    seen = set(vs)
+                    tgt = np.array([wb for v, wb in appends_by_key.get(k, ())
+                                    if v not in seen], dtype=np.intp)
+                    absent_writers[(k, vs)] = tgt
+                if tgt.size:
+                    rw[reader, tgt] = True
+                    rw[reader, reader] = False
 
         rt = None
         if self.realtime and n:

@@ -48,6 +48,15 @@ class _Carry2(NamedTuple):
 PACKED_INVALID = np.uint32(0xFFFFFFFF)
 
 
+class SearchBudgetExceeded(MemoryError):
+    """Wall-clock budget expiry during the resumable search.
+
+    Subclasses MemoryError so existing exact-or-unknown fallbacks keep
+    working, while callers that care can tell a timeout (retryable with a
+    bigger budget) from genuine capacity infeasibility (retryable only on
+    bigger hardware)."""
+
+
 def packable(model: Model, cfg: WGLConfig) -> bool:
     """Can (state, mask) live in one uint32 sort key? Needs a bounded model
     state space (cfg.state_bits, derived from the history's values) and a
@@ -316,12 +325,13 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
     resuming from the last good chunk boundary, until the frontier fits or
     f_cap_max is exceeded (at which point the search genuinely does not fit
     device memory and raises MemoryError). `time_budget_s` bounds WALL
-    time the same way — combinatorial frontiers (dozens of forever-pending
-    ops interleaving factorially, e.g. a mutex history full of
-    indeterminate acquires AND releases) otherwise grind through ever-
-    bigger sorts for hours; on expiry the same MemoryError is raised so
-    callers take their exact-or-unknown fallback, mirroring how knossos
-    DNFs on these histories."""
+    time — combinatorial frontiers (dozens of forever-pending ops
+    interleaving factorially, e.g. a mutex history full of indeterminate
+    acquires AND releases) otherwise grind through ever-bigger sorts for
+    hours; on expiry SearchBudgetExceeded (a MemoryError subclass) is
+    raised so callers take the same exact-or-unknown fallback while still
+    being able to tell timeout from capacity infeasibility, mirroring how
+    knossos DNFs on these histories."""
     import time as _time
 
     if model is None:
@@ -340,7 +350,7 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
         while True:
             if (time_budget_s is not None
                     and _time.monotonic() - t0 > time_budget_s):
-                raise MemoryError(
+                raise SearchBudgetExceeded(
                     f"WGL search exceeded its {time_budget_s:.0f}s time "
                     f"budget at return step {c0} (f_cap={f_cap}); the "
                     f"frontier is growing combinatorially")

@@ -415,9 +415,15 @@ def check_encoded_general(enc: EncodedHistory, model: Model,
     contract (and knossos' behavior at its own limits) is an
     indeterminate result, and merge_valid propagates it so the run exits
     nonzero."""
+    import time as _time
+
     from . import wgl2, wgl3
     from .encode import encode_return_steps, reslot_events
 
+    t0 = _time.monotonic()           # ONE clock for the whole ladder: the
+    #                                  dense rung gets the REMAINING budget,
+    #                                  so a check never spends ~2x the
+    #                                  configured bound (ADVICE r2)
     tight = wgl2.sort_k_slots(enc)   # f_cap_max sizing must match the
     #                                  width the sort kernel really uses
     # A CHUNKED dense lattice under the relaxed 2^26-cell budget, when one
@@ -445,11 +451,26 @@ def check_encoded_general(enc: EncodedHistory, model: Model,
             f_cap_max = min(f_cap_max, max(f_cap, cells // (tight + 1)))
 
     def dense_chunked(enc):
+        # Remaining budget only (ADVICE r2: the fallback used to restart
+        # the clock, spending up to 2x the configured bound). A launched
+        # chunk cannot be preempted, so overshoot is bounded by ONE chunk;
+        # with nothing left, don't start the rung at all.
+        remaining = (None if time_budget_s is None else
+                     time_budget_s - (_time.monotonic() - t0))
+        if remaining is not None and remaining <= 0.5:
+            return {"valid": "unknown", "survived": False, "overflow": True,
+                    "dead_step": -1, "max_frontier": -1,
+                    "configs_explored": -1, "op_count": enc.n_ops,
+                    "f_cap": cfg_dense.n_states * cfg_dense.n_masks,
+                    "escalations": 0, "kernel": "exhausted",
+                    "error": f"sort ladder consumed the whole "
+                             f"{time_budget_s:.0f}s budget; dense-chunked "
+                             f"rung not started"}
         if enc.k_slots != tight:
             enc = reslot_events(enc, tight)
         out = wgl3.check_steps3_long(encode_return_steps(enc), model,
                                      cfg_dense,
-                                     time_budget_s=time_budget_s)
+                                     time_budget_s=remaining)
         out["op_count"] = enc.n_ops
         out["f_cap"] = cfg_dense.n_states * cfg_dense.n_masks
         out["escalations"] = 0

@@ -374,6 +374,20 @@ def test_realtime_stale_empty_read_is_g_single_realtime():
     assert res["edge_counts"]["rt"] >= 1 and res["realtime"] is True
 
 
+def test_realtime_unobserved_append_is_still_caught():
+    """ADVICE r2 (medium): an acked append NO read ever observes must still
+    yield the rw anti-dependency — the read returns the whole list, so the
+    absent value's append is serialized after it. Without the anchoring
+    third read of the previous test, the old next-observed-value rule
+    inferred no rw edge and the violation escaped."""
+    h = txn_history(("ok", [("append", "x", 1)]),
+                    ("ok", [("r", "x", ())]))
+    assert ElleChecker().check({}, h)["valid"] is True
+    res = RT_CHECK.check({}, h)
+    assert res["valid"] is False
+    assert res["anomaly_types"] == ["G-single-realtime"]
+
+
 def test_realtime_future_read_is_g1c_realtime():
     """T1 completes a read observing an append that is only invoked LATER:
     wr says writer precedes reader, realtime says reader precedes writer."""
