@@ -51,6 +51,7 @@ import numpy as np
 
 from ..models.base import Model
 from .encode import EncodedHistory, ReturnSteps, encode_return_steps
+from .limits import limits
 
 
 @dataclass(frozen=True)
@@ -69,22 +70,8 @@ class DenseConfig:
         return self.max_rounds or self.k_slots
 
 
-# Largest table (S * 2^K cells) the dense kernel will build per history.
-# Cells are BITS (32 packed per uint32 word). Two forces set the cap:
-#  * algorithmic crossover — per-step cost is O(K * S * 2^K) regardless of
-#    how few configs are LIVE, while the sort kernel (wgl2) pays
-#    O(f_cap * K); past K ~ 17 the live frontier is invariably tiny
-#    relative to the lattice, so dense sweeps waste >100x the work;
-#  * the axon TPU worker kills programs running longer than ~1-2 min, and
-#    a K=20 dense chunk measured ~35 s per 4k steps — wide-K histories
-#    must not reach this kernel at all.
-# 2^20 cells admits typical jepsen geometries (K<=17 at S=8 — concurrency
-# 10 gives K=12, a 4 KiB table) and routes wider ones to wgl2.
-DENSE_CELL_BUDGET = 1 << 20
-
-
 def dense_config(model: Model, k_slots: int, max_value: int,
-                 budget: int = DENSE_CELL_BUDGET) -> DenseConfig | None:
+                 budget: int | None = None) -> DenseConfig | None:
     """DenseConfig for this (model, history) — or None when infeasible.
 
     Feasible iff the model's states are boundable from the history's values
@@ -92,7 +79,17 @@ def dense_config(model: Model, k_slots: int, max_value: int,
     kernel unrolls its state OR-reduce), K >= 5 (the mask axis is packed 32
     configs per uint32 word), and the table fits the cell budget. S is
     rounded up (multiple of 4) so nearby value ranges share one jit cache
-    entry, mirroring wgl2.make_config."""
+    entry, mirroring wgl2.make_config.
+
+    The default budget (limits().dense_cell_budget) caps cells because
+    per-step sweep cost is O(K * S * 2^K) regardless of how few configs
+    are LIVE, while the sort kernel (wgl2) pays O(f_cap * K) — past
+    K ~ 17 the live frontier is invariably tiny relative to the lattice,
+    so dense sweeps waste >100x the work; 2^20 cells admits typical
+    jepsen geometries (concurrency 10 gives K=12, a 4 KiB table) and
+    routes wider ones to wgl2 (or the sharded lattice, parallel/)."""
+    if budget is None:
+        budget = limits().dense_cell_budget
     if not model.packable_states or k_slots < 5:
         return None
     s = model.state_bound(max_value) + 1
@@ -271,13 +268,6 @@ def make_checker3(model: Model, cfg: DenseConfig):
     return jax.jit(_check_one_fn(model, cfg))
 
 
-# Step-axis limit for ONE scan program. The axon TPU worker reliably
-# crashes compiling/running a ~100k-step scan (40k is fine); beyond this,
-# the search runs as a host-driven loop of fixed-size scan chunks with
-# the (tiny) carry staying on device between calls.
-LONG_SCAN_CHUNK = 16384
-
-
 def _chunk_fn(model: Model, cfg: DenseConfig):
     """jitted (carry, tabs[C,K,4], act[C,K], tgts[C], idx0) ->
     (carry', configs-partial f32 scalar) — the partial sums accumulate
@@ -310,12 +300,13 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
 
     t0 = _time.monotonic()
     if chunk is None:
-        # Floor 128: at the 2^26-cell budget ceiling a step costs ~70 ms,
-        # so even the floor chunk stays ~10 s — safely under the axon
-        # worker's program-kill threshold.
+        # Scale chunk size inversely with table width (sweep cost per step
+        # is proportional to cells). Floor 128: at the chunked-budget cell
+        # ceiling a step costs ~70 ms, so even the floor chunk stays ~10 s
+        # — safely under the worker's program-kill threshold.
         cells = cfg.n_states * cfg.n_masks
-        chunk = min(LONG_SCAN_CHUNK,
-                    max(128, LONG_SCAN_CHUNK * (1 << 15) // max(cells, 1)))
+        base = limits().long_scan_chunk
+        chunk = min(base, max(128, base * (1 << 15) // max(cells, 1)))
     key = ("chunk3", model.cache_key(), cfg, chunk)
     if key not in _CACHE:
         _CACHE[key] = _chunk_fn(model, cfg)
@@ -360,12 +351,6 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
     }
     out["valid"] = verdict(out)
     return out
-
-
-# One-scan-program step limit for the NON-chunked XLA path (a ~100k-step
-# scan crashes the axon worker; ~32k is tested-good). Batches padded
-# beyond it route per-history through check_steps3_long.
-LONG_SCAN_MAX = 32768
 
 
 def make_batch_checker3(model: Model, cfg: DenseConfig):

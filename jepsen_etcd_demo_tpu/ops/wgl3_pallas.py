@@ -62,17 +62,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..models.base import Model
 from .encode import EncodedHistory
+from .limits import limits
 from .wgl3 import DenseConfig, _LO_MASK, batch_arrays3, dense_config
-
-# The kernel unrolls the slot sweep K times and carries a [S, 2^(K-5)]
-# table as registers/VMEM; cap K so the table stays a handful of tiles
-# (K=16 -> u32[8, 2048] = 64 KiB) and compile time stays sane.
-MAX_K_PALLAS = 16
-
-# Return steps per colmask block (grid chunking of the step axis): 512
-# steps x (8,128) u32 = 2 MiB per block, double-buffered well inside the
-# 16 MiB VMEM budget, while histories <= 512 steps stay single-chunk.
-STEP_CHUNK = 512
 
 
 def prepare_pallas_batch(model: Model, cfg: DenseConfig, slot_tabs, slot_active,
@@ -258,8 +249,9 @@ def make_batch_checker_pallas(model: Model, cfg: DenseConfig,
                               interpret: bool = False):
     """check(slot_tabs[B,R,K,4], slot_active[B,R,K], targets[B,R]) ->
     DEVICE i32[B, 5] packed results (wgl3.PACKED_FIELDS / unpack_np)."""
-    if cfg.k_slots > MAX_K_PALLAS:
-        raise ValueError(f"pallas kernel supports k_slots <= {MAX_K_PALLAS}, "
+    max_k = limits().max_k_pallas
+    if cfg.k_slots > max_k:
+        raise ValueError(f"pallas kernel supports k_slots <= {max_k}, "
                          f"got {cfg.k_slots}")
     Sp = max(8, (cfg.n_states + 7) // 8 * 8)
     W = 1 << (cfg.k_slots - 5)
@@ -280,7 +272,7 @@ def make_batch_checker_pallas(model: Model, cfg: DenseConfig,
         # iteration (a whole 10k-step history as a single block would need
         # 32 MiB of VMEM against the 16 MiB limit); search state carries
         # across chunks in scratch.
-        RC = min(R, STEP_CHUNK)
+        RC = min(R, limits().pallas_step_chunk)
         NC = (R + RC - 1) // RC
         R_pad = NC * RC
         grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -336,24 +328,21 @@ def cached_batch_checker_pallas(model: Model, cfg: DenseConfig,
     return _CACHE[key]
 
 
-# Bounds on the scalar-prefetched targets table [B, R_pad] (whole thing
-# lands in SMEM, 4 bytes/entry). Empirically on the axon worker:
-# [1024, 128] (the bench corpus, 512 KiB) and [1, 16384] run routinely;
-# [1, ~98k] kills the worker. The two caps keep launches inside the
-# tested-good envelope on BOTH axes — per-history steps and total
-# prefetch entries — with ~2x margin; anything bigger routes to the XLA
-# kernel, whose scan streams targets from HBM.
-MAX_R_PALLAS = 16384
-MAX_PREFETCH_PALLAS = 1 << 18
-
-
 def pallas_feasible(cfg: DenseConfig | None,
                     n_steps: int | None = None,
                     batch: int | None = None) -> bool:
-    return (cfg is not None and cfg.k_slots <= MAX_K_PALLAS
-            and (n_steps is None or n_steps <= MAX_R_PALLAS)
+    """Does this launch fit the pallas kernel's envelope? Bounds (all in
+    limits()): k_slots <= max_k_pallas (table stays a handful of VMEM
+    tiles), per-history steps <= max_r_pallas and batch * steps <=
+    max_prefetch_pallas (the scalar-prefetched targets table lands whole
+    in SMEM — the worker-profile caps keep launches inside the
+    tested-good envelope with ~2x margin). Anything bigger routes to the
+    XLA kernel, whose scan streams targets from HBM."""
+    lim = limits()
+    return (cfg is not None and cfg.k_slots <= lim.max_k_pallas
+            and (n_steps is None or n_steps <= lim.max_r_pallas)
             and (n_steps is None or batch is None
-                 or batch * n_steps <= MAX_PREFETCH_PALLAS))
+                 or batch * n_steps <= lim.max_prefetch_pallas))
 
 
 def pallas_available() -> bool:
@@ -433,13 +422,15 @@ def check_encoded_general(enc: EncodedHistory, model: Model,
     # mutex history with m indeterminate acquires AND releases pending:
     # ~C(2m, m) reachable configs) DNF the sort ladder but sweep in
     # seconds. So cap the sort rungs early when dense-chunked is waiting.
+    lim = limits()
     cfg_dense = wgl3.dense_config(model, tight, enc.max_value,
-                                  budget=1 << 26)
+                                  budget=lim.dense_cell_budget_chunked)
     if f_cap_max is None:
-        # The ~2M-key sort allocation fault is an axon-TPU-worker limit;
-        # other backends take the sort kernel as far as memory goes.
+        # The sort-row allocation fault is a worker-profile limit; other
+        # backends take the sort kernel as far as memory goes.
         if pallas_available():
-            f_cap_max = max(4096, min(1 << 20, (1 << 21) // (tight + 1)))
+            f_cap_max = max(4096, min(1 << 20,
+                                      lim.sort_row_budget // (tight + 1)))
         else:
             f_cap_max = 1 << 20
         if cfg_dense is not None:
@@ -508,13 +499,14 @@ def packed_batch_checker(model: Model, cfg: DenseConfig,
     and route to XLA)."""
     from . import wgl3
 
-    if n_steps is not None and n_steps > wgl3.LONG_SCAN_MAX:
+    long_max = limits().long_scan_max
+    if n_steps is not None and n_steps > long_max:
         # Neither packed checker survives a scan program this long on the
-        # axon worker; callers must go through check_batch_encoded_auto /
-        # check_steps3_long, which chunk the step axis host-side.
+        # worker profile; callers must go through check_batch_encoded_auto
+        # / check_steps3_long, which chunk the step axis host-side.
         raise ValueError(
             f"n_steps={n_steps} exceeds one scan program "
-            f"(LONG_SCAN_MAX={wgl3.LONG_SCAN_MAX}); use "
+            f"(long_scan_max={long_max}); use "
             f"check_batch_encoded_auto or wgl3.check_steps3_long")
     if use_pallas(cfg, n_steps, batch):
         return cached_batch_checker_pallas(model, cfg), "wgl3-dense-pallas"
@@ -555,7 +547,7 @@ def check_batch_encoded_auto(encs: Sequence[EncodedHistory],
             general_idx = sorted(general_idx + dense_idx)
             dense_idx = []
         else:
-            if r_cap > wgl3.LONG_SCAN_MAX:
+            if r_cap > limits().long_scan_max:
                 # Step count exceeds one scan program: host-driven chunked
                 # scans, one history at a time — arrays never stacked or
                 # transferred (check_steps3_long streams chunk by chunk).
@@ -615,11 +607,11 @@ def _batch_general(encs, idxs, model, results, kernels, f_cap: int = 256
     Exact verdicts (survived, or dead without overflow — soundness
     argument in ops/wgl2.py) land in `results`; returns (overflowed,
     too_long, top_tier): `overflowed` stayed "unknown" at every tier,
-    `too_long` exceed one scan program (LONG_SCAN_MAX) and were never
+    `too_long` exceed one scan program (limits().long_scan_max) and were never
     launched — both must ladder per history. Launches are chunked so
     batch*f_cap*(k_slots+1) stays inside the tested-good sort-row budget
-    (the axon worker faults past ~2M rows) AND the stacked slot tables
-    stay a few hundred MB."""
+    (limits().sort_row_budget — the worker profile faults past ~2M rows)
+    AND the stacked slot tables stay a few hundred MB."""
     import jax.numpy as jnp
 
     from . import wgl, wgl2, wgl3
@@ -632,27 +624,28 @@ def _batch_general(encs, idxs, model, results, kernels, f_cap: int = 256
     for i, e in sub:
         rs = encode_return_steps(
             reslot_events(e, k) if e.k_slots != k else e)
-        if rs.n_steps > wgl3.LONG_SCAN_MAX:
+        if rs.n_steps > limits().long_scan_max:
             too_long.append(i)   # needs host-chunked scans, not one program
         else:
             steps.append((i, rs))
     if not steps:
         return [], too_long, GENERAL_TIERS[-1]
     r_cap = min(wgl3.step_bucket(max(1, max(s.n_steps for _, s in steps))),
-                wgl3.LONG_SCAN_MAX)
+                limits().long_scan_max)
     # Every GENERAL_TIERS rung runs regardless of the caller's f_cap (the
     # point of tiering is re-batching overflows instead of laddering them
     # per history); f_cap joins as an extra rung when it is larger. No
     # tier may exceed the sort-row budget for ONE history — chunking
     # shrinks the batch, never a single lane's f_cap*(k+1) rows.
-    cap_max = max(GENERAL_TIERS[0], (1 << 21) // (k + 1))
+    cap_max = max(GENERAL_TIERS[0], limits().sort_row_budget // (k + 1))
     tiers = sorted({min(t, cap_max) for t in (*GENERAL_TIERS, f_cap)})
 
     def launch(tier_steps, tier_cap):
         cfg = wgl2.make_config(model, k, tier_cap, max_value)
+        lim = limits()
         chunk = max(1, min(
-            (1 << 21) // (tier_cap * (k + 1)),       # sort-row budget
-            (1 << 26) // max(1, r_cap * (k + 1))))   # stacked elements
+            lim.sort_row_budget // (tier_cap * (k + 1)),
+            lim.stack_element_budget // max(1, r_cap * (k + 1))))
         check = wgl2.cached_batch_checker2(model, cfg)
         overflowed = []
         for c0 in range(0, len(tier_steps), chunk):

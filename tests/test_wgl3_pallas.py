@@ -15,6 +15,7 @@ import pytest
 from jepsen_etcd_demo_tpu.checkers.oracle import check_events_oracle
 from jepsen_etcd_demo_tpu.models import CASRegister
 from jepsen_etcd_demo_tpu.ops import wgl3, wgl3_pallas
+from jepsen_etcd_demo_tpu.ops.limits import limits
 from jepsen_etcd_demo_tpu.ops.encode import encode_register_history
 from jepsen_etcd_demo_tpu.utils.fuzz import (gen_register_history,
                                              mutate_history)
@@ -72,7 +73,8 @@ def test_step_chunking_long_history():
     enc = encode_register_history(h, k_slots=32)
     steps = wgl3.step_bucket(
         sum(1 for op in h if op.type in ("ok", "info")))
-    assert steps > wgl3_pallas.STEP_CHUNK, "test must exercise chunking"
+    assert steps > limits().pallas_step_chunk, \
+        "test must exercise chunking"
     r = wgl3.check_encoded3(enc, MODEL)
     p = _pallas([enc])[0]
     for f in FIELDS:
@@ -106,8 +108,8 @@ def test_chunk_alignment_pads_do_not_count():
     enc = encode_register_history(h, k_slots=32)
     bucket = wgl3.step_bucket(
         sum(1 for op in h if op.type in ("ok", "info")))
-    assert bucket > wgl3_pallas.STEP_CHUNK
-    assert bucket % wgl3_pallas.STEP_CHUNK != 0, \
+    assert bucket > limits().pallas_step_chunk
+    assert bucket % limits().pallas_step_chunk != 0, \
         "test must exercise chunk-alignment padding"
     r = wgl3.check_encoded3(enc, MODEL)
     p = _pallas([enc])[0]
