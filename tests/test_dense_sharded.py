@@ -1,0 +1,137 @@
+"""Batch-sharded production dense kernels (parallel/dense.py).
+
+VERDICT r2 item 1: the sharded path must (a) produce verdicts identical to
+the single-device dense kernel and the oracle, (b) provably partition the
+launch across the mesh (per-device shard shapes asserted), and (c) be the
+path check_batch_encoded_auto takes on a multi-device platform — which is
+exactly what these tests run on (the 8-device virtual CPU mesh).
+"""
+
+from __future__ import annotations
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jepsen_etcd_demo_tpu.checkers.oracle import check_events_oracle
+from jepsen_etcd_demo_tpu.models import CASRegister
+from jepsen_etcd_demo_tpu.ops import wgl3, wgl3_pallas
+from jepsen_etcd_demo_tpu.ops.encode import encode_register_history
+from jepsen_etcd_demo_tpu.parallel import dense as pdense
+from jepsen_etcd_demo_tpu.utils.fuzz import (gen_register_history,
+                                             mutate_history)
+
+MODEL = CASRegister()
+FIELDS = ("survived", "dead_step", "max_frontier", "configs_explored")
+
+
+def _corpus(n, seed=0xD5, n_ops=40):
+    rng = random.Random(seed)
+    encs = []
+    for i in range(n):
+        h = gen_register_history(rng, n_ops=n_ops, n_procs=5)
+        if i % 3 == 0:
+            h = mutate_history(rng, h)
+        encs.append(encode_register_history(h, k_slots=16))
+    return encs
+
+
+def test_sharded_matches_unsharded_and_oracle():
+    encs = _corpus(16)
+    sharded, name = pdense.check_batch_sharded(encs, MODEL)
+    assert name == "wgl3-dense-sharded"
+    single = wgl3.check_batch_encoded3(encs, MODEL)
+    for enc, sh, si in zip(encs, sharded, single):
+        want = check_events_oracle(enc, MODEL).valid
+        assert sh["valid"] is want
+        for f in FIELDS:
+            assert sh[f] == si[f], f
+
+
+def test_ragged_batch_pads_and_strips():
+    encs = _corpus(13, seed=0xA7)   # 13 % 8 != 0
+    sharded, _ = pdense.check_batch_sharded(encs, MODEL)
+    assert len(sharded) == 13
+    single = wgl3.check_batch_encoded3(encs, MODEL)
+    assert [r["valid"] for r in sharded] == [r["valid"] for r in single]
+
+
+def test_launch_is_actually_sharded():
+    """The per-device shard shape proves the partition: [B/D, 5] on each
+    of the D devices, sharding spec named over the batch axis."""
+    encs = _corpus(16, seed=0x5A)
+    mesh = pdense.batch_mesh()
+    d = mesh.shape["batch"]
+    assert d == 8, "tests run on the 8-device virtual mesh"
+    cfg, steps, r_cap = wgl3.batch_steps3(encs, MODEL)
+    arrays, _b = pdense.pad_batch_arrays(wgl3.stack_steps3(steps, r_cap), d)
+    check = pdense.sharded_batch_checker3_packed(MODEL, cfg, mesh)
+    out = check(*(jnp.asarray(a) for a in arrays))
+    assert out.shape == (16, 5)
+    spec = out.sharding.spec
+    assert spec[0] == "batch", spec
+    shard_shapes = {s.data.shape for s in out.addressable_shards}
+    assert shard_shapes == {(16 // d, 5)}
+
+
+def test_auto_router_takes_sharded_path():
+    """check_batch_encoded_auto on a multi-device platform must route the
+    dense partition through the sharded launch (the production seam that
+    corpus/independent ride)."""
+    assert jax.device_count() > 1
+    encs = _corpus(12, seed=0x33)
+    results, kernel = wgl3_pallas.check_batch_encoded_auto(encs, MODEL)
+    assert kernel == "wgl3-dense-sharded"
+    for enc, res in zip(encs, results):
+        assert res["valid"] is check_events_oracle(enc, MODEL).valid
+
+
+def test_single_history_stays_unsharded():
+    encs = _corpus(1, seed=0x91)
+    results, kernel = wgl3_pallas.check_batch_encoded_auto(encs, MODEL)
+    assert kernel == "wgl3-dense"
+    assert results[0]["valid"] is check_events_oracle(encs[0], MODEL).valid
+
+
+def test_pallas_sharded_interpret_matches_xla_sharded():
+    """The fused pallas kernel under shard_map (interpret mode on the CPU
+    mesh) must be bit-identical to the sharded XLA kernel."""
+    encs = _corpus(8, seed=0x66, n_ops=30)
+    mesh = pdense.batch_mesh()
+    cfg, steps, r_cap = wgl3.batch_steps3(encs, MODEL)
+    arrays, _ = pdense.pad_batch_arrays(wgl3.stack_steps3(steps, r_cap),
+                                        mesh.shape["batch"])
+    jarrays = tuple(jnp.asarray(a) for a in arrays)
+    xla = np.asarray(
+        pdense.sharded_batch_checker3_packed(MODEL, cfg, mesh)(*jarrays))
+    pal = np.asarray(
+        pdense.sharded_batch_checker_pallas(MODEL, cfg, mesh,
+                                            interpret=True)(*jarrays))
+    np.testing.assert_array_equal(xla, pal)
+
+
+def test_independent_checker_rides_sharded_batch(tmp_path):
+    """End-to-end: the independent checker's batched launch engages the
+    mesh automatically (multi-key tuple history on the virtual mesh)."""
+    from jepsen_etcd_demo_tpu.checkers import IndependentChecker, Linearizable
+    from jepsen_etcd_demo_tpu.ops.op import Op
+
+    rng = random.Random(0x77)
+    history = []
+    t = 0.0
+    for k in range(6):
+        sub = gen_register_history(rng, n_ops=30, n_procs=3)
+        for op in sub:
+            history.append(Op(type=op.type, f=op.f,
+                              value=(k, op.value), process=(k, op.process),
+                              time=t, index=len(history)))
+            t += 1e-3
+    checker = IndependentChecker(Linearizable(model=MODEL))
+    res = checker.check({}, history, {})
+    assert res["valid"] is True
+    assert res["key_count"] == 6
+    for key_res in res["results"].values():
+        assert key_res["backend"] == "jax-dense-batched"
