@@ -445,6 +445,17 @@ def check_encoded_general(enc: EncodedHistory, model: Model,
     lim = limits()
     cfg_dense = wgl3.dense_config(model, tight, enc.max_value,
                                   budget=lim.dense_cell_budget_chunked)
+    # Multi-device: the lattice-sharded sweep (parallel/lattice.py)
+    # upgrades the dense rung — its cell budget scales with the device
+    # count and each device sweeps 1/D of the table, so geometries the
+    # single-device rung must refuse become checkable at all.
+    cfg_lat = None
+    if jax.device_count() > 1:
+        from ..parallel.lattice import lattice_dense_config
+
+        cfg_lat = lattice_dense_config(model, tight, enc.max_value,
+                                       jax.device_count())
+    cfg_sweep = cfg_lat if cfg_lat is not None else cfg_dense
     if f_cap_max is None:
         # The sort-row allocation fault is a worker-profile limit; other
         # backends take the sort kernel as far as memory goes.
@@ -457,7 +468,9 @@ def check_encoded_general(enc: EncodedHistory, model: Model,
             # Stop the sort ladder where the dense sweep becomes cheaper:
             # a sort rung costs ~f_cap*(k+1) sorted keys per step, the
             # dense sweep a fixed ~cells bit-ops per step. (Only for the
-            # computed default — an explicit caller f_cap_max stands.)
+            # computed default — an explicit caller f_cap_max stands; the
+            # crossover is judged on single-device cells even when the
+            # sharded sweep will run it.)
             cells = cfg_dense.n_states * cfg_dense.n_masks
             f_cap_max = min(f_cap_max, max(f_cap, cells // (tight + 1)))
 
@@ -472,21 +485,29 @@ def check_encoded_general(enc: EncodedHistory, model: Model,
             return {"valid": "unknown", "survived": False, "overflow": True,
                     "dead_step": -1, "max_frontier": -1,
                     "configs_explored": -1, "op_count": enc.n_ops,
-                    "f_cap": cfg_dense.n_states * cfg_dense.n_masks,
+                    "f_cap": cfg_sweep.n_states * cfg_sweep.n_masks,
                     "escalations": 0, "kernel": "exhausted",
                     "error": f"sort ladder consumed the whole "
                              f"{time_budget_s:.0f}s budget; dense-chunked "
                              f"rung not started"}
         if enc.k_slots != tight:
             enc = reslot_events(enc, tight)
-        out = wgl3.check_steps3_long(encode_return_steps(enc), model,
-                                     cfg_dense,
-                                     time_budget_s=remaining)
+        rs = encode_return_steps(enc)
+        if cfg_lat is not None:
+            from ..parallel.lattice import check_steps_lattice_long
+
+            out = check_steps_lattice_long(rs, model, cfg_lat,
+                                           time_budget_s=remaining)
+            name = "wgl3-dense-lattice-sharded"
+        else:
+            out = wgl3.check_steps3_long(rs, model, cfg_dense,
+                                         time_budget_s=remaining)
+            name = "wgl3-dense-chunked"
         out["op_count"] = enc.n_ops
-        out["f_cap"] = cfg_dense.n_states * cfg_dense.n_masks
+        out["f_cap"] = cfg_sweep.n_states * cfg_sweep.n_masks
         out["escalations"] = 0
         if out.get("valid") != "unknown":
-            out["kernel"] = "wgl3-dense-chunked"
+            out["kernel"] = name
         return out
 
     try:
@@ -498,7 +519,7 @@ def check_encoded_general(enc: EncodedHistory, model: Model,
     except MemoryError as e:
         # Capacity OR time exhausted: the dense-chunked rung (no frontier
         # capacity at all) when one exists, else the honest tri-state.
-        if cfg_dense is None:
+        if cfg_sweep is None:
             return {"valid": "unknown", "survived": False, "overflow": True,
                     "dead_step": -1, "max_frontier": -1,
                     "op_count": enc.n_ops, "f_cap": f_cap_max,

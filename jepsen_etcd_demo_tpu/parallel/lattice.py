@@ -1,0 +1,325 @@
+"""Lattice-sharded dense WGL search: ONE wide history, many devices.
+
+The dense subset-lattice kernel (ops/wgl3.py) holds the search frontier as
+the characteristic table u32[S, W] over (state, pending-mask) configs,
+W = 2^(K-5) packed words. Past K ~ 17 the table outgrows one device's cell
+budget and the single-device ladder falls back to the sort kernel or the
+host-chunked sweep (ops/wgl3_pallas.check_encoded_general). This module
+shards the table's WORD axis over a mesh axis instead — the build's
+sequence-parallelism analogue (SURVEY.md §5.7): history length is the
+sequence, the lattice is the per-step state, and each device owns the
+2^(K-5)/D words whose global index falls in its contiguous shard.
+
+What each table operation becomes under the shard (device count D = 2^dbits,
+local words W_loc = W/D, lbits = log2(W_loc); global word index = low lbits
+local | high dbits device):
+
+  * expanding slot j < 5            in-word shift — LOCAL
+  * expanding 5 <= j < 5+lbits      local word-axis reshape — LOCAL
+  * expanding j >= 5+lbits          the mask bit lives in the DEVICE index:
+                                    devices with bit b = j-5-lbits clear OR
+                                    their fired configs into partner
+                                    d | 1<<b — ONE lax.ppermute over ICI
+  * pruning at return t             same split; the remote case is the
+                                    reverse ppermute (bit-set partner sends
+                                    its half down), selected by lax.switch
+                                    over the dbits static permutations
+  * frontier size / death           psum of local popcount / any
+
+Exactness is unchanged — the sharded table is the same whole config space,
+just partitioned; no capacity, no overflow, no dropped configs. Verdicts
+are bit-identical to the single-device dense kernel (differentially
+tested), and the chunked host loop (`check_steps_lattice_long`) mirrors
+check_steps3_long with the carry staying sharded on-device between chunks.
+
+Production routing: check_encoded_general's dense-chunked rung upgrades to
+this path automatically when jax.device_count() > 1 and the geometry
+shards (W >= D) — with the cell budget scaled by D, geometries the
+single-device rung must refuse become checkable at all.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..models.base import Model
+from ..ops import wgl3
+from ..ops.encode import ReturnSteps
+from ..ops.limits import limits
+from ..ops.wgl3 import DenseConfig, _LO_MASK
+from .mesh import make_mesh
+
+_CACHE: dict[tuple, Any] = {}
+
+
+def lattice_mesh(n_devices: int | None = None) -> Mesh:
+    return make_mesh(n_devices, axes=("lattice",))
+
+
+def _mesh_key(mesh: Mesh) -> tuple:
+    return (tuple(mesh.axis_names), tuple(mesh.shape.values()),
+            tuple(d.id for d in mesh.devices.flat))
+
+
+def lattice_dense_config(model: Model, k_slots: int, max_value: int,
+                         n_devices: int,
+                         budget: int | None = None) -> DenseConfig | None:
+    """DenseConfig for the SHARDED lattice: the cell budget scales with the
+    device count (each device holds cells/D), and the word axis must split
+    (W >= D, i.e. K >= 5 + log2(D))."""
+    if budget is None:
+        budget = limits().dense_cell_budget_chunked * n_devices
+    cfg = wgl3.dense_config(model, k_slots, max_value, budget=budget)
+    if cfg is None or (1 << (cfg.k_slots - 5)) < n_devices:
+        return None
+    return cfg
+
+
+def _build_local_step(model: Model, cfg: DenseConfig, axis: str, d: int):
+    """The per-device scan body over one shard of the table. Mirrors
+    wgl3.make_step_fn3 exactly (same banking/closure/prune semantics, same
+    metrics) with the word axis split over `axis`."""
+    K, S = cfg.k_slots, cfg.n_states
+    assert K >= 5 and S <= 32
+    W = 1 << (K - 5)
+    assert W % d == 0 and (d & (d - 1)) == 0
+    w_loc = W // d
+    lbits = w_loc.bit_length() - 1
+    dbits = d.bit_length() - 1
+    lo_masks = jnp.asarray(np.array(_LO_MASK, dtype=np.uint32))
+    full = jnp.uint32(0xFFFFFFFF)
+    w_idx_loc = jnp.arange(w_loc, dtype=jnp.int32)
+
+    def dev():
+        return jax.lax.axis_index(axis)
+
+    def allowed_mask(t):
+        """u32[w_loc]: this shard's positions whose mask has bit t CLEAR
+        (global word index = dev * w_loc + local)."""
+        in_word = lo_masks[jnp.minimum(t, 4)]
+        w_glob = dev() * w_loc + w_idx_loc
+        word_level = jnp.where(
+            ((w_glob >> jnp.maximum(t - 5, 0)) & 1) == 0, full,
+            jnp.uint32(0))
+        return jnp.where(t < 5, jnp.broadcast_to(in_word, (w_loc,)),
+                         word_level)
+
+    def or_reduce(tj, src):
+        acc = jnp.zeros_like(src)
+        for s in range(S):
+            sel = tj[s].reshape((S,) + (1,) * (src.ndim - 1))
+            acc = acc | jnp.where(sel, src[s][None], jnp.uint32(0))
+        return acc
+
+    def expand(T, trans, allowed):
+        """One Gauss-Seidel sweep over all K slots; high slots cross the
+        mesh with one ppermute each."""
+        for j in range(K):
+            src = T & allowed[None, :]
+            if j < 5:
+                fired = or_reduce(trans[j], src & _LO_MASK[j])
+                T = T | (fired << np.uint32(1 << j))
+            elif j - 5 < lbits:
+                lo_w, hi = 1 << (j - 5), w_loc >> (j - 4)
+                Tr = T.reshape(S, hi, 2, lo_w)
+                srcj = src.reshape(S, hi, 2, lo_w)[:, :, 0, :]
+                fired = or_reduce(trans[j], srcj)
+                T = jnp.stack([Tr[:, :, 0, :], Tr[:, :, 1, :] | fired],
+                              axis=2).reshape(S, w_loc)
+            else:
+                b = j - 5 - lbits
+                src_dev = ((dev() >> b) & 1) == 0
+                fired = or_reduce(trans[j], src)
+                fired = jnp.where(src_dev, fired, jnp.uint32(0))
+                recv = jax.lax.ppermute(
+                    fired, axis,
+                    perm=[(p, p | (1 << b)) for p in range(d)
+                          if not (p >> b) & 1])
+                T = T | recv
+        return T
+
+    def prune_local(T, t, allowed):
+        """t's mask bit is in-word or in the LOCAL word bits: the
+        single-device addressing verbatim (w_loc in place of W)."""
+        shift = jnp.where(t < 5, jnp.uint32(1) << jnp.minimum(
+            t.astype(jnp.uint32), jnp.uint32(4)), jnp.uint32(0))
+        wsel = jnp.where(t < 5, w_idx_loc,
+                         w_idx_loc | (jnp.int32(1)
+                                      << jnp.maximum(t - 5, 0)))
+        # Clamp: when t's bit is beyond the local bits this branch is not
+        # taken (lax.switch routes to a remote branch); clamp keeps the
+        # gather in bounds for the untaken trace.
+        wsel = jnp.minimum(wsel, w_loc - 1)
+        return (T[:, wsel] >> shift) & allowed[None, :]
+
+    def prune_remote(b):
+        def f(T, t, allowed):
+            recv = jax.lax.ppermute(
+                T, axis,
+                perm=[(p, p ^ (1 << b)) for p in range(d) if (p >> b) & 1])
+            return recv & allowed[None, :]
+        return f
+
+    def prune(T, t, allowed):
+        # switch index: 0 = local bit, 1+b = device bit b.
+        idx = jnp.clip(t - 5 - lbits + 1, 0, dbits)
+        return jax.lax.switch(
+            idx,
+            [lambda T, t, a: prune_local(T, t, a)]
+            + [prune_remote(b) for b in range(dbits)],
+            T, t, allowed)
+
+    def step(carry, xs):
+        T, dead, dead_step, maxf = carry
+        trans, target, idx = xs
+        is_pad = target < 0
+        t = jnp.maximum(target, 0)
+        allowed = allowed_mask(t)
+
+        def body(st):
+            Tw, n_prev, _c, rounds = st
+            Tw = expand(Tw, trans, allowed)
+            n_now = jax.lax.psum(
+                jnp.sum(jax.lax.population_count(Tw), dtype=jnp.int32),
+                axis)
+            return Tw, n_now, n_now > n_prev, rounds + 1
+
+        def cond(st):
+            return st[2] & (st[3] < cfg.rounds)
+
+        n0 = jax.lax.psum(
+            jnp.sum(jax.lax.population_count(T), dtype=jnp.int32), axis)
+        T, n, _c, _r = jax.lax.while_loop(
+            cond, body, (T, n0, ~is_pad, jnp.int32(0)))
+
+        pruned = prune(T, t, allowed)
+        T_new = jnp.where(is_pad, T, pruned)
+        alive = jax.lax.psum(
+            jnp.any(T_new != 0).astype(jnp.int32), axis) > 0
+        died = ~is_pad & ~dead & ~alive
+        dead = dead | died
+        T_new = jnp.where(dead, jnp.zeros_like(T_new), T_new)
+        return (T_new, dead,
+                jnp.where(died & (dead_step < 0), idx, dead_step),
+                jnp.maximum(maxf, n)), jnp.where(is_pad, 0, n)
+
+    return step, w_loc
+
+
+def make_lattice_chunk_fn(model: Model, cfg: DenseConfig, mesh: Mesh,
+                          axis: str = "lattice"):
+    """jitted (table[S, W] sharded, dead, dead_step, maxf,
+    trans[C,K,S,S'], tgts[C], idx0) -> (table', dead', dead_step', maxf',
+    configs-partial) — the sharded twin of wgl3._chunk_fn. The table stays
+    a mesh-sharded jax.Array between host-loop chunks."""
+    d = mesh.shape[axis]
+    step, w_loc = _build_local_step(model, cfg, axis, d)
+
+    def run(table, dead, dead_step, maxf, trans, tgts, idx0):
+        idxs = idx0 + jnp.arange(tgts.shape[0], dtype=jnp.int32)
+        (table, dead, dead_step, maxf), ns = jax.lax.scan(
+            step, (table, dead, dead_step, maxf), (trans, tgts, idxs))
+        return table, dead, dead_step, maxf, jnp.sum(
+            ns.astype(jnp.float32))
+
+    specs = dict(
+        mesh=mesh,
+        in_specs=(P(None, axis), P(), P(), P(), P(None, None, None, None),
+                  P(None), P()),
+        out_specs=(P(None, axis), P(), P(), P(), P()))
+    try:
+        sharded = shard_map(run, check_vma=False, **specs)
+    except TypeError:
+        sharded = shard_map(run, check_rep=False, **specs)
+    return jax.jit(sharded)
+
+
+def cached_lattice_chunk(model: Model, cfg: DenseConfig, mesh: Mesh,
+                         axis: str = "lattice"):
+    key = ("lattice-chunk", model.cache_key(), cfg, _mesh_key(mesh), axis)
+    if key not in _CACHE:
+        _CACHE[key] = make_lattice_chunk_fn(model, cfg, mesh, axis)
+    return _CACHE[key]
+
+
+def _transitions_fn(model: Model, cfg: DenseConfig):
+    key = ("lattice-trans", model.cache_key(), cfg)
+    if key not in _CACHE:
+        _, transitions = wgl3.make_step_fn3(model, cfg)
+        _CACHE[key] = jax.jit(jax.vmap(transitions))
+    return _CACHE[key]
+
+
+def check_steps_lattice_long(rs: ReturnSteps, model: Model,
+                             cfg: DenseConfig, mesh: Mesh | None = None,
+                             chunk: int | None = None,
+                             time_budget_s: float | None = None) -> dict:
+    """Sharded host-chunked dense sweep: the wide-geometry twin of
+    wgl3.check_steps3_long. Same result schema, same honest "unknown" on
+    budget expiry; exact otherwise."""
+    import time as _time
+
+    from ..ops.wgl import verdict
+
+    t0 = _time.monotonic()
+    if mesh is None:
+        mesh = lattice_mesh()
+    d = int(np.prod(list(mesh.shape.values())))
+    if chunk is None:
+        cells = cfg.n_states * cfg.n_masks // d   # per-device sweep cost
+        base = limits().long_scan_chunk
+        chunk = min(base, max(128, base * (1 << 15) // max(cells, 1)))
+    run = cached_lattice_chunk(model, cfg, mesh)
+    trans_of = _transitions_fn(model, cfg)
+    n = rs.n_steps
+    n_pad = (n + chunk - 1) // chunk * chunk
+    rs = rs.padded_to(n_pad)
+    # Carry starts as host values; jit output keeps the table sharded
+    # across chunks.
+    w = 1 << (cfg.k_slots - 5)
+    table = jnp.zeros((cfg.n_states, w), jnp.uint32)
+    row = int(model.init_state()) + cfg.state_offset
+    table = table.at[row, 0].set(jnp.uint32(1))
+    dead = jnp.bool_(False)
+    dead_step = jnp.int32(-1)
+    maxf = jnp.int32(1)
+    cfgs_dev = None
+    for c in range(n_pad // chunk):
+        if (time_budget_s is not None
+                and _time.monotonic() - t0 > time_budget_s):
+            return {"valid": "unknown", "survived": False, "overflow": True,
+                    "dead_step": -1, "max_frontier": -1,
+                    "configs_explored": -1, "kernel": "exhausted",
+                    "error": f"sharded dense sweep exceeded its "
+                             f"{time_budget_s:.0f}s time budget at return "
+                             f"step {c * chunk}"}
+        sl = slice(c * chunk, (c + 1) * chunk)
+        trans = trans_of(jnp.asarray(rs.slot_tabs[sl]),
+                         jnp.asarray(rs.slot_active[sl]))
+        table, dead, dead_step, maxf, part = run(
+            table, dead, dead_step, maxf, trans,
+            jnp.asarray(rs.targets[sl]), jnp.int32(c * chunk))
+        cfgs_dev = part if cfgs_dev is None else cfgs_dev + part
+        if bool(np.asarray(dead)):
+            break
+    out = {
+        "survived": not bool(np.asarray(dead)),
+        "overflow": False,
+        "dead_step": int(np.asarray(dead_step)),
+        "max_frontier": int(np.asarray(maxf)),
+        "configs_explored": int(np.asarray(
+            jnp.clip(cfgs_dev, 0, 2**31 - 1))),
+    }
+    out["valid"] = verdict(out)
+    return out
