@@ -1,309 +1,28 @@
-"""Wing–Gong linearizability search as a JAX/XLA kernel.
+"""Shared verdict helper (all that remains of the v1 event-major kernel).
 
-This replaces the reference's compute hot loop — knossos's JVM state-space
-search invoked at src/jepsen/etcdemo.clj:117 — with a static-shape, TPU-
-compilable frontier search (BASELINE.json north star).
-
-Shape of the computation:
-
-  * A *configuration* is (model state: int32, linearized set: bitmask over
-    `k_slots` pending-op slots). The frontier is a fixed-capacity tensor of
-    configurations: states[F], masks[F, W] (W = k_slots/32 uint32 words),
-    valid[F].
-  * `lax.scan` walks the event tensor (encode.py). EV_INVOKE loads the op
-    into its slot table row. EV_RETURN runs the expansion closure — a bounded
-    `lax.while_loop` that repeatedly fires every legal pending op from every
-    config (vmapped model step over frontier × slots), merges candidates with
-    the existing frontier, and dedups by sort — then prunes to configs that
-    linearized the returning op, clears its bit, and frees the slot.
-  * Dedup is sort-based (jnp.lexsort over state + mask words) because a hash
-    set is not a TPU-friendly structure; this mirrors knossos's memoization
-    (high-scale-lib concurrent sets on the JVM) with sorted uniqueness.
-
-Soundness under overflow: dropping configurations when the frontier exceeds
-capacity can only lose linearization witnesses. A run that *survives* is
-therefore a genuine proof of linearizability regardless of overflow; a run
-that dies after overflowing is reported "unknown" rather than invalid.
-
-The whole search is data-independent in shape, so it vmaps over a batch of
-histories (the per-key axis of jepsen.independent, src/jepsen/etcdemo.clj:115,
-120-125) and shards over a device mesh (parallel/).
+The v1 WGL kernel that lived here — frontier-as-list over [E, 6] event
+tensors with an EV_INVOKE/EV_RETURN lax.cond per scan step — was retired in
+round 3: it lost the round-1 bench to the CPU oracle, was superseded by the
+return-major sort kernel (ops/wgl2.py) and the dense subset-lattice kernels
+(ops/wgl3.py, ops/wgl3_pallas.py), and by round 2 existed only to be
+mesh-sharded; the production shardings now wrap the dense kernels directly
+(parallel/dense.py, parallel/lattice.py). Its search geometry config and
+sort-dedup helpers moved to ops/wgl2.py with the sort kernel, their only
+remaining user.
 """
 
 from __future__ import annotations
 
-import functools
-from dataclasses import dataclass
-from typing import Any, NamedTuple
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from ..models.base import Model
-from .encode import EncodedHistory, EV_INVOKE, EV_RETURN, EVENT_WIDTH
-
-
-class _Carry(NamedTuple):
-    states: jax.Array       # i32[F]
-    masks: jax.Array        # u32[F, W]
-    valid: jax.Array        # bool[F]
-    slot_tab: jax.Array     # i32[K, 4] (f, a1, a2, rv)
-    slot_active: jax.Array  # bool[K]
-    dead: jax.Array         # bool
-    overflow: jax.Array     # bool
-    dead_event: jax.Array   # i32
-    max_frontier: jax.Array  # i32
-
-
-@dataclass(frozen=True)
-class WGLConfig:
-    k_slots: int = 32       # pending-op slot capacity (bitmask width)
-    f_cap: int = 256        # frontier capacity (configs kept after dedup)
-    max_expand_rounds: int | None = None  # closure depth bound; default k_slots
-    # >0 enables the packed single-uint32 dedup in the v2 kernel: every
-    # reachable model state must fit in `state_bits` bits after the model's
-    # state_offset. Derive from the HISTORY's actual values
-    # (model.pack_bits(enc.max_value)) — never assume a value range.
-    state_bits: int = 0
-
-    @property
-    def words(self) -> int:
-        return (self.k_slots + 31) // 32
-
-    @property
-    def rounds(self) -> int:
-        return self.max_expand_rounds or self.k_slots
-
-
-def _slot_constants(cfg: WGLConfig):
-    k, w = cfg.k_slots, cfg.words
-    word = np.arange(k) // 32
-    bit = np.arange(k) % 32
-    slot_bitmask = np.zeros((k, w), dtype=np.uint32)
-    slot_bitmask[np.arange(k), word] = np.uint32(1) << bit.astype(np.uint32)
-    return (jnp.asarray(word, jnp.int32), jnp.asarray(bit, jnp.uint32),
-            jnp.asarray(slot_bitmask))
-
-
-def _dedup(states, masks, valid, f_cap):
-    """Sort rows by (valid desc, state, mask words), keep unique valid rows,
-    compact into a fresh fixed-capacity frontier."""
-    w = masks.shape[-1]
-    invalid = (~valid).astype(jnp.int32)
-    # lexsort: last key is primary. Primary: invalid flag (valid rows first);
-    # then state; then mask words for a total order on content.
-    keys = tuple(masks[:, i].astype(jnp.uint32) for i in range(w - 1, -1, -1))
-    order = jnp.lexsort(keys + (states, invalid))
-    s_states = states[order]
-    s_masks = masks[order]
-    s_valid = valid[order]
-    eq_prev = jnp.concatenate([
-        jnp.array([False]),
-        (s_states[1:] == s_states[:-1])
-        & jnp.all(s_masks[1:] == s_masks[:-1], axis=-1),
-    ])
-    unique = s_valid & ~eq_prev
-    n_unique = jnp.sum(unique.astype(jnp.int32))
-    dest = jnp.where(unique, jnp.cumsum(unique.astype(jnp.int32)) - 1, f_cap)
-    new_states = jnp.zeros((f_cap,), jnp.int32).at[dest].set(
-        s_states, mode="drop")
-    new_masks = jnp.zeros((f_cap, masks.shape[-1]), jnp.uint32).at[dest].set(
-        s_masks, mode="drop")
-    new_valid = jnp.arange(f_cap) < jnp.minimum(n_unique, f_cap)
-    return new_states, new_masks, new_valid, n_unique
-
-
-def make_step_fn(model: Model, cfg: WGLConfig):
-    """Build the per-event scan body (the jittable unit)."""
-    word_of, bit_of, slot_bitmask = _slot_constants(cfg)
-    f_cap, k = cfg.f_cap, cfg.k_slots
-
-    def bits_set(masks):
-        # masks u32[F, W] -> {0,1}[F, K]: is each slot's bit set?
-        return (masks[:, word_of] >> bit_of) & jnp.uint32(1)
-
-    def expand_once(states, masks, valid, slot_tab, slot_active, t_word,
-                    t_bit):
-        f = slot_tab[:, 0]
-        a1 = slot_tab[:, 1]
-        a2 = slot_tab[:, 2]
-        rv = slot_tab[:, 3]
-        legal, nxt = jax.vmap(lambda s: model.step(s, f, a1, a2, rv))(states)
-        # Just-in-time linearization (Lowe; knossos :linear): only expand
-        # configs that have NOT yet fired the returning op. Once the target
-        # is fired a config is banked as-is — anything reachable beyond it
-        # is regenerable at the next return's closure, so storing only the
-        # boundary keeps the frontier minimal.
-        not_done = ((masks[:, t_word] >> t_bit) & jnp.uint32(1)) == 0  # [F]
-        cand_valid = (valid[:, None] & not_done[:, None]
-                      & slot_active[None, :]
-                      & (bits_set(masks) == 0) & legal)          # [F, K]
-        cand_masks = masks[:, None, :] | slot_bitmask[None, :, :]  # [F, K, W]
-        all_states = jnp.concatenate([states, nxt.reshape(-1)])
-        all_masks = jnp.concatenate(
-            [masks, cand_masks.reshape(-1, cfg.words)])
-        all_valid = jnp.concatenate([valid, cand_valid.reshape(-1)])
-        return _dedup(all_states, all_masks, all_valid, f_cap)
-
-    def closure(states, masks, valid, slot_tab, slot_active, overflow,
-                t_word, t_bit):
-        n0 = jnp.sum(valid.astype(jnp.int32))
-
-        def cond(st):
-            _s, _m, _v, n_prev, changed, _o, it = st
-            return changed & (it < cfg.rounds)
-
-        def body(st):
-            s, m, v, n_prev, _c, o, it = st
-            s2, m2, v2, n_unique = expand_once(s, m, v, slot_tab,
-                                               slot_active, t_word, t_bit)
-            o = o | (n_unique > f_cap)
-            n_now = jnp.minimum(n_unique, f_cap)
-            return (s2, m2, v2, n_now, n_now > n_prev, o, it + 1)
-
-        init = (states, masks, valid, n0, jnp.bool_(True), overflow,
-                jnp.int32(0))
-        s, m, v, n, _c, o, _it = jax.lax.while_loop(cond, body, init)
-        return s, m, v, n, o
-
-    def step(carry: _Carry, ev_and_idx):
-        ev, idx = ev_and_idx
-        kind, slot = ev[0], ev[1]
-
-        def on_invoke(c: _Carry) -> _Carry:
-            slot_tab = c.slot_tab.at[slot].set(ev[2:6])
-            slot_active = c.slot_active.at[slot].set(True)
-            return c._replace(slot_tab=slot_tab, slot_active=slot_active)
-
-        def on_return(c: _Carry) -> _Carry:
-            s, m, v, n, overflow = closure(
-                c.states, c.masks, c.valid, c.slot_tab, c.slot_active,
-                c.overflow, word_of[slot], bit_of[slot])
-            bit_word = jnp.take(m, word_of[slot], axis=-1)
-            has_bit = ((bit_word >> bit_of[slot]) & jnp.uint32(1)) == 1
-            keep = v & has_bit
-            cleared = m & ~slot_bitmask[slot][None, :]
-            slot_active = c.slot_active.at[slot].set(False)
-            died = ~jnp.any(keep)
-            return c._replace(
-                states=s, masks=cleared, valid=keep,
-                slot_active=slot_active,
-                dead=died, overflow=overflow,
-                dead_event=jnp.where(died & (c.dead_event < 0), idx,
-                                     c.dead_event),
-                max_frontier=jnp.maximum(c.max_frontier, n))
-
-        def active_step(c: _Carry) -> _Carry:
-            return jax.lax.cond(kind == EV_INVOKE, on_invoke, on_return, c)
-
-        skip = carry.dead | (kind != EV_INVOKE) & (kind != EV_RETURN)
-        carry = jax.lax.cond(skip, lambda c: c, active_step, carry)
-        return carry, None
-
-    return step
-
-
-def _init_carry(model: Model, cfg: WGLConfig) -> _Carry:
-    f_cap, k, w = cfg.f_cap, cfg.k_slots, cfg.words
-    return _Carry(
-        states=jnp.zeros((f_cap,), jnp.int32).at[0].set(model.init_state()),
-        masks=jnp.zeros((f_cap, w), jnp.uint32),
-        valid=jnp.zeros((f_cap,), bool).at[0].set(True),
-        slot_tab=jnp.zeros((k, 4), jnp.int32),
-        slot_active=jnp.zeros((k,), bool),
-        dead=jnp.bool_(False),
-        overflow=jnp.bool_(False),
-        dead_event=jnp.int32(-1),
-        max_frontier=jnp.int32(1),
-    )
-
-
-def make_checker(model: Model, cfg: WGLConfig = WGLConfig()):
-    """Returns jitted check(events[E,6] int32) -> result dict of scalars."""
-    step = make_step_fn(model, cfg)
-
-    @jax.jit
-    def check(events):
-        carry = _init_carry(model, cfg)
-        idxs = jnp.arange(events.shape[0], dtype=jnp.int32)
-        final, _ = jax.lax.scan(step, carry, (events, idxs))
-        return {
-            "survived": ~final.dead,
-            "overflow": final.overflow,
-            "dead_event": final.dead_event,
-            "max_frontier": final.max_frontier,
-        }
-
-    return check
-
-
-def make_batch_checker(model: Model, cfg: WGLConfig = WGLConfig()):
-    """Returns jitted check(events[B,E,6]) -> dict of [B] result vectors.
-
-    The batch axis is the per-key axis of the independent checker
-    (src/jepsen/etcdemo.clj:115,120-125) and/or a corpus of stored histories;
-    it is the natural data-parallel axis to shard over a TPU mesh.
-    """
-    step = make_step_fn(model, cfg)
-
-    def check_one(events):
-        carry = _init_carry(model, cfg)
-        idxs = jnp.arange(events.shape[0], dtype=jnp.int32)
-        final, _ = jax.lax.scan(step, carry, (events, idxs))
-        return (~final.dead, final.overflow, final.dead_event,
-                final.max_frontier)
-
-    @jax.jit
-    def check(events_batch):
-        survived, overflow, dead_event, max_frontier = jax.vmap(check_one)(
-            events_batch)
-        return {
-            "survived": survived,
-            "overflow": overflow,
-            "dead_event": dead_event,
-            "max_frontier": max_frontier,
-        }
-
-    return check
+from typing import Any
 
 
 def verdict(result: dict[str, Any]) -> bool | str:
-    """Map kernel outputs to jepsen's tri-state validity."""
+    """Map kernel outputs to jepsen's tri-state validity: a surviving
+    search proves linearizability; a dead search refutes it UNLESS configs
+    were dropped along the way (overflow), which can only lose
+    linearization witnesses — then the honest answer is "unknown"."""
     survived = bool(result["survived"])
     overflow = bool(result["overflow"])
     if survived:
         return True
     return "unknown" if overflow else False
-
-
-# Jitted checkers are cached per (model identity, config) so repeated checks
-# (per-key loops, overflow retries) don't pay XLA retrace/compile each time.
-_CHECKER_CACHE: dict[tuple, Any] = {}
-
-
-def cached_checker(model: Model, cfg: WGLConfig):
-    key = ("single", model.cache_key(), cfg)
-    if key not in _CHECKER_CACHE:
-        _CHECKER_CACHE[key] = make_checker(model, cfg)
-    return _CHECKER_CACHE[key]
-
-
-def cached_batch_checker(model: Model, cfg: WGLConfig):
-    key = ("batch", model.cache_key(), cfg)
-    if key not in _CHECKER_CACHE:
-        _CHECKER_CACHE[key] = make_batch_checker(model, cfg)
-    return _CHECKER_CACHE[key]
-
-
-def check_encoded(enc: EncodedHistory, model: Model | None = None,
-                  f_cap: int = 256) -> dict[str, Any]:
-    """Convenience single-history entry point (jit-cached per config)."""
-    if model is None:
-        from ..models import CASRegister
-        model = CASRegister()
-    check = cached_checker(model, WGLConfig(enc.k_slots, f_cap))
-    out = check(jnp.asarray(enc.events))
-    out = {k: np.asarray(v) for k, v in out.items()}
-    out["valid"] = verdict(out)
-    return out

@@ -24,6 +24,7 @@ soundness-under-overflow argument as in ops/wgl.py.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Any, NamedTuple
 
 import jax
@@ -32,7 +33,67 @@ import numpy as np
 
 from ..models.base import Model
 from .encode import EncodedHistory, ReturnSteps, encode_return_steps
-from .wgl import WGLConfig, _dedup, _slot_constants
+
+
+@dataclass(frozen=True)
+class WGLConfig:
+    """Sort-kernel search geometry (formerly ops/wgl.py, the retired v1
+    event-major kernel; the config and its helpers moved here when v1 was
+    deleted — the return-major sort kernel is their only remaining user)."""
+    k_slots: int = 32       # pending-op slot capacity (bitmask width)
+    f_cap: int = 256        # frontier capacity (configs kept after dedup)
+    max_expand_rounds: int | None = None  # closure depth bound; default k_slots
+    # >0 enables the packed single-uint32 dedup: every reachable model
+    # state must fit in `state_bits` bits after the model's state_offset.
+    # Derive from the HISTORY's actual values
+    # (model.pack_bits(enc.max_value)) — never assume a value range.
+    state_bits: int = 0
+
+    @property
+    def words(self) -> int:
+        return (self.k_slots + 31) // 32
+
+    @property
+    def rounds(self) -> int:
+        return self.max_expand_rounds or self.k_slots
+
+
+def _slot_constants(cfg: WGLConfig):
+    k, w = cfg.k_slots, cfg.words
+    word = np.arange(k) // 32
+    bit = np.arange(k) % 32
+    slot_bitmask = np.zeros((k, w), dtype=np.uint32)
+    slot_bitmask[np.arange(k), word] = np.uint32(1) << bit.astype(np.uint32)
+    return (jnp.asarray(word, jnp.int32), jnp.asarray(bit, jnp.uint32),
+            jnp.asarray(slot_bitmask))
+
+
+def _dedup(states, masks, valid, f_cap):
+    """Sort rows by (valid desc, state, mask words), keep unique valid rows,
+    compact into a fresh fixed-capacity frontier."""
+    w = masks.shape[-1]
+    invalid = (~valid).astype(jnp.int32)
+    # lexsort: last key is primary. Primary: invalid flag (valid rows first);
+    # then state; then mask words for a total order on content.
+    keys = tuple(masks[:, i].astype(jnp.uint32) for i in range(w - 1, -1, -1))
+    order = jnp.lexsort(keys + (states, invalid))
+    s_states = states[order]
+    s_masks = masks[order]
+    s_valid = valid[order]
+    eq_prev = jnp.concatenate([
+        jnp.array([False]),
+        (s_states[1:] == s_states[:-1])
+        & jnp.all(s_masks[1:] == s_masks[:-1], axis=-1),
+    ])
+    unique = s_valid & ~eq_prev
+    n_unique = jnp.sum(unique.astype(jnp.int32))
+    dest = jnp.where(unique, jnp.cumsum(unique.astype(jnp.int32)) - 1, f_cap)
+    new_states = jnp.zeros((f_cap,), jnp.int32).at[dest].set(
+        s_states, mode="drop")
+    new_masks = jnp.zeros((f_cap, masks.shape[-1]), jnp.uint32).at[dest].set(
+        s_masks, mode="drop")
+    new_valid = jnp.arange(f_cap) < jnp.minimum(n_unique, f_cap)
+    return new_states, new_masks, new_valid, n_unique
 
 
 class _Carry2(NamedTuple):
@@ -365,8 +426,9 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
             if f_cap > f_cap_max:
                 raise MemoryError(
                     f"WGL frontier exceeds f_cap_max={f_cap_max} at return "
-                    f"step {c0}; history needs a bigger device or sharded "
-                    f"frontier (parallel/frontier.py)")
+                    f"step {c0}; history needs the dense sweep — chunked "
+                    f"(ops/wgl3.py) or lattice-sharded "
+                    f"(parallel/lattice.py)")
             cfg = config_for(rs, model, f_cap)
             carry = _migrate_carry(carry, f_cap)
         if bool(out.dead):

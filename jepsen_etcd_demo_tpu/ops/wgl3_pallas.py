@@ -464,14 +464,17 @@ def check_encoded_general(enc: EncodedHistory, model: Model,
                                       lim.sort_row_budget // (tight + 1)))
         else:
             f_cap_max = 1 << 20
-        if cfg_dense is not None:
+        if cfg_sweep is not None:
             # Stop the sort ladder where the dense sweep becomes cheaper:
             # a sort rung costs ~f_cap*(k+1) sorted keys per step, the
-            # dense sweep a fixed ~cells bit-ops per step. (Only for the
-            # computed default — an explicit caller f_cap_max stands; the
-            # crossover is judged on single-device cells even when the
-            # sharded sweep will run it.)
-            cells = cfg_dense.n_states * cfg_dense.n_masks
+            # dense sweep a fixed ~cells bit-ops per step — PER DEVICE
+            # when the lattice-sharded rung will run it, so wide
+            # geometries route to the cheap sweep early instead of
+            # burning the budget on huge sort rungs. (Only for the
+            # computed default — an explicit caller f_cap_max stands.)
+            cells = cfg_sweep.n_states * cfg_sweep.n_masks
+            if cfg_lat is not None:
+                cells //= jax.device_count()
             f_cap_max = min(f_cap_max, max(f_cap, cells // (tight + 1)))
 
     def dense_chunked(enc):

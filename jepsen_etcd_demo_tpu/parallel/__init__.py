@@ -1,20 +1,27 @@
-"""Device-mesh execution of the checker kernels.
+"""Device-mesh execution of the PRODUCTION checker kernels.
 
 The reference's parallelism axes map onto the TPU mesh like this
 (SURVEY.md §2.4):
   * independent-key / corpus axis (embarrassingly parallel histories) →
-    data-parallel sharding of the [B, E, 6] event batch over mesh axis
-    "batch" (`batch.py`) — configs[2]/[4] of BASELINE.json;
-  * checker search axis (knossos's JVM search threads) → the WGL frontier
-    sharded over mesh axis "frontier" with shard_map + all_gather compaction
-    (`frontier.py`) — configs[3], the 10k-op north star.
+    batch-axis sharding of the dense wgl3/pallas kernels (`dense.py`) —
+    configs[2]/[4] of BASELINE.json; engaged automatically by
+    check_batch_encoded_auto whenever more than one device is present;
+  * checker search axis (knossos's JVM search threads; this domain's
+    sequence parallelism, §5.7) → the dense subset-lattice table's word
+    axis sharded with shard_map + ppermute exchange (`lattice.py`) —
+    configs[3], wide geometries past one chip's cell budget;
+  * across hosts, the corpus axis rides DCN (`multislice.py`) — §2.5.
 
 Collectives ride ICI inside a slice; the corpus axis is the DCN axis across
-slices (§2.5).
+slices. The round-2 frontier/batch shardings of the retired v1 sort kernel
+were deleted with it (ops/wgl.py docstring has the history).
 """
 
 from .mesh import make_mesh, device_count  # noqa: F401
-from .batch import sharded_corpus_checker, check_corpus  # noqa: F401
-from .frontier import (  # noqa: F401
-    make_frontier_sharded_checker, make_grid_sharded_checker,
+from .dense import (  # noqa: F401
+    batch_mesh, check_batch_sharded, check_steps_sharded,
+    sharded_packed_batch_checker,
+)
+from .lattice import (  # noqa: F401
+    check_steps_lattice_long, lattice_dense_config, lattice_mesh,
 )

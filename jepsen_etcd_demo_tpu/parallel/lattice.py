@@ -40,7 +40,6 @@ single-device rung must refuse become checkable at all.
 
 from __future__ import annotations
 
-import functools
 from typing import Any
 
 import jax
@@ -77,7 +76,11 @@ def lattice_dense_config(model: Model, k_slots: int, max_value: int,
                          budget: int | None = None) -> DenseConfig | None:
     """DenseConfig for the SHARDED lattice: the cell budget scales with the
     device count (each device holds cells/D), and the word axis must split
-    (W >= D, i.e. K >= 5 + log2(D))."""
+    evenly — D a power of two with W >= D (the ppermute pairing addresses
+    devices by mask bits). Infeasible platforms get None so routing falls
+    back to the single-device rung instead of crashing mid-check."""
+    if n_devices < 2 or n_devices & (n_devices - 1):
+        return None
     if budget is None:
         budget = limits().dense_cell_budget_chunked * n_devices
     cfg = wgl3.dense_config(model, k_slots, max_value, budget=budget)

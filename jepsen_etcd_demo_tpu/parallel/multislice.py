@@ -1,8 +1,8 @@
 """DCN multi-slice corpus sharding (BASELINE configs[4]; SURVEY.md §2.5).
 
 The reference's only inter-machine planes are SSH + HTTP; the TPU build adds
-a device-collective plane. Within a slice, the frontier/batch axes ride ICI
-(parallel/frontier.py, parallel/batch.py). ACROSS slices — separate hosts,
+a device-collective plane. Within a slice, the batch/lattice axes ride ICI
+(parallel/dense.py, parallel/lattice.py). ACROSS slices — separate hosts,
 each running one JAX process — the corpus axis rides DCN:
 
   * every process calls `init_multislice` (jax.distributed.initialize) so

@@ -80,14 +80,15 @@ def test_chunked_carry_across_host_loop():
 def test_wide_geometry_k20():
     """A K=20 history (beyond the single-device DEFAULT cell budget, the
     round-2 gap): the sharded sweep must agree with the single-device
-    relaxed-budget sweep bit for bit. Built deterministically: 19
-    forever-pending indeterminate writes UNDER a normal fuzzed run widen
-    the pending set to exactly the target K."""
+    relaxed-budget sweep bit for bit. Built deterministically: 14
+    forever-pending indeterminate writes on top of a normal fuzzed run
+    (whose own concurrency + info ops supply the rest) widen the pending
+    set so tight_k_slots lands at the target K=20."""
     from jepsen_etcd_demo_tpu.ops.op import Op
 
     rng = random.Random(0xD4)
     h = list(gen_register_history(rng, n_ops=40, n_procs=3))
-    # 19 concurrent indeterminate writes from dedicated processes, invoked
+    # Concurrent indeterminate writes from dedicated processes, invoked
     # up front and never completed: each stays pending for the whole
     # history (knossos :info open-forever semantics).
     wide = [Op(type="invoke", f="write", value=(i % 5),
@@ -101,6 +102,15 @@ def test_wide_geometry_k20():
     cfg = lattice.lattice_dense_config(MODEL, k, 4, jax.device_count())
     assert cfg is not None
     _compare(h, k=k)
+
+
+def test_non_power_of_two_platform_falls_back():
+    """6 devices cannot pair for the bit-addressed ppermute: config must be
+    None so the general ladder keeps the single-device rung instead of
+    crashing (documented never-a-crash contract)."""
+    assert lattice.lattice_dense_config(MODEL, 12, 4, 6) is None
+    assert lattice.lattice_dense_config(MODEL, 12, 4, 1) is None
+    assert lattice.lattice_dense_config(MODEL, 12, 4, 8) is not None
 
 
 def test_production_routing_via_general_ladder():
