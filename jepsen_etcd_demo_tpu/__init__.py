@@ -10,7 +10,7 @@ persisting every run to a browsable store.
 
 The defining difference from the reference: the linearizability checker's
 Wing–Gong state-space search runs as a vmapped, mesh-shardable JAX/XLA kernel
-(see `ops.wgl` and `parallel/`) instead of knossos's JVM search, behind the
+(see `ops.wgl3`, `ops.wgl3_pallas`, and `parallel/`) instead of knossos's JVM search, behind the
 same pluggable Checker seam (reference seam: jepsen.checker/Checker, invoked
 at src/jepsen/etcdemo.clj:115-119).
 

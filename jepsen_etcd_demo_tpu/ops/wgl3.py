@@ -283,6 +283,25 @@ def _chunk_fn(model: Model, cfg: DenseConfig):
     return jax.jit(run)
 
 
+def default_scan_chunk(cfg: DenseConfig) -> int:
+    """Host-loop chunk size: scales inversely with table width (sweep cost
+    per step is proportional to cells). Floor 128: at the chunked-budget
+    cell ceiling a step costs ~70 ms, so even the floor chunk stays ~10 s
+    — safely under the worker's program-kill threshold. ONE copy shared by
+    the long sweep and witness frontier recovery so a tuning change can't
+    leave one of them outside the envelope."""
+    cells = cfg.n_states * cfg.n_masks
+    base = limits().long_scan_chunk
+    return min(base, max(128, base * (1 << 15) // max(cells, 1)))
+
+
+def _cached_chunk_run(model: Model, cfg: DenseConfig, chunk: int):
+    key = ("chunk3", model.cache_key(), cfg, chunk)
+    if key not in _CACHE:
+        _CACHE[key] = _chunk_fn(model, cfg)
+    return _CACHE[key]
+
+
 def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
                       chunk: int | None = None,
                       time_budget_s: float | None = None) -> dict:
@@ -300,17 +319,8 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
 
     t0 = _time.monotonic()
     if chunk is None:
-        # Scale chunk size inversely with table width (sweep cost per step
-        # is proportional to cells). Floor 128: at the chunked-budget cell
-        # ceiling a step costs ~70 ms, so even the floor chunk stays ~10 s
-        # — safely under the worker's program-kill threshold.
-        cells = cfg.n_states * cfg.n_masks
-        base = limits().long_scan_chunk
-        chunk = min(base, max(128, base * (1 << 15) // max(cells, 1)))
-    key = ("chunk3", model.cache_key(), cfg, chunk)
-    if key not in _CACHE:
-        _CACHE[key] = _chunk_fn(model, cfg)
-    run = _CACHE[key]
+        chunk = default_scan_chunk(cfg)
+    run = _cached_chunk_run(model, cfg, chunk)
     n = rs.n_steps
     n_pad = (n + chunk - 1) // chunk * chunk
     rs = rs.padded_to(n_pad)
@@ -351,6 +361,48 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
     }
     out["valid"] = verdict(out)
     return out
+
+
+def recover_table3(rs: ReturnSteps, model: Model, cfg: DenseConfig,
+                   upto_step: int,
+                   chunk: int | None = None) -> list[tuple[int, int]]:
+    """EXACT reachable-config set after the first `upto_step` return steps:
+    run the chunked dense scan that far, fetch the table once, decode the
+    set bits host-side. Returns [(state_value, linearized-mask), ...].
+
+    This is the frontier-recovery half of big-history witness extraction
+    (checkers/witness.py): the kernel knows WHERE a search died
+    (dead_step) but keeps no lineage; recovering the frontier shortly
+    before the death point lets the host replay only a bounded window
+    instead of the whole exponential prefix."""
+    if chunk is None:
+        chunk = default_scan_chunk(cfg)
+    run = _cached_chunk_run(model, cfg, chunk)
+    upto = min(upto_step, rs.n_steps)
+    # Truncate to the prefix, then pad the tail chunk with -1 targets
+    # (pad steps leave the table untouched).
+    n_pad = max(1, (upto + chunk - 1) // chunk) * chunk
+    pre = ReturnSteps(rs.slot_tabs[:upto], rs.slot_active[:upto],
+                      rs.targets[:upto], upto, rs.n_ops, rs.k_slots,
+                      rs.max_pending, rs.max_value).padded_to(n_pad)
+    carry = _init_carry3(model, cfg)
+    for c in range(n_pad // chunk):
+        sl = slice(c * chunk, (c + 1) * chunk)
+        carry, _ = run(carry, jnp.asarray(pre.slot_tabs[sl]),
+                       jnp.asarray(pre.slot_active[sl]),
+                       jnp.asarray(pre.targets[sl]),
+                       jnp.int32(c * chunk))
+    table = np.asarray(carry.table)            # u32[S, W]
+    configs = []
+    for srow in range(cfg.n_states):
+        for w in np.nonzero(table[srow])[0]:
+            bits = int(table[srow, w])
+            while bits:
+                b = bits & -bits
+                configs.append((srow - cfg.state_offset,
+                                int(w) * 32 + b.bit_length() - 1))
+                bits ^= b
+    return configs
 
 
 def make_batch_checker3(model: Model, cfg: DenseConfig):

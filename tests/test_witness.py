@@ -112,3 +112,101 @@ def test_svg_renders_without_lineage():
     assert w is not None       # works without the raw history too
     assert "maximal_linearization" in w
     assert render_witness_svg(w).startswith("<svg")
+
+
+# -- windowed / big-history reconstruction (VERDICT r2 item 4) -------------
+
+def test_effort_cap_raises_not_none():
+    """A tiny cap must raise WitnessEffortExceeded — the silent None of
+    round 2 is gone."""
+    from jepsen_etcd_demo_tpu.checkers.witness import WitnessEffortExceeded
+
+    rng = random.Random(0x21)
+    h = mutate_history(rng, gen_register_history(rng, n_ops=60, n_procs=6))
+    enc = encode_register_history(h, k_slots=16)
+    if check_events_oracle(enc, CASRegister()).valid:
+        h = mutate_history(rng, h)
+        enc = encode_register_history(h, k_slots=16)
+    with pytest.raises(WitnessEffortExceeded):
+        reconstruct_witness(enc, CASRegister(), h, effort_cap=3)
+
+
+def test_windowed_matches_full_reconstruction():
+    """The windowed replay (dense-kernel frontier recovery + bounded
+    window) must name the same failing op as the full replay."""
+    from jepsen_etcd_demo_tpu.checkers.witness import (
+        reconstruct_witness_windowed)
+    from jepsen_etcd_demo_tpu.ops import wgl3
+
+    rng = random.Random(0x22)
+    model = CASRegister()
+    found = 0
+    for i in range(20):
+        h = mutate_history(rng,
+                           gen_register_history(rng, n_ops=80, n_procs=5))
+        enc = encode_register_history(h, k_slots=16)
+        res = wgl3.check_encoded3(enc, model)
+        if res["valid"] is not False:
+            continue
+        found += 1
+        full = reconstruct_witness(enc, model, h)
+        win = reconstruct_witness_windowed(enc, model, res["dead_step"], h,
+                                           window=4)
+        assert full is not None and win is not None
+        assert win["op"] == full["op"]
+        assert win["dead_step"] == full["dead_step"]
+        assert "window_start_step" in win
+        if found >= 5:
+            break
+    assert found >= 3, "fuzz produced too few invalid histories"
+
+
+def test_invalid_10k_history_gets_witness_fast(tmp_path):
+    """The round-2 gap verbatim: an invalid 10k-op history must produce
+    linear.json naming the failed op, in seconds (the kernel recovers the
+    frontier; the host replays only a bounded window)."""
+    import time
+
+    rng = random.Random(0x23)
+    h = gen_register_history(rng, n_ops=10_000, n_procs=8, p_info=0.0)
+    # Corrupt a late read deterministically: find the last ok-read and
+    # replace its value with one never written (writes draw 0-4).
+    for j in range(len(h) - 1, -1, -1):
+        if h[j].type == "ok" and h[j].f == "read":
+            h[j] = Op(type="ok", f="read", value=6, process=h[j].process,
+                      time=h[j].time, index=h[j].index)
+            break
+    checker = Linearizable(model="cas-register")
+    t0 = time.monotonic()
+    res = checker.check({}, h, {"store_dir": str(tmp_path)})
+    wall = time.monotonic() - t0
+    assert res["valid"] is False
+    assert "witness" in res, "witness must never be silently absent"
+    assert res["witness"] != "skipped", \
+        "windowed reconstruction should handle a register history"
+    assert "read" in res["failed_op"]
+    assert (tmp_path / "linear.json").exists()
+    w = json.loads((tmp_path / "linear.json").read_text())
+    assert w["valid"] is False
+    # ~5.5 s measured on the CPU test platform (sub-second of that is the
+    # witness; target envelope is <10 s on the TPU product path).
+    assert wall < 60, f"witness extraction took {wall:.1f}s"
+
+
+def test_skipped_marker_when_reconstruction_infeasible(tmp_path, monkeypatch):
+    """When BOTH the full replay and the windowed fallback are defeated,
+    the result and the store must carry an explicit skipped witness with
+    the dead_step context — never a silent absence."""
+    from jepsen_etcd_demo_tpu.checkers import witness as wmod
+
+    monkeypatch.setattr(wmod, "MAX_WITNESS_EVENTS", 1)
+    checker = Linearizable(model="cas-register")
+    res = checker.check({}, _stale_read_history(),
+                        {"store_dir": str(tmp_path)})
+    assert res["valid"] is False
+    assert res["witness"] == "skipped"
+    assert "witness_detail" in res
+    assert (tmp_path / "linear.json").exists()
+    w = json.loads((tmp_path / "linear.json").read_text())
+    assert w["witness"] == "skipped"
+    assert w["dead_step"] == res["dead_step"]
