@@ -119,11 +119,20 @@ class Linearizable(Checker):
         from .witness import (WitnessEffortExceeded, reconstruct_witness,
                               reconstruct_witness_windowed, write_witness)
 
+        from .witness import WITNESS_WINDOW_STEPS
+
         dead_step = int(res.get("dead_step", -1))
         try:
             w = reconstruct_witness(enc, self.model, history)
         except WitnessEffortExceeded as e:
             try:
+                if dead_step <= WITNESS_WINDOW_STEPS:
+                    # The window would start at step 0 — an exact re-run
+                    # of the replay that just blew the cap. Go straight
+                    # to the skipped marker.
+                    raise ValueError(
+                        "death inside the first window; windowed replay "
+                        "would repeat the capped full replay")
                 w = reconstruct_witness_windowed(
                     enc, self.model, dead_step, history)
             except (WitnessEffortExceeded, ValueError) as e2:

@@ -599,6 +599,7 @@ def check_batch_encoded_auto(encs: Sequence[EncodedHistory],
                     one = wgl3.check_steps3_long(s, model, cfg)
                     one["op_count"] = s.n_ops
                     one["table_cells"] = cfg.n_states * cfg.n_masks
+                    one.setdefault("kernel", "wgl3-dense-chunked")
                     results[i] = one
                 kernels.add("wgl3-dense-chunked")
             elif jax.device_count() > 1 and len(sub) > 1:
