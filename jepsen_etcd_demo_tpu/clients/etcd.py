@@ -20,7 +20,8 @@ from typing import Any, Optional
 
 import httpx
 
-from .base import ClientError, IndeterminateDequeue, NotFound, Timeout
+from .base import (ClientError, IndeterminateDequeue, NotFound,
+                   RetriesExhausted, Timeout)
 
 ETCD_KEY_MISSING = 100   # etcd v2 errorCode for absent key (reference :104)
 ETCD_CAS_FAILED = 101    # compare failed
@@ -160,7 +161,7 @@ class EtcdClient:
                 continue
             self._raise_for(body)
             return new
-        raise Timeout("swap retry budget exhausted")
+        raise RetriesExhausted("swap retry budget exhausted: 64 determinate CAS failures")
 
 
 def etcd_conn_factory(port: int = 2379, timeout_s: float = 5.0):

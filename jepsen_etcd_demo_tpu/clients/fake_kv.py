@@ -29,7 +29,8 @@ import asyncio
 import random
 from typing import Any, Optional
 
-from .base import IndeterminateDequeue, NotFound, Timeout
+from .base import (IndeterminateDequeue, NotFound, RetriesExhausted,
+                   Timeout)
 
 
 class FakeKVStore:
@@ -233,6 +234,6 @@ class FakeKVStore:
                     return new
             except NotFound:
                 raise
-        raise Timeout("swap retry budget exhausted")
+        raise RetriesExhausted("swap retry budget exhausted: 64 determinate CAS failures")
 
 

@@ -583,3 +583,38 @@ class TestEtcdQueue:
             assert ei.value.value == "5"
             await client.close()
         go(t())
+
+
+def test_swap_retry_exhaustion_is_determinate_fail():
+    """64 determinate CAS failures = the swap definitely never applied:
+    RetriesExhausted is a ClientError (-> :fail), NOT a Timeout (-> :info)
+    — spurious open-forever ops multiply the checker's search space."""
+    import asyncio
+
+    from jepsen_etcd_demo_tpu.clients.base import (ClientError,
+                                                   RetriesExhausted, Timeout)
+    from jepsen_etcd_demo_tpu.clients.fake_kv import FakeKVStore
+
+    assert issubclass(RetriesExhausted, ClientError)
+    assert not issubclass(RetriesExhausted, Timeout)
+
+    async def scenario():
+        cluster = FakeKVStore(["n1"], seed=1)
+        await cluster.reset("n1", "k", "0")
+
+        async def contended_swap():
+            # fn returns a NEW value each call, but another writer always
+            # sneaks in between read and cas: force it by mutating under
+            # the swap's feet via the fn side effect.
+            def fn(cur):
+                # Sabotage: bump the stored value so the upcoming CAS
+                # (predicated on `cur`) must fail determinately.
+                cluster.data["k"] = str(int(cluster.data["k"]) + 1)
+                return str(int(cur) + 100)
+
+            await cluster.swap("n1", "k", fn)
+
+        with pytest.raises(RetriesExhausted):
+            await contended_swap()
+
+    asyncio.run(scenario())
