@@ -176,7 +176,7 @@ class Linearizable(Checker):
             results, kernel = wgl3_pallas.check_batch_encoded_auto(
                 [enc], self.model)
             out = results[0]
-            backend = ("jax-dense-pallas" if kernel.endswith("pallas")
+            backend = ("jax-dense-pallas" if "pallas" in kernel
                        else "jax-dense")
             return {"valid": out["valid"], "backend": backend,
                     "op_count": enc.n_ops,

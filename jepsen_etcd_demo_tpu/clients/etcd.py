@@ -146,7 +146,12 @@ class EtcdClient:
                 continue   # lost the race to another consumer
             self._raise_for(del_body)
             return value
-        raise Timeout("dequeue retry budget exhausted")
+        # Every retry lost its claim DETERMINATELY (compare-and-delete
+        # observed missing/stale); an indeterminate delete raised
+        # IndeterminateDequeue above. Same determinate-:fail reasoning as
+        # swap's exhaustion.
+        raise RetriesExhausted(
+            "dequeue retry budget exhausted: 64 determinate claim losses")
 
     async def swap(self, key: str, fn) -> str:
         """Atomic read-modify-write via prevIndex CAS retries — the client-

@@ -183,3 +183,46 @@ def test_batched_general_overflow_escalates_exactly():
     # batched pass) and its verdict is exact, not "unknown".
     assert results[0]["kernel"] != "wgl2-sort-batched"
     assert results[0]["valid"] in (True, False)
+
+
+def test_grouped_kernel_bit_identical_ragged():
+    """The grouped kernel (G histories per program, interpret mode) must
+    match the XLA kernel bit for bit on a ragged mixed batch — including
+    per-history death metadata under group padding."""
+    rng = random.Random(0x6A)
+    encs = []
+    for i in range(11):          # 11 % 8 != 0: exercises group padding
+        h = gen_register_history(rng, n_ops=45, n_procs=6)
+        if i % 3 == 0:
+            h = mutate_history(rng, h)
+        encs.append(encode_register_history(h, k_slots=16))
+    cfg, steps, r_cap = wgl3.batch_steps3(encs, MODEL)
+    arrays = wgl3.stack_steps3(steps, r_cap)
+    import numpy as np
+    ref = np.asarray(wgl3.cached_batch_checker3_packed(MODEL, cfg)(*arrays))
+    got = np.asarray(wgl3_pallas.cached_batch_checker_pallas_grouped(
+        MODEL, cfg, group=8, interpret=True)(*arrays))
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_grouped_kernel_multi_chunk_carry():
+    """Histories longer than one grouped step-chunk: scratch-carried
+    search state across grid chunks must stay bit-identical."""
+    from jepsen_etcd_demo_tpu.ops.limits import KernelLimits, limits, \
+        set_limits
+
+    rng = random.Random(0x6B)
+    encs = [encode_register_history(
+        gen_register_history(rng, n_ops=120, n_procs=6), k_slots=16)
+        for _ in range(8)]
+    cfg, steps, r_cap = wgl3.batch_steps3(encs, MODEL)
+    arrays = wgl3.stack_steps3(steps, r_cap)
+    import numpy as np
+    ref = np.asarray(wgl3.cached_batch_checker3_packed(MODEL, cfg)(*arrays))
+    prev = set_limits(KernelLimits(pallas_step_chunk=128))  # RC=128/8=16
+    try:
+        got = np.asarray(wgl3_pallas.make_batch_checker_pallas_grouped(
+            MODEL, cfg, group=8, interpret=True)(*arrays))
+    finally:
+        set_limits(prev)
+    np.testing.assert_array_equal(ref, got)
