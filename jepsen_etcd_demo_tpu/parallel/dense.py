@@ -74,16 +74,19 @@ def sharded_batch_checker3_packed(model: Model, cfg: DenseConfig,
 
 def sharded_batch_checker_pallas(model: Model, cfg: DenseConfig, mesh: Mesh,
                                  axis: str = "batch",
-                                 interpret: bool = False):
+                                 interpret: bool = False,
+                                 group: int = 1):
     """The fused pallas kernel under shard_map: each device launches its
-    own (B/D, NC) grid over its batch shard. Same signature and packed
+    own (B/D, NC) grid over its batch shard — the GROUPED grid when
+    `group` > 1 (local shard batch must divide into groups; the router
+    guarantees it via the batch multiple). Same signature and packed
     i32[B, 5] result as the sharded XLA checker. The prep half stays a
     plain sharded XLA jit (separate dispatch — the two pipeline, see
     make_batch_checker_pallas)."""
     from ..ops import wgl3_pallas
 
     key = ("pallas-sharded", model.cache_key(), cfg, _mesh_key(mesh), axis,
-           interpret)
+           interpret, group)
     if key in _CACHE:
         return _CACHE[key]
 
@@ -94,8 +97,12 @@ def sharded_batch_checker_pallas(model: Model, cfg: DenseConfig, mesh: Mesh,
                       NamedSharding(mesh, P(axis, None))),
         out_shardings=(NamedSharding(mesh, P(axis, None, None, None)),
                        NamedSharding(mesh, P(axis, None))))
-    launcher = wgl3_pallas.cached_pallas_launcher(model, cfg,
-                                                  interpret=interpret)
+    if group > 1:
+        launcher = wgl3_pallas.local_pallas_launcher_grouped(
+            model, cfg, group, interpret=interpret)
+    else:
+        launcher = wgl3_pallas.cached_pallas_launcher(model, cfg,
+                                                      interpret=interpret)
     d = mesh.shape[axis]
 
     @functools.lru_cache(maxsize=None)
@@ -123,6 +130,26 @@ def sharded_batch_checker_pallas(model: Model, cfg: DenseConfig, mesh: Mesh,
     return check
 
 
+def batch_multiple(model: Model, cfg: DenseConfig, mesh: Mesh,
+                   n_steps: int | None = None,
+                   batch: int | None = None,
+                   axis: str = "batch") -> int:
+    """The [B]-axis padding multiple the routed sharded checker needs:
+    D devices, times the pallas group when the grouped kernel will run
+    (each device's shard must split into whole groups)."""
+    from ..ops import wgl3_pallas
+
+    d = mesh.shape[axis]
+    sp = max(8, (cfg.n_states + 7) // 8 * 8)
+    G = limits().pallas_group
+    local_batch = None if batch is None else (batch + d - 1) // d
+    if (sp == 8 and G > 1 and local_batch is not None and local_batch >= G
+            and wgl3_pallas.use_pallas(
+                cfg, n_steps, (local_batch + G - 1) // G * G)):
+        return d * G
+    return d
+
+
 def sharded_packed_batch_checker(model: Model, cfg: DenseConfig, mesh: Mesh,
                                  n_steps: int | None = None,
                                  batch: int | None = None,
@@ -130,8 +157,9 @@ def sharded_packed_batch_checker(model: Model, cfg: DenseConfig, mesh: Mesh,
     """Mesh-sharded twin of wgl3_pallas.packed_batch_checker — THE routing
     point for multi-device dense launches: (packed_check_fn, kernel_name).
     Routes to the pallas shard_map form on a live TPU backend when the
-    PER-DEVICE shard fits the pallas envelope, else the sharded XLA
-    kernel."""
+    PER-DEVICE shard fits the pallas envelope — grouped per shard under
+    the same conditions as the single-device router — else the sharded
+    XLA kernel. `batch` must already be padded to batch_multiple()."""
     from ..ops import wgl3_pallas
 
     if n_steps is not None and n_steps > limits().long_scan_max:
@@ -140,6 +168,13 @@ def sharded_packed_batch_checker(model: Model, cfg: DenseConfig, mesh: Mesh,
     d = mesh.shape[axis]
     local_batch = None if batch is None else (batch + d - 1) // d
     if wgl3_pallas.use_pallas(cfg, n_steps, local_batch):
+        G = limits().pallas_group
+        sp = max(8, (cfg.n_states + 7) // 8 * 8)
+        if (sp == 8 and G > 1 and local_batch is not None
+                and local_batch >= G and local_batch % G == 0):
+            return (sharded_batch_checker_pallas(model, cfg, mesh, axis,
+                                                 group=G),
+                    "wgl3-dense-pallas-grouped-sharded")
         return (sharded_batch_checker_pallas(model, cfg, mesh, axis),
                 "wgl3-dense-pallas-sharded")
     return (sharded_batch_checker3_packed(model, cfg, mesh, axis),
@@ -172,9 +207,9 @@ def check_steps_sharded(model: Model, cfg: DenseConfig, steps,
     once, strip pads. Returns (per-history results, kernel_name)."""
     if mesh is None:
         mesh = batch_mesh()
-    arrays, b = pad_batch_arrays(
-        wgl3.stack_steps3(steps, r_cap),
-        int(np.prod(list(mesh.shape.values()))))
+    mult = batch_multiple(model, cfg, mesh, n_steps=r_cap,
+                          batch=len(steps))
+    arrays, b = pad_batch_arrays(wgl3.stack_steps3(steps, r_cap), mult)
     check, name = sharded_packed_batch_checker(
         model, cfg, mesh, n_steps=r_cap, batch=arrays[2].shape[0])
     out = wgl3.unpack_np(np.asarray(check(*(jnp.asarray(a)

@@ -135,3 +135,37 @@ def test_independent_checker_rides_sharded_batch(tmp_path):
     assert res["key_count"] == 6
     for key_res in res["results"].values():
         assert key_res["backend"] == "jax-dense-batched"
+
+
+def test_pallas_grouped_sharded_interpret_matches_xla_sharded():
+    """The GROUPED pallas kernel under shard_map (each device runs a
+    (B/D/G, NC) grid over its shard) must be bit-identical to the sharded
+    XLA kernel — the real-pod form of the production fast path."""
+    encs = _corpus(32, seed=0x6C, n_ops=30)   # B/D = 4 groups of G=... 
+    mesh = pdense.batch_mesh()
+    d = mesh.shape["batch"]
+    cfg, steps, r_cap = wgl3.batch_steps3(encs, MODEL)
+    # Pad so each device's shard splits into whole groups of 2.
+    arrays, _ = pdense.pad_batch_arrays(wgl3.stack_steps3(steps, r_cap),
+                                        d * 2)
+    jarrays = tuple(jnp.asarray(a) for a in arrays)
+    xla = np.asarray(
+        pdense.sharded_batch_checker3_packed(MODEL, cfg, mesh)(*jarrays))
+    pal = np.asarray(
+        pdense.sharded_batch_checker_pallas(MODEL, cfg, mesh,
+                                            interpret=True,
+                                            group=2)(*jarrays))
+    np.testing.assert_array_equal(xla, pal)
+
+
+def test_batch_multiple_routing():
+    """batch_multiple returns D on the CPU mesh (no live pallas) and the
+    checker name stays the sharded XLA kernel."""
+    encs = _corpus(16, seed=0x6D)
+    mesh = pdense.batch_mesh()
+    cfg, steps, r_cap = wgl3.batch_steps3(encs, MODEL)
+    assert pdense.batch_multiple(MODEL, cfg, mesh, n_steps=r_cap,
+                                 batch=len(steps)) == mesh.shape["batch"]
+    _, name = pdense.sharded_packed_batch_checker(
+        MODEL, cfg, mesh, n_steps=r_cap, batch=16)
+    assert name == "wgl3-dense-sharded"
