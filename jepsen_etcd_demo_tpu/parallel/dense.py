@@ -72,6 +72,29 @@ def sharded_batch_checker3_packed(model: Model, cfg: DenseConfig,
     return _CACHE[key]
 
 
+def sharded_batch_checker2(model: Model, cfg2, mesh: Mesh,
+                           axis: str = "batch"):
+    """The SORT kernel (ops/wgl2.py — the non-dense production path:
+    queue/multi-register geometries), batch-sharded like the dense
+    kernel: jitted check(slot_tabs[B,R,K,4], slot_active[B,R,K],
+    targets[B,R]) -> dict of [B] arrays partitioned over `axis`. B must
+    be a multiple of the axis size."""
+    from ..ops import wgl2
+
+    key = ("sort-sharded", model.cache_key(), cfg2, _mesh_key(mesh), axis)
+    if key not in _CACHE:
+        fn = jax.vmap(wgl2._check_one_fn(model, cfg2))
+        in_sh = (NamedSharding(mesh, P(axis, None, None, None)),
+                 NamedSharding(mesh, P(axis, None, None)),
+                 NamedSharding(mesh, P(axis, None)))
+        out_sh = NamedSharding(mesh, P(axis))
+        _CACHE[key] = jax.jit(
+            fn, in_shardings=in_sh,
+            out_shardings={"survived": out_sh, "overflow": out_sh,
+                           "dead_step": out_sh, "max_frontier": out_sh})
+    return _CACHE[key]
+
+
 def sharded_batch_checker_pallas(model: Model, cfg: DenseConfig, mesh: Mesh,
                                  axis: str = "batch",
                                  interpret: bool = False,

@@ -169,3 +169,38 @@ def test_batch_multiple_routing():
     _, name = pdense.sharded_packed_batch_checker(
         MODEL, cfg, mesh, n_steps=r_cap, batch=16)
     assert name == "wgl3-dense-sharded"
+
+
+def test_sort_kernel_sharded_matches_and_partitions():
+    """The non-dense production path (sort kernel) shards its batch axis
+    too: dict outputs partitioned over the mesh, values identical to the
+    unsharded batched checker."""
+    from jepsen_etcd_demo_tpu.models import FIFOQueue
+    from jepsen_etcd_demo_tpu.ops import wgl2, wgl3
+    from jepsen_etcd_demo_tpu.ops.encode import (encode_history,
+                                                 encode_return_steps)
+    from jepsen_etcd_demo_tpu.utils.fuzz import gen_queue_history
+
+    model = FIFOQueue()
+    rng = random.Random(0x99)
+    steps = []
+    for _ in range(16):
+        h = gen_queue_history(rng, n_ops=12, n_procs=3, fifo=True)
+        enc = encode_history(model.prepare_history(h), model, k_slots=8)
+        steps.append(encode_return_steps(enc))
+    r_cap = max(s.n_steps for s in steps)
+    padded = [s.padded_to(r_cap) for s in steps]
+    tabs = np.stack([p.slot_tabs for p in padded])
+    act = np.stack([p.slot_active for p in padded])
+    tgt = np.stack([p.targets for p in padded])
+    cfg2 = wgl2.make_config(model, 8, 64,
+                            max(s.max_value for s in steps))
+    mesh = pdense.batch_mesh()
+    sharded = pdense.sharded_batch_checker2(model, cfg2, mesh)
+    out = sharded(jnp.asarray(tabs), jnp.asarray(act), jnp.asarray(tgt))
+    assert out["survived"].sharding.spec[0] == "batch"
+    ref = wgl2.cached_batch_checker2(model, cfg2)(
+        jnp.asarray(tabs), jnp.asarray(act), jnp.asarray(tgt))
+    for k in ("survived", "overflow", "dead_step", "max_frontier"):
+        np.testing.assert_array_equal(np.asarray(ref[k]),
+                                      np.asarray(out[k]))
