@@ -285,8 +285,10 @@ def bench_100k(model) -> dict:
 def main():
     import jax
 
+    from jepsen_etcd_demo_tpu.cli.main import enable_compilation_cache
     from jepsen_etcd_demo_tpu.models import CASRegister
 
+    enable_compilation_cache()   # kernel_cold_s amortizes across runs
     model = CASRegister()
     # SURVEY.md §5.1: jax.profiler traces for the checker kernel itself.
     # Opt-in (BENCH_PROFILE=<dir> or --profile <dir>) so the driver's plain
