@@ -37,16 +37,45 @@ results match wgl3 bit-for-bit; tests run it in interpreter mode on CPU
 against the XLA kernel and the oracle (tests/test_wgl3_pallas.py).
 
 Tuning notes (measured on TPU v5e, 1024x150-op corpus, k=12/S=8; kept
-here so the next round doesn't re-run dead ends):
-  * per-history kernel cost ~0.23 ms (~3 us/return step) + a fixed
-    ~0.11 s device->host fetch round trip on the tunneled backend;
-  * replacing the K-way `lax.switch` in prune with a branchless dynamic
-    shift+roll+select measured 12% SLOWER — Mosaic lowers the switch to a
-    real branch, and the two dynamic ops cost more than one static branch;
-  * unrolling two closure sweeps per while iteration measured 45% slower:
-    the typical step is one productive sweep + one mandatory confirming
-    sweep, so extra unrolling only adds work. The two-sweep floor is
-    inherent to fixpoint detection, not loop overhead.
+here so the next round doesn't re-run dead ends). Round-4 profiling
+(jax.profiler device-busy, not wall: on the tunneled axon backend wall
+adds a fixed ~0.1 s dispatch+fetch round trip that is NOT kernel time)
+re-attributed the r3 numbers and drove a 2.4x kernel redesign, 110 ms ->
+45 ms device time for the grouped corpus launch:
+  * The data-dependent fixpoint `while_loop` was ~60% of device time:
+    Mosaic pays ~4 us per loop entry/exit (scalar cond round trip +
+    carry materialization), and per-sweep popcount reduces rode along.
+    Now each step runs a PAIR of sweeps unconditionally, loops on pairs
+    only while the pair's 2nd sweep grew (vector compare, one scalar
+    cond per step typical), and takes the metrics popcount ONCE after
+    convergence. Bit-identical: extra sweeps past the fixpoint are
+    idempotent, and converged T gives the same popcount the per-sweep
+    loop exited with.
+  * Padded step tails were ~40% of all steps (R bucketing): the launch
+    now prefetches per-history step counts and bounds the scan trip by
+    the group max (`trip = clip(max_len - c*RC, 0, RC)`) — pad steps
+    never execute at all.
+  * The s-loop's [*,Sp,1]-shaped bit-extract + where(select) chain was
+    broadcast-bound: broadcasting the colmask column ONCE per slot to
+    full [*,Sp,W] width and selecting with arithmetic masks
+    (0 - ((colb >> s) & 1)) dropped 61 -> 45 ms.
+  * Dead ends so the next round doesn't re-run them: tree-OR of the
+    s-loop partials — no change (16 independent per-vreg chains already
+    fill the VPU pipeline); packing 4 targets per SMEM word — no change
+    (g3 scalar reads are not a bottleneck: ablating them entirely moved
+    0.3 ms); 2-sweep speculation with host-side escalation — dead, the
+    flag rate is 100% of corpus histories (every history has at least
+    one step needing a 2nd pair, so everything would re-run); G=32/64
+    groups — Mosaic compile failure (scoped-VMEM live set), and the old
+    G=32 measurement was already neutral; replacing the K-way prune
+    switch (per-history kernel) with dynamic shift+roll+select — 12%
+    slower (r3 measurement, still believed).
+  * Calibration: a peak microbench (independent 8-chain int32 ALU loop,
+    zero memory traffic) sustains ~3.3 G vreg-ops/s (~3.4 T word-ops/s)
+    on this v5e core — the honest VPU ceiling for this kernel's op mix,
+    vs the 6.1 T spec-sheet estimate bench.py's roofline also reports.
+    Serial dependent chains sustain only ~0.55 G vreg-ops/s, which is
+    why ILP shape (not op count) dominates kernel cost here.
 """
 
 from __future__ import annotations
@@ -72,7 +101,10 @@ def prepare_pallas_batch(model: Model, cfg: DenseConfig, slot_tabs, slot_active,
 
     slot_tabs [B,R,K,4] i32, slot_active [B,R,K] bool, targets [B,R] i32
     (the batched return-major arrays of wgl3.batch_arrays3).
-    Returns (colmask u32[B,R,Sp,128], targets i32[B,R]).
+    Returns (colmask u32[B,R,Sp,128], targets i32[B,R], lengths i32[B]):
+    `lengths` counts each history's real (non-pad) return steps so the
+    kernel can bound its scan trip and skip the padded tail entirely
+    (pad targets are -1 and always a suffix — wgl3.stack_steps3).
     """
     K, S, off = cfg.k_slots, cfg.n_states, cfg.state_offset
     state_vals = jnp.arange(S, dtype=jnp.int32) - off
@@ -94,7 +126,9 @@ def prepare_pallas_batch(model: Model, cfg: DenseConfig, slot_tabs, slot_active,
         return jnp.pad(colmask, ((0, 0), (0, sp - S), (0, 128 - K)))
 
     colmask = jax.vmap(pack)(slot_tabs, slot_active)
-    return colmask, targets.astype(jnp.int32)
+    tg = targets.astype(jnp.int32)
+    lengths = jnp.sum((tg >= 0).astype(jnp.int32), axis=1)
+    return colmask, tg, lengths
 
 
 def _kernel_body(cfg: DenseConfig):
@@ -121,15 +155,20 @@ def _kernel_body(cfg: DenseConfig):
                          jnp.where(word_ok, full, jnp.uint32(0)))
 
     def closure(T, cm, allowed):
-        """One Gauss-Seidel sweep over all K slots (static unroll)."""
+        """One Gauss-Seidel sweep over all K slots (static unroll).
+
+        The colmask column is broadcast to full [Sp, W] width ONCE per
+        slot and the source-state select is an arithmetic mask
+        (0 - bit), not a [Sp,1]-shaped where: the narrow-shape variant
+        was broadcast-bound (r4 tuning notes)."""
         for j in range(K):
             src = T & allowed                                # [Sp, W]
-            col = cm[:, j:j + 1]                             # u32[Sp, 1]
+            colb = jnp.broadcast_to(cm[:, j:j + 1], (Sp, W))  # u32[Sp, W]
             fired = jnp.zeros_like(T)
             for s in range(S):
-                sel = ((col >> jnp.uint32(s)) & 1) != 0      # [Sp,1]
-                fired = fired | jnp.where(sel, src[s:s + 1, :],
-                                          jnp.uint32(0))
+                selm = (jnp.uint32(0)
+                        - ((colb >> jnp.uint32(s)) & jnp.uint32(1)))
+                fired = fired | (selm & src[s:s + 1, :])
             if j < 5:
                 T = T | ((fired & jnp.uint32(_LO_MASK[j]))
                          << jnp.uint32(1 << j))
@@ -150,12 +189,21 @@ def _kernel_body(cfg: DenseConfig):
             return f
         return jax.lax.switch(t, [br(j) for j in range(K)], None)
 
-    def body(tg_ref, cm_ref, out_ref, T_s, meta_s):
+    # Paired-sweep fixpoint: pairs may overshoot cfg.rounds by one sweep,
+    # which is sound because extra sweeps past the fixpoint are
+    # idempotent and _require_converging_cap guarantees the cap is never
+    # a truncating one (r4 tuning notes — the per-step while_loop entry
+    # was ~4 us, so a pair per loop trip halves the scalar conds and
+    # drops the per-sweep popcounts entirely).
+    MAX_PAIRS = (cfg.rounds + 1) // 2
+
+    def body(ln_ref, tg_ref, cm_ref, out_ref, T_s, meta_s):
         """Grid is (B, NC): history b, step-chunk c. The colmask block is
         one RC-step chunk (long histories would blow the 16 MiB VMEM limit
         as a single block); the search state (table + metadata) carries
         across chunks in scratch, which persists over the sequential TPU
-        grid."""
+        grid. The scan trip is bounded by the history's REAL step count
+        (ln_ref scalar prefetch): bucket-pad steps never execute."""
         b = pl.program_id(0)
         c = pl.program_id(1)
         NC = pl.num_programs(1)
@@ -174,48 +222,55 @@ def _kernel_body(cfg: DenseConfig):
             meta_s[2] = 1    # max_frontier
             meta_s[3] = 0    # configs_explored
 
+        trip = jnp.clip(ln_ref[b] - c * RC, 0, RC)
+
         def step(i, carry):
             T, dead, dead_step, maxf, cfgs = carry
             r = c * RC + i
-            t_raw = tg_ref[b, r]
-            is_pad = t_raw < 0
-            t = jnp.maximum(t_raw, 0)
+            t = jnp.maximum(tg_ref[b, r], 0)   # trip excludes pads (-1)
             allowed = allowed_mask(t)
             cm = cm_ref[0, i]                                # u32[Sp, 128]
 
+            # One sweep, then PAIRS of sweeps while the last sweep still
+            # grew (vector compare; fixpoint detection unchanged, so the
+            # result is bit-identical — extra sweeps past the fixpoint
+            # are idempotent, and the metrics popcount of a converged
+            # table equals the one the per-sweep loop exited with).
+            # Single-history steps are often already saturated (first
+            # sweep silent): those pay exactly the old 1 sweep + 1 cond,
+            # while multi-sweep steps pay roughly half the old scalar
+            # conds.
+            T1 = closure(T, cm, allowed)
+
             def wbody(st):
-                Tw, n_prev, _ch, rounds = st
-                Tw = closure(Tw, cm, allowed)
-                n_now = jnp.sum(jax.lax.population_count(Tw),
-                                dtype=jnp.int32)
-                return Tw, n_now, n_now > n_prev, rounds + 1
+                Tw, _ch, pairs = st
+                Ta = closure(Tw, cm, allowed)
+                Tb = closure(Ta, cm, allowed)
+                return Tb, jnp.any(Ta != Tb), pairs + 1
 
             def wcond(st):
-                return st[2] & (st[3] < cfg.rounds)
+                return st[1] & (st[2] < MAX_PAIRS)
 
-            n0 = jnp.sum(jax.lax.population_count(T), dtype=jnp.int32)
-            T, n, _c, _r = jax.lax.while_loop(
-                wcond, wbody, (T, n0, ~is_pad, jnp.int32(0)))
+            T, _ch, _p = jax.lax.while_loop(
+                wcond, wbody, (T1, jnp.any(T1 != T), jnp.int32(0)))
+            n = jnp.sum(jax.lax.population_count(T), dtype=jnp.int32)
 
             pruned = prune(T, t, allowed)
-            T_new = jnp.where(is_pad, T, pruned)
-            alive = jnp.any(T_new != 0)
-            died = ~is_pad & ~dead & ~alive
+            alive = jnp.any(pruned != 0)
+            died = ~dead & ~alive
             dead = dead | died
-            T_new = jnp.where(dead, jnp.zeros_like(T_new), T_new)
+            T_new = jnp.where(dead, jnp.zeros_like(pruned), pruned)
             return (T_new, dead,
                     jnp.where(died & (dead_step < 0), r, dead_step),
                     jnp.maximum(maxf, n),
-                    # Pad steps (scan-bucket AND chunk-alignment pads) must
-                    # not count: keeps the metric padding-invariant and
-                    # bit-identical to the XLA kernel whatever the chunking.
-                    cfgs + jnp.where(is_pad, 0, n))
+                    cfgs + n)
 
         # cfgs accumulates as i32 (a scalar f32 bitcast has no Mosaic
         # lowering); exact up to 2^31 summed configs, beyond which the f32
         # accumulator of the XLA kernel is approximate anyway.
         init = (T_s[:, :], meta_s[0] != 0, meta_s[1], meta_s[2], meta_s[3])
-        T, dead, dead_step, maxf, cfgs = jax.lax.fori_loop(0, RC, step, init)
+        T, dead, dead_step, maxf, cfgs = jax.lax.fori_loop(0, trip, step,
+                                                           init)
         T_s[:, :] = T
         meta_s[0] = dead.astype(jnp.int32)
         meta_s[1] = dead_step
@@ -245,16 +300,35 @@ def _kernel_body(cfg: DenseConfig):
     return bind
 
 
+def _require_converging_cap(cfg: DenseConfig) -> None:
+    """The paired-sweep loops assume cfg.rounds never TRUNCATES the
+    closure (pairs can overshoot a sub-convergence cap, diverging from
+    the XLA kernel's exact per-sweep cut-off). With the default
+    max_rounds=0 the cap is k_slots, which provably bounds the fixpoint
+    (each firing sets a distinct slot bit), so this only rejects explicit
+    sub-convergence caps — no production config sets one."""
+    if cfg.max_rounds and cfg.max_rounds < cfg.k_slots:
+        raise ValueError(
+            f"pallas kernels require a converging sweep cap: "
+            f"max_rounds={cfg.max_rounds} < k_slots={cfg.k_slots} would "
+            f"truncate the closure; use the XLA kernel for truncated "
+            f"sweeps")
+
+
 def local_pallas_launcher(model: Model, cfg: DenseConfig,
                           interpret: bool = False):
     """The pallas-call half of the checker: launch(B, R) -> jitted
-    (tg i32[B,R], cm u32[B,R,Sp,128]) -> i32[B,5]. Exposed separately so
-    the mesh-sharded form (parallel/dense.py) can run it under shard_map,
-    each device launching its own (B/D, NC) grid over its batch shard."""
+    (ln i32[B], tg i32[B,R], cm u32[B,R,Sp,128]) -> i32[B,5]. Exposed
+    separately so the mesh-sharded form (parallel/dense.py) can run it
+    under shard_map, each device launching its own (B/D, NC) grid over
+    its batch shard. `ln` is the per-history real step count
+    (prepare_pallas_batch's third output) bounding the kernel's scan
+    trip."""
     max_k = limits().max_k_pallas
     if cfg.k_slots > max_k:
         raise ValueError(f"pallas kernel supports k_slots <= {max_k}, "
                          f"got {cfg.k_slots}")
+    _require_converging_cap(cfg)
     Sp = max(8, (cfg.n_states + 7) // 8 * 8)
     W = 1 << (cfg.k_slots - 5)
     row = int(model.init_state()) + cfg.state_offset
@@ -272,14 +346,16 @@ def local_pallas_launcher(model: Model, cfg: DenseConfig,
         NC = (R + RC - 1) // RC
         R_pad = NC * RC
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,   # targets: whole [B,R_pad] table, SMEM
+            # lengths [B] + targets [B,R_pad], both whole in SMEM
+            num_scalar_prefetch=2,
             grid=(B, NC),
             in_specs=[
                 pl.BlockSpec((1, RC, Sp, 128),
-                             lambda b, c, tg_ref: (b, c, 0, 0),
+                             lambda b, c, ln_ref, tg_ref: (b, c, 0, 0),
                              memory_space=pltpu.VMEM),
             ],
-            out_specs=[pl.BlockSpec((5 * B,), lambda b, c, tg_ref: (0,),
+            out_specs=[pl.BlockSpec((5 * B,),
+                                    lambda b, c, ln_ref, tg_ref: (0,),
                                     memory_space=pltpu.SMEM)],
             scratch_shapes=[
                 pltpu.VMEM((Sp, W), jnp.uint32),   # table carry
@@ -287,7 +363,7 @@ def local_pallas_launcher(model: Model, cfg: DenseConfig,
             ],
         )
 
-        def run(tg, cm):
+        def run(ln, tg, cm):
             if R_pad != R:
                 tg = jnp.pad(tg, ((0, 0), (0, R_pad - R)),
                              constant_values=-1)
@@ -297,7 +373,7 @@ def local_pallas_launcher(model: Model, cfg: DenseConfig,
                 grid_spec=grid_spec,
                 out_shape=[jax.ShapeDtypeStruct((5 * B,), jnp.int32)],
                 interpret=interpret,
-            )(tg, cm)[0].reshape(B, 5)
+            )(ln, tg, cm)[0].reshape(B, 5)
 
         return jax.jit(run)
 
@@ -330,9 +406,9 @@ def make_batch_checker_pallas(model: Model, cfg: DenseConfig,
         fetches once and splits host-side (wgl3.unpack_np). One fetch per
         launch is the difference between ~0.12 s and ~0.6 s per call on a
         tunneled TPU backend (~0.1 s round trip per fetch)."""
-        colmask, tg = prep(slot_tabs, slot_active, targets)
+        colmask, tg, lengths = prep(slot_tabs, slot_active, targets)
         B, R = targets.shape
-        return launch(B, R)(tg, colmask)
+        return launch(B, R)(lengths, tg, colmask)
 
     return check
 
@@ -398,15 +474,17 @@ def _kernel_body_grouped(cfg: DenseConfig, G: int):
 
     def closure(T, cm, allowed):
         """One Gauss-Seidel sweep, all G histories: T u32[G,Sp,W],
-        cm u32[G,Sp,128], allowed u32[G,1,W]."""
+        cm u32[G,Sp,128], allowed u32[G,1,W]. Column broadcast once per
+        slot + arithmetic select masks (r4 tuning notes: the [G,Sp,1]
+        where-chain was broadcast-bound, 61 -> 45 ms)."""
         for j in range(K):
             src = T & allowed
-            col = cm[:, :, j:j + 1]                           # [G,Sp,1]
+            colb = jnp.broadcast_to(cm[:, :, j:j + 1], (G, Sp, W))
             fired = jnp.zeros_like(T)
             for s in range(S):
-                sel = ((col >> jnp.uint32(s)) & 1) != 0       # [G,Sp,1]
-                fired = fired | jnp.where(sel, src[:, s:s + 1, :],
-                                          jnp.uint32(0))
+                selm = (jnp.uint32(0)
+                        - ((colb >> jnp.uint32(s)) & jnp.uint32(1)))
+                fired = fired | (selm & src[:, s:s + 1, :])
             if j < 5:
                 T = T | ((fired & jnp.uint32(_LO_MASK[j]))
                          << jnp.uint32(1 << j))
@@ -440,7 +518,10 @@ def _kernel_body_grouped(cfg: DenseConfig, G: int):
         return jnp.sum(jnp.sum(pc, axis=2, keepdims=True), axis=1,
                        keepdims=True)
 
-    def body(tg_ref, cm_ref, out_ref, T_s, dead_s, step_s, maxf_s, cfgs_s):
+    MAX_PAIRS = (cfg.rounds + 1) // 2
+
+    def body(ln_ref, tg_ref, cm_ref, out_ref, T_s, dead_s, step_s, maxf_s,
+             cfgs_s):
         b = pl.program_id(0)
         c = pl.program_id(1)
         NC = pl.num_programs(1)
@@ -457,6 +538,14 @@ def _kernel_body_grouped(cfg: DenseConfig, G: int):
             maxf_s[...] = jnp.ones((G, 1, 1), jnp.int32)
             cfgs_s[...] = jnp.zeros((G, 1, 1), jnp.int32)
 
+        # Bound the trip by the LONGEST history in the group: steps past
+        # every member's length are pure pad and never execute (shorter
+        # members' tail steps inside the trip stay guarded by is_pad).
+        rg = ln_ref[b * G]
+        for g in range(1, G):
+            rg = jnp.maximum(rg, ln_ref[b * G + g])
+        trip = jnp.clip(rg - c * RC, 0, RC)
+
         def step(i, carry):
             # dead carried as i32[G,1,1]: loop-carried rank-3 BOOL vectors
             # fail scf.for legalization in Mosaic.
@@ -468,19 +557,26 @@ def _kernel_body_grouped(cfg: DenseConfig, G: int):
             allowed = allowed_mask(tv3)
             cm = cm_ref[:, i]                                  # [G,Sp,128]
 
+            # Paired sweeps, loop while the pair's second sweep grew
+            # ANYWHERE in the group (vector compare; one scalar cond per
+            # step typical — see the r4 tuning notes). Pad histories'
+            # colmask columns are zero, so their tables never change and
+            # never extend the loop.
+            T1 = closure(T, cm, allowed)
+            T2 = closure(T1, cm, allowed)
+
             def wbody(st):
-                Tw, n_prev, _ch, rounds = st
-                Tw = closure(Tw, cm, allowed)
-                n_now = popcounts(Tw)
-                return (Tw, n_now,
-                        jnp.any((n_now > n_prev) & ~is_pad), rounds + 1)
+                Tw, _ch, pairs = st
+                Ta = closure(Tw, cm, allowed)
+                Tb = closure(Ta, cm, allowed)
+                return Tb, jnp.any(Ta != Tb), pairs + 1
 
             def wcond(st):
-                return st[2] & (st[3] < cfg.rounds)
+                return st[1] & (st[2] < MAX_PAIRS)
 
-            n0 = popcounts(T)
-            T, n, _c2, _r2 = jax.lax.while_loop(
-                wcond, wbody, (T, n0, jnp.any(~is_pad), jnp.int32(0)))
+            T, _c2, _p2 = jax.lax.while_loop(
+                wcond, wbody, (T2, jnp.any(T1 != T2), jnp.int32(1)))
+            n = popcounts(T)
 
             pruned = prune(T, tv3, allowed)
             T_new = jnp.where(is_pad, T, pruned)
@@ -495,7 +591,7 @@ def _kernel_body_grouped(cfg: DenseConfig, G: int):
 
         init = (T_s[...], dead_s[...], step_s[...], maxf_s[...],
                 cfgs_s[...])
-        T, dead_i, dead_step, maxf, cfgs = jax.lax.fori_loop(0, RC, step,
+        T, dead_i, dead_step, maxf, cfgs = jax.lax.fori_loop(0, trip, step,
                                                              init)
         T_s[...] = T
         dead_s[...] = dead_i
@@ -527,6 +623,7 @@ def local_pallas_launcher_grouped(model: Model, cfg: DenseConfig, G: int,
     if cfg.k_slots > max_k:
         raise ValueError(f"pallas kernel supports k_slots <= {max_k}, "
                          f"got {cfg.k_slots}")
+    _require_converging_cap(cfg)
     Sp = max(8, (cfg.n_states + 7) // 8 * 8)
     W = 1 << (cfg.k_slots - 5)
     row = int(model.init_state()) + cfg.state_offset
@@ -545,14 +642,15 @@ def local_pallas_launcher_grouped(model: Model, cfg: DenseConfig, G: int,
         NC = (R + RC - 1) // RC
         R_pad = NC * RC
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,   # lengths [B] + targets [B,R_pad]
             grid=(B // G, NC),
             in_specs=[
                 pl.BlockSpec((G, RC, Sp, 128),
-                             lambda b, c, tg_ref: (b, c, 0, 0),
+                             lambda b, c, ln_ref, tg_ref: (b, c, 0, 0),
                              memory_space=pltpu.VMEM),
             ],
-            out_specs=[pl.BlockSpec((5 * B,), lambda b, c, tg_ref: (0,),
+            out_specs=[pl.BlockSpec((5 * B,),
+                                    lambda b, c, ln_ref, tg_ref: (0,),
                                     memory_space=pltpu.SMEM)],
             scratch_shapes=[
                 pltpu.VMEM((G, Sp, W), jnp.uint32),    # table carry
@@ -563,7 +661,7 @@ def local_pallas_launcher_grouped(model: Model, cfg: DenseConfig, G: int,
             ],
         )
 
-        def run(tg, cm):
+        def run(ln, tg, cm):
             if R_pad != R:
                 tg = jnp.pad(tg, ((0, 0), (0, R_pad - R)),
                              constant_values=-1)
@@ -573,7 +671,7 @@ def local_pallas_launcher_grouped(model: Model, cfg: DenseConfig, G: int,
                 grid_spec=grid_spec,
                 out_shape=[jax.ShapeDtypeStruct((5 * B,), jnp.int32)],
                 interpret=interpret,
-            )(tg, cm)[0].reshape(B, 5)
+            )(ln, tg, cm)[0].reshape(B, 5)
 
         return jax.jit(run)
 
@@ -605,8 +703,8 @@ def make_batch_checker_pallas_grouped(model: Model, cfg: DenseConfig,
                                         slot_active.dtype)])
             targets = jnp.concatenate(
                 [targets, jnp.full((extra, R), -1, targets.dtype)])
-        colmask, tg = prep(slot_tabs, slot_active, targets)
-        return launch(B_pad, R)(tg, colmask)[:B]
+        colmask, tg, lengths = prep(slot_tabs, slot_active, targets)
+        return launch(B_pad, R)(lengths, tg, colmask)[:B]
 
     return check
 

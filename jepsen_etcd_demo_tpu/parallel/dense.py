@@ -119,7 +119,8 @@ def sharded_batch_checker_pallas(model: Model, cfg: DenseConfig, mesh: Mesh,
                       NamedSharding(mesh, P(axis, None, None)),
                       NamedSharding(mesh, P(axis, None))),
         out_shardings=(NamedSharding(mesh, P(axis, None, None, None)),
-                       NamedSharding(mesh, P(axis, None))))
+                       NamedSharding(mesh, P(axis, None)),
+                       NamedSharding(mesh, P(axis))))
     if group > 1:
         launcher = wgl3_pallas.local_pallas_launcher_grouped(
             model, cfg, group, interpret=interpret)
@@ -130,11 +131,12 @@ def sharded_batch_checker_pallas(model: Model, cfg: DenseConfig, mesh: Mesh,
 
     @functools.lru_cache(maxsize=None)
     def sharded_launch(b_loc: int, r: int):
-        def local(tg, cm):           # i32[B/D, R], u32[B/D, R, Sp, 128]
-            return launcher(b_loc, r)(tg, cm)
+        def local(ln, tg, cm):  # i32[B/D], i32[B/D, R], u32[B/D, R, Sp, 128]
+            return launcher(b_loc, r)(ln, tg, cm)
 
         specs = dict(mesh=mesh,
-                     in_specs=(P(axis, None), P(axis, None, None, None)),
+                     in_specs=(P(axis), P(axis, None),
+                               P(axis, None, None, None)),
                      out_specs=P(axis, None))
         try:   # pallas_call out_shapes carry no vma: disable the check
             sharded = shard_map(local, check_vma=False, **specs)
@@ -146,8 +148,8 @@ def sharded_batch_checker_pallas(model: Model, cfg: DenseConfig, mesh: Mesh,
         b, r = targets.shape
         if b % d:
             raise ValueError(f"batch {b} not a multiple of axis size {d}")
-        cm, tg = prep(slot_tabs, slot_active, targets)
-        return sharded_launch(b // d, r)(tg, cm)
+        cm, tg, ln = prep(slot_tabs, slot_active, targets)
+        return sharded_launch(b // d, r)(ln, tg, cm)
 
     _CACHE[key] = check
     return check
