@@ -56,10 +56,111 @@ LONG100K_FILE = Path(__file__).parent / "bench_100k.json"
 # Peak numbers for the roofline estimate, per jax device-kind prefix.
 # v5e public specs: 197 bf16 TFLOP/s over 4 128x128 MXUs -> ~1.5 GHz core
 # clock; the VPU is 8 sublanes x 128 lanes x 4 ALUs at that clock
-# => ~6.1e12 int32 word-ops/s. HBM 819 GB/s.
+# => ~6.1e12 int32 word-ops/s. HBM 819 GB/s. The roofline ALSO reports
+# utilization against a MEASURED int32 ALU peak (_peak_microbench): the
+# spec number assumes every ALU issue slot takes int ops, which this
+# hardware does not sustain (~3.4e12 measured), so the spec percentage
+# understates real utilization.
 PEAKS = {
     "TPU v5": {"vpu_word_ops": 6.1e12, "hbm_Bps": 8.19e11},
 }
+
+
+def _device_seconds(fn) -> float | None:
+    """Device-busy seconds for one call of fn (device-side events of a
+    jax.profiler trace). On the tunneled axon backend wall time carries a
+    fixed ~0.1 s dispatch+fetch round trip that is NOT kernel time
+    (VERDICT r3 item 1) — this is the honest kernel denominator. Returns
+    None when no device events are captured (CPU backend)."""
+    import glob
+    import gzip
+    import shutil
+    import tempfile
+
+    import jax
+
+    tmp = tempfile.mkdtemp(prefix="benchprof")
+    try:
+        with jax.profiler.trace(tmp):
+            fn()
+        traces = glob.glob(f"{tmp}/plugins/profile/*/*.trace.json.gz")
+        if not traces:
+            return None
+        with gzip.open(traces[0]) as f:
+            d = json.load(f)
+        pids = {e["pid"]: e["args"].get("name", "")
+                for e in d.get("traceEvents", [])
+                if e.get("ph") == "M" and e.get("name") == "process_name"}
+        dev_us = sum(
+            e.get("dur", 0) for e in d["traceEvents"]
+            if e.get("ph") == "X" and "TPU" in pids.get(e["pid"], "")
+            and not e.get("name", "").startswith("jit_"))
+        return dev_us / 1e6 if dev_us else None
+    except (OSError, ValueError, KeyError):
+        return None
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _peak_microbench() -> float | None:
+    """Measured int32 VPU word-ops/s ceiling: a pallas kernel of 8
+    independent 4-op ALU chains on resident vregs (no memory traffic, no
+    reduces — the best case for this kernel family's op mix). Pinned in
+    bench_baseline.json per device kind; delete the entry to re-measure."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    kind = jax.devices()[0].device_kind
+    try:
+        rec = json.loads(BASELINE_FILE.read_text())["peak_microbench"]
+        if rec.get("device_kind") == kind:
+            return rec["word_ops_per_s"]
+    except (OSError, ValueError, KeyError):
+        pass
+
+    # 5 vector ALU ops per chain-iteration: xor, shift, or, and, add.
+    ITERS, UNROLL, OPS, SP, W = 200_000, 8, 5, 8, 128
+
+    def kernel(x_ref, o_ref):
+        def body(i, accs):
+            out = []
+            for a in accs:
+                a = a ^ jnp.uint32(0x9E3779B9)
+                a = a | (a << jnp.uint32(1))
+                a = a & jnp.uint32(0x7FFFFFFF)
+                a = a + jnp.uint32(i)
+                out.append(a)
+            return tuple(out)
+        accs = tuple(x_ref[...] + jnp.uint32(k) for k in range(UNROLL))
+        accs = jax.lax.fori_loop(0, ITERS, body, accs)
+        acc = accs[0]
+        for a in accs[1:]:
+            acc = acc | a
+        o_ref[...] = acc
+
+    @jax.jit
+    def run(x):
+        return pl.pallas_call(
+            kernel, out_shape=jax.ShapeDtypeStruct((SP, W), jnp.uint32))(x)
+
+    x = jnp.asarray(np.arange(SP * W, dtype=np.uint32).reshape(SP, W))
+    np.asarray(run(x))  # compile
+    dev_s = _device_seconds(lambda: np.asarray(run(x)))
+    if not dev_s:
+        return None
+    peak = ITERS * UNROLL * OPS * SP * W / dev_s
+    try:
+        data = json.loads(BASELINE_FILE.read_text())
+    except (OSError, ValueError):
+        data = {}
+    data["peak_microbench"] = {
+        "device_kind": kind, "word_ops_per_s": round(peak, -9),
+        "pinned_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    BASELINE_FILE.write_text(json.dumps(data, indent=2) + "\n")
+    print(f"# pinned measured VPU peak {peak/1e12:.2f} T word-ops/s -> "
+          f"{BASELINE_FILE.name} (commit it)", file=sys.stderr)
+    return peak
 
 
 def build_corpus():
@@ -110,20 +211,30 @@ def _pin_oracle(lane: str, sig: dict, oracle_s: float) -> None:
 
 
 def _roofline(device_kind: str, cfg, steps, r_pad: int, batch: int,
-              kernel_s: float) -> dict | None:
+              kernel_s: float, device_s: float | None = None,
+              measured_peak: float | None = None,
+              min_sweeps: int = 2) -> dict | None:
     """Lower-bound hardware-utilization estimate for the dense batched
-    launch (VERDICT r2 missing #4). Two ceilings:
+    launch (VERDICT r2 missing #4; r3 item 1 split the denominator). Two
+    ceilings:
 
       * HBM: the fused pallas kernel keeps the table in VMEM; its HBM
         traffic is the streamed colmask blocks (+ the prefetched targets),
         which is exactly computable from the launch shape.
-      * VPU: word-ops are modeled from the guaranteed work — TWO closure
-        sweeps per real step (one productive + one confirming, the
-        fixpoint minimum) of K slots x (2S+3) word-ops over the
-        Sp x W table. Real sweeps can exceed two, so vpu_pct is a LOWER
-        bound on utilization.
+      * VPU: word-ops are modeled from the guaranteed work — min_sweeps
+        closure sweeps per real step of K slots x (2S+3) word-ops over
+        the Sp x W table. min_sweeps is 2 for the grouped kernel (two
+        unconditional sweeps per step) but 1 for the per-history kernel,
+        whose first-sweep-silent steps stop after one sweep. Real sweeps
+        can exceed the minimum, so vpu_pct is a LOWER bound.
 
-    roofline_pct is the binding ceiling (max of the two fractions)."""
+    Utilization is computed on DEVICE time when a profiler measurement is
+    available (wall carries the tunneled backend's fixed ~0.1 s
+    dispatch+fetch round trip), against BOTH the spec-sheet peak and the
+    pinned measured int32 ALU peak (_peak_microbench — the honest ceiling
+    for this op mix). roofline_pct stays the spec-peak wall-time figure
+    for round-over-round comparability; roofline_pct_device /
+    roofline_pct_measured are the sharper views."""
     peaks = next((v for k, v in PEAKS.items() if device_kind.startswith(k)),
                  None)
     if peaks is None:
@@ -133,10 +244,10 @@ def _roofline(device_kind: str, cfg, steps, r_pad: int, batch: int,
     w = 1 << (K - 5)
     real_steps = int(sum(s.n_steps for s in steps))
     colmask_bytes = batch * r_pad * sp * 128 * 4 + batch * r_pad * 4
-    word_ops = real_steps * 2 * K * (2 * S + 3) * sp * w
+    word_ops = real_steps * min_sweeps * K * (2 * S + 3) * sp * w
     hbm_pct = colmask_bytes / kernel_s / peaks["hbm_Bps"] * 100
     vpu_pct = word_ops / kernel_s / peaks["vpu_word_ops"] * 100
-    return {
+    out = {
         "achieved_hbm_GBps": round(colmask_bytes / kernel_s / 1e9, 2),
         "achieved_word_Gops": round(word_ops / kernel_s / 1e9, 2),
         "hbm_pct": round(hbm_pct, 2),
@@ -145,6 +256,16 @@ def _roofline(device_kind: str, cfg, steps, r_pad: int, batch: int,
         "peaks_assumed": {"vpu_word_ops": peaks["vpu_word_ops"],
                           "hbm_Bps": peaks["hbm_Bps"]},
     }
+    if device_s:
+        out["device_s"] = round(device_s, 4)
+        out["dispatch_fetch_s"] = round(max(0.0, kernel_s - device_s), 4)
+        out["roofline_pct_device"] = round(
+            word_ops / device_s / peaks["vpu_word_ops"] * 100, 2)
+        if measured_peak:
+            out["vpu_word_ops_measured"] = measured_peak
+            out["roofline_pct_measured"] = round(
+                word_ops / device_s / measured_peak * 100, 2)
+    return out
 
 
 def _measure_corpus(lane, encs, model):
@@ -193,10 +314,17 @@ def _measure_corpus(lane, encs, model):
         # counter for an apples-to-apples view).
         "configs_per_sec": float(out["configs_explored"].sum()) / best,
     }
-    roof = _roofline(jax.devices()[0].device_kind, cfg, steps, r_cap,
-                     len(encs), best)
-    if roof:
-        m["roofline"] = roof
+    kind = jax.devices()[0].device_kind
+    if any(kind.startswith(k) for k in PEAKS):
+        # Profiled launch + peak microbench only when a roofline will
+        # actually be emitted for this device kind.
+        device_s = _device_seconds(lambda: wgl3.unpack_np(check(*arrays)))
+        measured_peak = _peak_microbench() if device_s else None
+        roof = _roofline(kind, cfg, steps, r_cap, len(encs), best,
+                         device_s, measured_peak,
+                         min_sweeps=2 if "grouped" in kernel_name else 1)
+        if roof:
+            m["roofline"] = roof
     return m
 
 

@@ -71,11 +71,12 @@ re-attributed the r3 numbers and drove a 2.4x kernel redesign, 110 ms ->
     switch (per-history kernel) with dynamic shift+roll+select — 12%
     slower (r3 measurement, still believed).
   * Calibration: a peak microbench (independent 8-chain int32 ALU loop,
-    zero memory traffic) sustains ~3.3 G vreg-ops/s (~3.4 T word-ops/s)
-    on this v5e core — the honest VPU ceiling for this kernel's op mix,
-    vs the 6.1 T spec-sheet estimate bench.py's roofline also reports.
-    Serial dependent chains sustain only ~0.55 G vreg-ops/s, which is
-    why ILP shape (not op count) dominates kernel cost here.
+    zero memory traffic, 5 ops/chain-iteration) sustains ~4.0 G
+    vreg-ops/s (~4.1 T word-ops/s) on this v5e core — the honest VPU
+    ceiling for this kernel's op mix, vs the 6.1 T spec-sheet estimate
+    bench.py's roofline also reports. A single serial dependent chain
+    sustains only ~0.7 G vreg-ops/s, which is why ILP shape (not op
+    count) dominates kernel cost here.
 """
 
 from __future__ import annotations
