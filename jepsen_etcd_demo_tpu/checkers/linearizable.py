@@ -176,7 +176,13 @@ class Linearizable(Checker):
             results, kernel = wgl3_pallas.check_batch_encoded_auto(
                 [enc], self.model)
             out = results[0]
+            # "host-oracle-routed" = the latency router sent a tiny
+            # single history to the exact host oracle (same algorithm;
+            # device dispatch alone would cost more than the whole
+            # check — ops/limits.py oracle_crossover_events).
             backend = ("jax-dense-pallas" if "pallas" in kernel
+                       else "host-oracle-routed"
+                       if kernel == "oracle-small-history"
                        else "jax-dense")
             return {"valid": out["valid"], "backend": backend,
                     "op_count": enc.n_ops,

@@ -130,6 +130,20 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--reencode", action="store_true",
                    help="re-encode from history.jsonl instead of loading "
                         "stored history-*.npz tensors")
+    # DCN multislice (BASELINE configs[4]): every participating host runs
+    # the SAME corpus command against the same store, plus these flags;
+    # the batch shards over the ("slice", "batch") mesh and every process
+    # prints the identical gathered verdict.
+    c.add_argument("--coordinator", metavar="HOST:PORT",
+                   help="jax.distributed coordinator address; enables "
+                        "multi-process (DCN multislice) corpus sharding")
+    c.add_argument("--num-processes", type=int, default=1,
+                   help="total processes in the multislice run")
+    c.add_argument("--process-id", type=int, default=0,
+                   help="this process's rank [0, num-processes)")
+    c.add_argument("--local-devices", type=int, default=None,
+                   help="simulate with N virtual CPU devices per process "
+                        "(CI / one-machine dryrun)")
 
     s = sub.add_parser("serve", help="serve the results store over http")
     s.add_argument("--port", type=int, default=8080)
@@ -265,6 +279,15 @@ def cmd_corpus(args) -> int:
     --reencode forces the JSONL path (e.g. after an encoder fix)."""
     import time
 
+    # Multislice first: jax.distributed must initialize before ANY backend
+    # use (the store/encode imports below never touch a device).
+    multislice = args.coordinator is not None
+    if multislice:
+        from ..parallel.multislice import init_multislice
+
+        init_multislice(args.coordinator, args.num_processes,
+                        args.process_id, local_devices=args.local_devices)
+
     from ..checkers import Linearizable
     from ..checkers.independent import split_by_key
     from ..ops import wgl3_pallas
@@ -338,15 +361,23 @@ def cmd_corpus(args) -> int:
     t0 = time.perf_counter()
     invalid, kernels, n_keys = [], set(), 0
     for model_name, entries in sorted(by_model.items()):
-        results, kernel = wgl3_pallas.check_batch_encoded_auto(
-            [e[2] for e in entries], Linearizable(model=model_name).model)
+        model = Linearizable(model=model_name).model
+        if multislice:
+            from ..parallel.multislice import check_corpus_multislice
+
+            results = check_corpus_multislice([e[2] for e in entries],
+                                              model)
+            kernel = "wgl3-dense-multislice"
+        else:
+            results, kernel = wgl3_pallas.check_batch_encoded_auto(
+                [e[2] for e in entries], model)
         kernels.add(kernel)
         n_keys += len(entries)
         invalid.extend({"run": r, "key": k, "model": model_name}
                        for (r, k, _), one in zip(entries, results)
                        if one["valid"] is not True)
     wall = time.perf_counter() - t0
-    print(json.dumps({
+    out = {
         "valid": not invalid,
         "runs": len(runs_seen),
         "keys": n_keys,
@@ -354,7 +385,14 @@ def cmd_corpus(args) -> int:
         "kernel": kernels.pop() if len(kernels) == 1 else "mixed",
         "from_tensors": n_from_tensors,
         "wall_s": round(wall, 3),
-    }))
+    }
+    if multislice:
+        import jax
+
+        out["processes"] = jax.process_count()
+        out["process_id"] = jax.process_index()
+        out["devices"] = jax.device_count()
+    print(json.dumps(out))
     return 0 if not invalid else 1
 
 

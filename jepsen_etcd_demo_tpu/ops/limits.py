@@ -58,6 +58,13 @@ class KernelLimits:
     max_r_pallas: int = 16384
     # [worker] Total prefetch entries (batch * steps) per pallas launch.
     max_prefetch_pallas: int = 1 << 18
+    # [worker] Event-count crossover below which a SINGLE history on a
+    # live TPU backend routes to the exact host oracle instead of a
+    # device launch: the dispatch+fetch round trip (~0.1 s on the axon
+    # tunnel; tens of ms on a local runtime) exceeds the oracle's whole
+    # runtime at tutorial scale. ~1000 ops is the measured break-even on
+    # the tunnel (BENCH long_history[1000]); batches are never routed.
+    oracle_crossover_events: int = 2048
     # [arch] Histories per pallas program in the grouped batch kernel
     # (tables stacked on a leading group axis; amortizes per-step
     # instruction overhead — measured 1.6-2.1x end-to-end / ~2.3x

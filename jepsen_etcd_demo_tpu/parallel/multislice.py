@@ -80,12 +80,39 @@ def check_corpus_multislice(encs: Sequence, model, mesh=None
     from jax.experimental import multihost_utils
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from ..ops import wgl3
+    from ..ops import wgl3, wgl3_pallas
+    from ..ops.limits import limits
     from ..ops.wgl import verdict
 
     if mesh is None:
         mesh = multislice_mesh()
-    cfg, arrays, steps = wgl3.batch_arrays3(encs, model)
+
+    # Partition like check_batch_encoded_auto: one dense-infeasible or
+    # over-long history must not crash the whole multislice pass. The
+    # non-dense minority runs the exact general ladder LOCALLY on every
+    # process (deterministic — identical results on all hosts); only the
+    # dense majority shards over the mesh.
+    dense_idx, general_idx = [], []
+    for i, e in enumerate(encs):
+        ok = wgl3.dense_config(model, wgl3.tight_k_slots(e), e.max_value)
+        (dense_idx if ok is not None else general_idx).append(i)
+    if dense_idx:
+        sub = [encs[i] for i in dense_idx]
+        try:
+            cfg, arrays, steps = wgl3.batch_arrays3(sub, model)
+        except ValueError:
+            general_idx = sorted(general_idx + dense_idx)
+            dense_idx = []
+        else:
+            if arrays[2].shape[1] > limits().long_scan_max:
+                general_idx = sorted(general_idx + dense_idx)
+                dense_idx = []
+    if not dense_idx:
+        return [wgl3_pallas.check_encoded_general(e, model) for e in encs]
+    full_results: list = [None] * len(encs)
+    for i in general_idx:
+        full_results[i] = wgl3_pallas.check_encoded_general(encs[i], model)
+    encs = sub
     axes = tuple(mesh.axis_names)
     total = int(np.prod([mesh.shape[a] for a in axes]))
     b = arrays[0].shape[0]
@@ -115,15 +142,14 @@ def check_corpus_multislice(encs: Sequence, model, mesh=None
     out = fn(*global_arrays)
     gathered = {k: np.asarray(multihost_utils.process_allgather(
         v, tiled=True)) for k, v in out.items()}
-    results = []
     for i, s in enumerate(steps):
         one = {k: gathered[k][i].item() for k in gathered}
         one["valid"] = verdict(one)
         one["op_count"] = s.n_ops
         # int like every other backend (the dict path carries f32).
         one["configs_explored"] = int(one["configs_explored"])
-        results.append(one)
-    return results
+        full_results[dense_idx[i]] = one
+    return full_results
 
 
 # --- one-machine simulation / dryrun ---------------------------------------
