@@ -898,9 +898,14 @@ def check_encoded_general(enc: EncodedHistory, model: Model,
         return out
 
     try:
+        # keep_death_checkpoint: zero-cost until death, and on an invalid
+        # verdict it hands the witness rung the exact frontier nearest
+        # the death point so no second search is needed
+        # (checkers/witness.py reconstruct_witness_from_sort_checkpoint).
         out = wgl2.check_encoded_resumable(enc, model, f_cap=f_cap,
                                            f_cap_max=f_cap_max,
-                                           time_budget_s=time_budget_s)
+                                           time_budget_s=time_budget_s,
+                                           keep_death_checkpoint=True)
         out["kernel"] = "wgl2-sort-resumable"
         return out
     except MemoryError as e:
