@@ -12,6 +12,6 @@ from .linearizable import Linearizable  # noqa: F401
 from .set_checker import SetChecker  # noqa: F401
 from .independent import IndependentChecker  # noqa: F401
 from .oracle import check_events_oracle, brute_force_check  # noqa: F401
-from .elle import ElleChecker  # noqa: F401
+from .elle import ElleChecker, ElleRwChecker  # noqa: F401
 from .perf import PerfChecker  # noqa: F401
 from .timeline import TimelineChecker  # noqa: F401

@@ -1,4 +1,5 @@
-"""Elle-equivalent transactional anomaly checker (list-append workload).
+"""Elle-equivalent transactional anomaly checker (BOTH inference
+families: list-append here in ElleChecker, rw-register in ElleRwChecker).
 
 The reference's dependency tree ships elle 0.1.2 (jepsen.etcdemo.iml:46,
 reached transitively through jepsen.checker — SURVEY.md §2.2 lists it as a
@@ -345,6 +346,222 @@ class ElleChecker(Checker):
                 anomalies["G2-item" + suffix].append(witness(
                     extract_cycle(full, reach_f, cyc_f)))
         return True
+
+
+class ElleRwChecker(ElleChecker):
+    """elle.rw-register equivalent: transactional anomaly inference over
+    REGISTER txns — elle 0.1.2's other workload family (VERDICT r3 item
+    8; the reference ships it at jepsen.etcdemo.iml:46).
+
+      txn ops: Op(f="txn", value=[micro-op, ...]) with micro-ops
+          ("w", k, v)  — write v to register k (values unique per key)
+          ("r", k, v)  — read register k (v: None on invoke; the observed
+                          value, or None for the initial nil, on :ok)
+
+    Unlike list-append, a register read observes only the LAST write, so
+    the per-key version order must be INFERRED rather than read off a
+    list prefix. Sources (each sound for a register with unique writes
+    and no deletes):
+      * own-txn write order — successive writes to k inside one :ok txn;
+      * writes-follow-reads — an :ok txn that reads k=v1 before its own
+        first write v2 to k places v1 before v2 (the read saw the state
+        its write replaced or succeeded);
+      * the initial nil precedes every written version.
+    The per-key version DAG is closed transitively (tiny host matrices);
+    a CYCLIC version graph is itself reported (:cyclic-versions, elle's
+    name) and that key contributes no ww/rw edges — deriving order from
+    a contradiction would fabricate anomalies.
+
+    Dependency edges over :ok txns, fed to the SAME G0/G1c/G-single/
+    G2-item (+ -realtime) classification ladder as list-append:
+      * wr  writer(v) -> reader that observed v;
+      * ww  writer(v1) -> writer(v2) for v1 < v2 in the version order;
+      * rw  reader of v -> writer(v2) for every v2 > v (a register holds
+        the last write, so a later version's writer must serialize after
+        any read that still saw v); a read of nil anti-depends on EVERY
+        writer of the key.
+
+    Direct anomalies: internal (own-txn read contradicts own earlier
+    write), G1a (observed a :fail txn's value), G1b (observed a txn's
+    non-final write), garbage-read (observed a value nobody wrote),
+    cyclic-versions. :info txns: their writes may legitimately be
+    observed (never G1a) but contribute no edges."""
+
+    name = "elle-rw"
+
+    def check(self, test: dict, history: Sequence[Op],
+              opts: dict | None = None) -> dict[str, Any]:
+        txns = _pair_txns(history)
+        oks = [t for t in txns if t[1] == "ok"]
+        n = len(oks)
+        anomalies: dict[str, list] = defaultdict(list)
+
+        # Ownership: (k, v) -> ok writer idx; final write per (txn, k);
+        # failed and indeterminate writes.
+        writer_of: dict[tuple, int] = {}
+        final_write: dict[tuple, Any] = {}
+        info_vals: set[tuple] = set()
+        failed_vals: set[tuple] = set()
+        for i, (_, _, value, *_pos) in enumerate(oks):
+            for mop in value:
+                if mop[0] == "w":
+                    k, v = mop[1], mop[2]
+                    if (k, v) in writer_of:
+                        raise TxnEncodeError(
+                            f"write value {v!r} reused for key {k!r}")
+                    writer_of[(k, v)] = i
+                    final_write[(i, k)] = v
+        for value, typ, *_rest in txns:
+            if typ in ("fail", "info"):
+                for mop in value:
+                    if mop[0] == "w":
+                        (failed_vals if typ == "fail"
+                         else info_vals).add((mop[1], mop[2]))
+
+        # Internal: after a txn's own write to k, its later reads of k
+        # must observe the latest own write.
+        for i, (_, _, value, *_pos) in enumerate(oks):
+            own_last: dict[Any, Any] = {}
+            for mop in value:
+                if mop[0] == "w":
+                    own_last[mop[1]] = mop[2]
+                elif (mop[0] == "r" and mop[1] in own_last
+                        and mop[2] != own_last[mop[1]]):
+                    anomalies["internal"].append(
+                        {"key": mop[1], "expected": own_last[mop[1]],
+                         "read": mop[2], "txn": i})
+
+        # External reads: (reader, key, observed) with own-value reads
+        # excluded (covered by internal above; no self-edges).
+        ext_reads: list[tuple[int, Any, Any]] = []
+        for i, (_, _, value, *_pos) in enumerate(oks):
+            own_written: set = set()
+            for mop in value:
+                if mop[0] == "w":
+                    own_written.add((mop[1], mop[2]))
+                elif mop[0] == "r":
+                    k, v = mop[1], mop[2]
+                    if (k, v) in own_written:
+                        continue
+                    ext_reads.append((i, k, v))
+                    if v is None:
+                        continue
+                    # Same guard as the append family: a value a :fail
+                    # txn shares with a committed write was legitimately
+                    # observable.
+                    if (k, v) in failed_vals and (k, v) not in writer_of:
+                        anomalies["G1a"].append(
+                            {"key": k, "value": v, "reader": i})
+                    elif ((k, v) not in writer_of
+                            and (k, v) not in info_vals):
+                        anomalies["garbage-read"].append(
+                            {"key": k, "value": v, "reader": i})
+                    owner = writer_of.get((k, v))
+                    if owner is not None and final_write[(owner, k)] != v:
+                        anomalies["G1b"].append(
+                            {"key": k, "value": v, "reader": i,
+                             "writer": owner})
+
+        # Per-key version DAG -> transitive closure -> ww/rw edges.
+        versions: dict[Any, list] = defaultdict(lambda: [None])
+        for (k, v) in writer_of:
+            versions[k].append(v)
+        prec: dict[Any, np.ndarray] = {}
+        for k, vs in versions.items():
+            idx = {v: j for j, v in enumerate(vs)}
+            m = np.zeros((len(vs), len(vs)), bool)
+            m[0, 1:] = True                      # nil precedes everything
+            for i, (_, _, value, *_pos) in enumerate(oks):
+                last_own = None
+                first_read: Any = "__none__"
+                for mop in value:
+                    if mop[0] == "w" and mop[1] == k:
+                        if last_own is not None:
+                            m[idx[last_own], idx[mop[2]]] = True
+                        elif (first_read != "__none__"
+                                and first_read in idx):
+                            # writes-follow-reads: the pre-write read
+                            m[idx[first_read], idx[mop[2]]] = True
+                        last_own = mop[2]
+                    elif (mop[0] == "r" and mop[1] == k
+                            and last_own is None
+                            and first_read == "__none__"):
+                        first_read = mop[2]   # may be None = nil (idx 0)
+            closure = _bool_closure(m)
+            if closure.diagonal().any():
+                cyc_vals = [vs[j] for j in
+                            np.nonzero(closure.diagonal())[0]]
+                anomalies["cyclic-versions"].append(
+                    {"key": k, "values": cyc_vals})
+                continue   # contradictory order: derive no edges from k
+            prec[k] = closure
+
+        ww = np.zeros((n, n), bool)
+        wr = np.zeros((n, n), bool)
+        rw = np.zeros((n, n), bool)
+        vidx = {k: {x: j for j, x in enumerate(vs)}
+                for k, vs in versions.items()}
+        for k, closure in prec.items():
+            vs = versions[k]
+            owners = np.full(len(vs), -1, dtype=np.intp)
+            for j, v in enumerate(vs[1:], start=1):
+                owners[j] = writer_of[(k, v)]
+            for a, b in zip(*np.nonzero(closure)):
+                wa, wb = owners[a], owners[b]
+                if wa >= 0 and wb >= 0 and wa != wb:
+                    ww[wa, wb] = True
+        for reader, k, v in ext_reads:
+            # wr needs no version order — sound even when the key's
+            # inferred order is contradictory (cyclic-versions only
+            # withholds the order-DERIVED ww/rw edges).
+            if v is not None and (k, v) in writer_of:
+                wa = writer_of[(k, v)]
+                if wa != reader:
+                    wr[wa, reader] = True
+            if k not in prec:
+                continue
+            vs = versions[k]
+            j = vidx[k].get(v)
+            if j is None:
+                continue   # garbage / info value: no inferable position
+            for succ in np.nonzero(prec[k][j])[0]:
+                wb = writer_of[(k, vs[succ])]
+                if wb != reader:
+                    rw[reader, wb] = True
+
+        rt = None
+        if self.realtime and n:
+            inv_pos = np.array([t[3] for t in oks])
+            comp_pos = np.array([t[4] for t in oks])
+            rt = comp_pos[:, None] < inv_pos[None, :]
+        self._find_cycles(ww, wr, rw, oks, anomalies, rt)
+
+        types = sorted(anomalies)
+        edge_counts = {"ww": int(ww.sum()), "wr": int(wr.sum()),
+                       "rw": int(rw.sum())}
+        if rt is not None:
+            edge_counts["rt"] = int(rt.sum())
+        return {
+            "valid": not types,
+            "anomaly_types": types,
+            "anomalies": {t: anomalies[t] for t in types},
+            "txn_count": n,
+            "realtime": self.realtime,
+            "edge_counts": edge_counts,
+            "backend": "jax-mxu-closure",
+        }
+
+
+def _bool_closure(m: np.ndarray) -> np.ndarray:
+    """Transitive closure by boolean matrix squaring (host: per-key
+    version matrices are tiny; the TXN graph uses the MXU closure in
+    ops/cycles.py)."""
+    out = m.copy()
+    while True:
+        nxt = out | (out @ out)
+        if (nxt == out).all():
+            return out
+        out = nxt
 
 
 # -- pure-Python oracle (differential tests) -----------------------------

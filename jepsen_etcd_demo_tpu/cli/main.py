@@ -101,8 +101,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "'unknown' verdict instead of grinding on "
                         "combinatorial frontiers")
     t.add_argument("--elle-realtime", action="store_true",
-                   help="append workload: assert STRICT serializability "
-                        "(wall-clock order joins the elle dependency graph)")
+                   help="append/txnregister workloads: assert STRICT "
+                        "serializability (wall-clock order joins the elle "
+                        "dependency graph)")
     t.add_argument("--duplicate-cas-prob", type=float, default=0.0,
                    help="[fake] a failed CAS may actually have applied")
     t.add_argument("--reorder-prob", type=float, default=0.0,
@@ -233,12 +234,15 @@ def cmd_analyze(args) -> int:
                                    backend=args.backend,
                                    time_budget_s=budget),
                                "timeline": TimelineChecker()})})
-    elif workload == "append":
+    elif workload in ("append", "txnregister"):
         # Re-check under the same strictness the run recorded (a strict-
         # serializability run must not silently downgrade on analyze).
+        from ..checkers.elle import ElleRwChecker
+
+        elle_cls = ElleChecker if workload == "append" else ElleRwChecker
         checker = Compose({"perf": PerfChecker(),
                            "indep": Compose({
-                               "elle": ElleChecker(realtime=bool(
+                               "elle": elle_cls(realtime=bool(
                                    stored_test.get("elle_realtime"))),
                                "timeline": TimelineChecker()})})
     else:

@@ -172,6 +172,47 @@ class FakeKVStore:
             await asyncio.sleep(self.op_delay_s * self.rng.random())
         return out
 
+    async def txn_register(self, node: str, mops: list) -> list:
+        """Atomic multi-key REGISTER transaction (elle's rw-register
+        workload — checkers/elle.py ElleRwChecker). Micro-ops:
+        ("w", k, v) writes register k; ("r", k, None) reads it (None =
+        the initial nil). Same injected bugs as txn(): lost_write_prob
+        drops an acked write, stale_read_prob serves an old snapshot —
+        both surface as elle anomalies (G-single-realtime and friends)."""
+        maybe_timeout = node in self.isolated
+        if maybe_timeout and self.rng.random() >= self.partial_apply_prob:
+            raise Timeout(f"node {node} partitioned")
+        out = []
+        overlay: dict = {}   # own writes, so read-your-writes holds even
+        #                      when the store LOSES the write (same
+        #                      contract as txn(): :internal never fires
+        #                      on fake runs, it is golden-tested)
+        async with self.lock:
+            self._snapshot()
+            for mop in mops:
+                f, k, v = mop
+                if f == "w":
+                    if self.rng.random() >= self.lost_write_prob:
+                        self.data[k] = v
+                    overlay[k] = v
+                    out.append(("w", k, v))
+                elif f == "r":
+                    if k in overlay:
+                        out.append(("r", k, overlay[k]))
+                        continue
+                    src = self.data
+                    if (self.snapshots
+                            and self.rng.random() < self.stale_read_prob):
+                        src = self.rng.choice(self.snapshots)
+                    out.append(("r", k, src.get(k)))
+                else:
+                    raise ValueError(f"unknown register micro-op {f!r}")
+        if maybe_timeout:
+            raise Timeout(f"node {node} partitioned (txn applied)")
+        if self.op_delay_s:
+            await asyncio.sleep(self.op_delay_s * self.rng.random())
+        return out
+
     # -- queue surface (queue workload; no reference counterpart — the
     # fifo/unordered-queue MODELS mirror knossos's model family) ----------
     async def enqueue(self, node: str, key: str, value: Any) -> None:

@@ -106,6 +106,9 @@ class _BoundFakeConn:
     async def txn(self, mops):
         return await self.store.txn(self.node, mops)
 
+    async def txn_register(self, mops):
+        return await self.store.txn_register(self.node, mops)
+
     async def enqueue(self, key, value):
         return await self.store.enqueue(self.node, key, value)
 

@@ -15,26 +15,39 @@ from .base import ConnClient, ClientError, Timeout, completed
 
 
 class TxnClient(ConnClient):
-    """conn_factory(test, node) -> connection exposing async txn(mops)."""
+    """conn_factory(test, node) -> connection exposing the transactional
+    method named by `method`: txn(mops) for list-append (micro-op
+    "append"), txn_register(mops) for rw-register (micro-op "w")."""
+
+    def __init__(self, conn_factory, conn=None, method: str = "txn"):
+        # (conn_factory, conn) positional order matches ConnClient's
+        # open() clone call.
+        super().__init__(conn_factory, conn)
+        self.method = method
+
+    async def open(self, test: dict, node: str) -> "TxnClient":
+        c = await super().open(test, node)
+        c.method = self.method
+        return c
 
     def _check_conn(self, conn) -> None:
-        if not hasattr(conn, "txn"):
+        if not hasattr(conn, self.method):
             # Fail fast at setup, not with an AttributeError mid-run: the
-            # etcd v2 API has no transactions, so the append workload only
-            # runs against transactional stores (e.g. --fake).
+            # etcd v2 API has no transactions, so the txn workloads only
+            # run against transactional stores (e.g. --fake).
             raise RuntimeError(
-                "append workload requires a transactional connection "
-                f"(conn {type(conn).__name__!r} has no txn()); "
+                "txn workload requires a transactional connection "
+                f"(conn {type(conn).__name__!r} has no {self.method}()); "
                 "use --fake or a store with multi-key transactions")
 
     async def invoke(self, test: dict, op: Op) -> Op:
         if op.f != "txn":
             raise ValueError(f"unknown op f={op.f!r}")
         try:
-            done = await self.conn.txn(list(op.value))
+            done = await getattr(self.conn, self.method)(list(op.value))
             return completed(op, "ok", value=done)
         except Timeout:
-            writes = any(m[0] == "append" for m in op.value)
+            writes = any(m[0] in ("append", "w") for m in op.value)
             return completed(op, "info" if writes else "fail",
                              error="timeout")
         except ClientError as e:

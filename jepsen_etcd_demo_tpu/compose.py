@@ -110,15 +110,14 @@ def set_workload(opts: dict, conn_factory: Callable) -> dict:
     }
 
 
-def append_workload(opts: dict, conn_factory: Callable) -> dict:
-    """Elle list-append workload: random multi-key txns of reads and
-    appends (values unique per key), checked by the MXU-backed elle
-    checker (checkers/elle.py). No reference-demo counterpart — the demo
-    only ships elle as a dependency (jepsen.etcdemo.iml:46) — but the
-    capability is part of the dependency surface SURVEY.md §2.2 lists.
-    Requires a transactional connection (the fake cluster provides txn();
-    etcd v2 has no transactions)."""
-    from .checkers.elle import ElleChecker
+def _elle_txn_workload(opts: dict, conn_factory: Callable, write_mop: str,
+                       method: str, checker_cls) -> dict:
+    """Shared shape of the two elle txn workloads: random multi-key txns
+    of reads and writes (write values unique per key), a final
+    read-everything phase after healing (the tail of writes is observed,
+    tightening the inferred version order), the elle checker family in
+    the run's strictness. Requires a transactional connection (the fake
+    cluster; etcd v2 has no transactions)."""
     from .clients.txn import TxnClient
 
     n_keys = int(opts.get("txn_keys", 3))
@@ -133,21 +132,41 @@ def append_workload(opts: dict, conn_factory: Callable) -> dict:
                 mops.append(("r", k, None))
             else:
                 counters[k] = counters.get(k, 0) + 1
-                mops.append(("append", k, counters[k]))
+                mops.append((write_mop, k, counters[k]))
         return {"f": "txn", "value": mops}
 
     return {
-        "client": TxnClient(conn_factory),
-        "checker": Compose({"elle": ElleChecker(
+        "client": TxnClient(conn_factory, method=method),
+        "checker": Compose({"elle": checker_cls(
                                 realtime=bool(opts.get("elle_realtime"))),
                             "timeline": TimelineChecker()}),
         "generator": gen.repeat(txn_gen),
-        # Final phase: one read-everything txn after healing, so the tail
-        # of appends is observed (tightens the inferred version order).
         "final_generator": gen.once({
             "f": "txn",
             "value": [("r", f"k{i}", None) for i in range(n_keys)]}),
     }
+
+
+def append_workload(opts: dict, conn_factory: Callable) -> dict:
+    """Elle list-append workload: random multi-key txns of reads and
+    appends (values unique per key), checked by the MXU-backed elle
+    checker (checkers/elle.py). No reference-demo counterpart — the demo
+    only ships elle as a dependency (jepsen.etcdemo.iml:46) — but the
+    capability is part of the dependency surface SURVEY.md §2.2 lists."""
+    from .checkers.elle import ElleChecker
+
+    return _elle_txn_workload(opts, conn_factory, "append", "txn",
+                              ElleChecker)
+
+
+def txnregister_workload(opts: dict, conn_factory: Callable) -> dict:
+    """Elle rw-register workload: random multi-key REGISTER txns (writes
+    unique per key), checked by ElleRwChecker — elle 0.1.2's other
+    inference family (jepsen.etcdemo.iml:46; VERDICT r3 item 8)."""
+    from .checkers.elle import ElleRwChecker
+
+    return _elle_txn_workload(opts, conn_factory, "w", "txn_register",
+                              ElleRwChecker)
 
 
 def queue_workload(opts: dict, conn_factory: Callable) -> dict:
@@ -291,6 +310,7 @@ WORKLOADS = {
     "set": set_workload,
     "gset": gset_workload,
     "append": append_workload,
+    "txnregister": txnregister_workload,
     "queue": queue_workload,
     "multiregister": multiregister_workload,
     "mutex": mutex_workload,

@@ -1,0 +1,264 @@
+"""Elle rw-register checker (checkers/elle.py ElleRwChecker; VERDICT r3
+item 8 — elle 0.1.2's second inference family, jepsen.etcdemo.iml:46).
+
+Golden histories for the taxonomy (version order inferred from
+writes-follow-reads + own-txn write order, unlike list-append's observable
+prefixes), serial-execution fuzz (must stay anomaly-free), and the
+hermetic end-to-end txnregister workload with and without injected bugs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+
+from jepsen_etcd_demo_tpu.checkers.elle import ElleRwChecker, TxnEncodeError
+from jepsen_etcd_demo_tpu.compose import fake_test
+from jepsen_etcd_demo_tpu.ops.op import Op
+from jepsen_etcd_demo_tpu.runner import run_test
+
+CHECK = ElleRwChecker()
+CHECK_RT = ElleRwChecker(realtime=True)
+
+
+def txn_history(*txns):
+    """txns: (completion_type, [mops]) — invoke/completion pairs, one
+    process per txn, reads blanked to None on the invoke."""
+    h = []
+    for p, (typ, mops) in enumerate(txns):
+        inv = [(m[0], m[1], None) if m[0] == "r" else m for m in mops]
+        h.append(Op(type="invoke", f="txn", value=inv, process=p))
+        h.append(Op(type=typ, f="txn",
+                    value=mops if typ == "ok" else inv, process=p))
+    return h
+
+
+def anomalies_of(*txns, rt=False):
+    return (CHECK_RT if rt else CHECK).check({}, txn_history(*txns))
+
+
+# -- golden taxonomy ------------------------------------------------------
+
+def test_serial_history_valid():
+    res = anomalies_of(
+        ("ok", [("w", "x", 1)]),
+        ("ok", [("r", "x", 1), ("w", "x", 2)]),
+        ("ok", [("r", "x", 2)]),
+    )
+    assert res["valid"] is True
+    assert res["anomaly_types"] == []
+    # wfr inference ordered 1 < 2: ww writer(1)->writer(2).
+    assert res["edge_counts"]["ww"] >= 1
+    assert res["backend"] == "jax-mxu-closure"
+
+
+def test_nil_read_is_valid_initially():
+    res = anomalies_of(
+        ("ok", [("r", "x", None)]),
+        ("ok", [("w", "x", 1)]),
+        ("ok", [("r", "x", 1)]),
+    )
+    assert res["valid"] is True
+    # nil-reader anti-depends on the writer.
+    assert res["edge_counts"]["rw"] >= 1
+
+
+def test_internal_read_contradicts_own_write():
+    res = anomalies_of(
+        ("ok", [("w", "x", 1), ("r", "x", 9)]),
+    )
+    assert "internal" in res["anomaly_types"]
+
+
+def test_read_your_own_write_is_valid():
+    res = anomalies_of(
+        ("ok", [("w", "x", 1), ("r", "x", 1), ("w", "x", 2),
+                ("r", "x", 2)]),
+    )
+    assert res["valid"] is True
+
+
+def test_g1a_aborted_read():
+    res = anomalies_of(
+        ("fail", [("w", "x", 1)]),
+        ("ok", [("r", "x", 1)]),
+    )
+    assert "G1a" in res["anomaly_types"]
+
+
+def test_info_write_observed_is_not_g1a():
+    res = anomalies_of(
+        ("info", [("w", "x", 1)]),
+        ("ok", [("r", "x", 1)]),
+    )
+    assert "G1a" not in res["anomaly_types"]
+    assert res["valid"] is True
+
+
+def test_garbage_read():
+    res = anomalies_of(
+        ("ok", [("w", "x", 1)]),
+        ("ok", [("r", "x", 42)]),
+    )
+    assert "garbage-read" in res["anomaly_types"]
+
+
+def test_g1b_intermediate_read():
+    res = anomalies_of(
+        ("ok", [("w", "x", 1), ("w", "x", 2)]),
+        ("ok", [("r", "x", 1)]),
+    )
+    assert "G1b" in res["anomaly_types"]
+
+
+def test_cyclic_versions():
+    # T1 reads 2 then writes 1 (2 < 1); T2 reads 1 then writes 2 (1 < 2):
+    # the inferred version order contradicts itself.
+    res = anomalies_of(
+        ("ok", [("r", "x", 2), ("w", "x", 1)]),
+        ("ok", [("r", "x", 1), ("w", "x", 2)]),
+    )
+    assert "cyclic-versions" in res["anomaly_types"]
+
+
+def test_g0_write_cycle():
+    # x: T2 wrote 1, T1 read 1 then wrote 2 (wfr: 1 < 2) => ww T2->T1.
+    # y: T1 wrote 1, T2 read 1 then wrote 2 (wfr: 1 < 2) => ww T1->T2.
+    res = anomalies_of(
+        ("ok", [("r", "x", 1), ("w", "x", 2), ("w", "y", 1)]),
+        ("ok", [("r", "y", 1), ("w", "y", 2), ("w", "x", 1)]),
+    )
+    assert "G0" in res["anomaly_types"]
+
+
+def test_g1c_circular_information_flow():
+    # wr T1->T2 (T2 reads T1's x); ww T2->T1 via wfr on y.
+    res = anomalies_of(
+        ("ok", [("w", "x", 1), ("r", "y", 1), ("w", "y", 2)]),
+        ("ok", [("r", "x", 1), ("w", "y", 1)]),
+    )
+    # y: T2 wrote 1; T1 read y=1 then wrote y=2 => ww T2->T1.
+    # x: T1 wrote 1; T2 read x=1 => wr T1->T2. Cycle with a wr edge.
+    assert "G1c" in res["anomaly_types"]
+
+
+def test_g_single_one_antidependency():
+    # wr T2->T1 (T1 read T2's z=5); rw T1->T2 (T1 read x=nil while T2
+    # wrote x=1). Exactly ONE anti-dependency closes the cycle.
+    res = anomalies_of(
+        ("ok", [("r", "z", 5), ("r", "x", None), ("w", "y", 1)]),
+        ("ok", [("w", "z", 5), ("w", "x", 1)]),
+    )
+    assert "G-single" in res["anomaly_types"]
+
+
+def test_g2_item_two_antidependencies():
+    res = anomalies_of(
+        ("ok", [("r", "x", None), ("w", "y", 1)]),
+        ("ok", [("r", "y", None), ("w", "x", 1)]),
+    )
+    assert "G2-item" in res["anomaly_types"]
+
+
+def test_encode_errors():
+    with pytest.raises(TxnEncodeError):
+        anomalies_of(("ok", [("w", "x", 1)]), ("ok", [("w", "x", 1)]))
+
+
+def test_realtime_stale_nil_read_is_g_single_realtime():
+    """T1 writes x=1 and completes; T2 then reads x=nil: rw T2->T1 plus
+    realtime T1->T2 — the strict-serializability violation."""
+    h = [
+        Op(type="invoke", f="txn", value=[("w", "x", 1)], process=0),
+        Op(type="ok", f="txn", value=[("w", "x", 1)], process=0),
+        Op(type="invoke", f="txn", value=[("r", "x", None)], process=1),
+        Op(type="ok", f="txn", value=[("r", "x", None)], process=1),
+    ]
+    res = CHECK_RT.check({}, h)
+    assert "G-single-realtime" in res["anomaly_types"]
+    # Non-realtime mode: a serialization putting T2 first exists.
+    assert CHECK.check({}, h)["valid"] is True
+
+
+def test_serial_fuzz_no_anomalies():
+    rng = random.Random(0x5E1B)
+    for _ in range(10):
+        store: dict = {}
+        counters: dict = {}
+        txns = []
+        for _ in range(40):
+            mops = []
+            for _ in range(1 + rng.randrange(3)):
+                k = f"k{rng.randrange(3)}"
+                if rng.random() < 0.5:
+                    mops.append(("r", k, store.get(k)))
+                else:
+                    counters[k] = counters.get(k, 0) + 1
+                    store[k] = counters[k]
+                    mops.append(("w", k, counters[k]))
+            txns.append(("ok", mops))
+        res = anomalies_of(*txns)
+        assert res["valid"] is True, res["anomaly_types"]
+        res = anomalies_of(*txns, rt=True)
+        assert res["valid"] is True, res["anomaly_types"]
+
+
+# -- end-to-end txnregister workload --------------------------------------
+
+def fast_opts(tmp_path, **kw):
+    opts = {"time_limit": 1.2, "rate": 150.0, "store_root": str(tmp_path),
+            "recovery_wait": 0.05, "nemesis_interval": 0.2,
+            "workload": "txnregister", "seed": 11}
+    opts.update(kw)
+    return opts
+
+
+def test_txnregister_run_healthy_is_valid(tmp_path):
+    test = fake_test(fast_opts(tmp_path, no_nemesis=True))
+    result = asyncio.run(run_test(test))
+    assert result["valid"] is True
+    assert result["indep"]["elle"]["txn_count"] > 20
+
+
+def test_txnregister_run_detects_injected_g_single(tmp_path):
+    """Injected stale reads + realtime mode: a read of an old version
+    after its overwriter completed is exactly one anti-dependency closed
+    by a realtime edge — G-single(-realtime) end to end."""
+    test = fake_test(fast_opts(tmp_path, stale_read_prob=0.5,
+                               elle_realtime=True, no_nemesis=True))
+    result = asyncio.run(run_test(test))
+    assert result["valid"] is False
+    types = result["indep"]["elle"]["anomaly_types"]
+    assert any(t.startswith("G-single") or t.startswith("G2-item")
+               or t.startswith("G0") or t.startswith("G1c")
+               for t in types), types
+    assert any("G-single" in t for t in types), types
+
+
+def test_txnregister_run_under_partitions_is_valid(tmp_path):
+    """Partitions only produce indeterminacy (info txns), never
+    anomalies: the rw-register checker must stay sound under faults."""
+    test = fake_test(fast_opts(tmp_path, seed=3))
+    result = asyncio.run(run_test(test))
+    assert result["valid"] is True
+
+
+def test_fail_and_ok_sharing_a_value_is_not_g1a():
+    """A value a :fail txn shares with a committed write (client-side
+    retry) was legitimately observable — same guard as the append
+    family."""
+    h = txn_history(
+        ("fail", [("w", "x", 1)]),
+    )
+    h += txn_history(
+        ("ok", [("w", "x", 1)]),
+        ("ok", [("r", "x", 1)]),
+    )
+    # Re-number processes so the three txns don't collide.
+    for i, op in enumerate(h[2:], start=2):
+        op.process = 10 + (i - 2) // 2
+    res = CHECK.check({}, h)
+    assert "G1a" not in res["anomaly_types"]
+    assert res["valid"] is True
