@@ -66,10 +66,16 @@ re-attributed the r3 numbers and drove a 2.4x kernel redesign, 110 ms ->
     0.3 ms); 2-sweep speculation with host-side escalation — dead, the
     flag rate is 100% of corpus histories (every history has at least
     one step needing a 2nd pair, so everything would re-run); G=32/64
-    groups — Mosaic compile failure (scoped-VMEM live set), and the old
-    G=32 measurement was already neutral; replacing the K-way prune
-    switch (per-history kernel) with dynamic shift+roll+select — 12%
-    slower (r3 measurement, still believed).
+    groups — scoped-VMEM OOM (the colmask block + live set crosses the
+    16 MB scoped limit), and the old G=32 measurement was already
+    neutral; Sp=32 grouping REVISITED with this design (VERDICT r3 item
+    3): G=2 compiles once the step chunk halves (the default RC formula
+    overshoots scoped VMEM by ~350 KB at G=2·Sp=32) and measures 137 ms
+    vs 145 ms per-history on the gset corpus — +6%, not worth the
+    routing complexity — while G=4 still OOMs; the gset lane's 1.5x
+    target was met by the redesign itself (374 -> 236 ms wall);
+    replacing the K-way prune switch (per-history kernel) with dynamic
+    shift+roll+select — 12% slower (r3 measurement, still believed).
   * Calibration: a peak microbench (independent 8-chain int32 ALU loop,
     zero memory traffic, 5 ops/chain-iteration) sustains ~4.0 G
     vreg-ops/s (~4.1 T word-ops/s) on this v5e core — the honest VPU

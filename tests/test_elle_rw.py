@@ -262,3 +262,82 @@ def test_fail_and_ok_sharing_a_value_is_not_g1a():
     res = CHECK.check({}, h)
     assert "G1a" not in res["anomaly_types"]
     assert res["valid"] is True
+
+
+# -- brute-force serializability differential ------------------------------
+
+def _serializable(txns):
+    """Brute force: does some permutation of the ok txns execute serially
+    with every read observing the register state at that point? (Register
+    semantics, initial nil.) Exponential — tiny histories only."""
+    import itertools
+
+    oks = [mops for typ, mops in txns if typ == "ok"]
+    for perm in itertools.permutations(range(len(oks))):
+        store: dict = {}
+        good = True
+        for i in perm:
+            for mop in oks[i]:
+                f, k, v = mop
+                if f == "w":
+                    store[k] = v
+                elif store.get(k) != v:
+                    good = False
+                    break
+            if not good:
+                break
+        if good:
+            return True
+    return False
+
+
+def test_cycle_anomalies_imply_nonserializable_fuzz():
+    """SOUNDNESS of the inference: every reported cycle-class anomaly
+    (G0/G1c/G-single/G2-item, non-realtime) must correspond to a real
+    serializability violation, verified by brute force on small fuzzed
+    histories; and brute-force-serializable histories must never get a
+    cycle anomaly."""
+    rng = random.Random(0xD1FF)
+    cycle_classes = {"G0", "G1c", "G-single", "G2-item"}
+    checked = flagged = 0
+    for trial in range(300):
+        n_txn = 2 + rng.randrange(4)
+        counters: dict = {}
+        store: dict = {}
+        txns = []
+        for _ in range(n_txn):
+            mops = []
+            for _ in range(1 + rng.randrange(3)):
+                k = f"k{rng.randrange(2)}"
+                if rng.random() < 0.5:
+                    # Read: usually truthful, sometimes a stale/wrong
+                    # committed value or a spurious nil (the anomaly
+                    # sources).
+                    roll = rng.random()
+                    if roll < 0.35 and counters.get(k):
+                        v = rng.randrange(1, counters[k] + 1)
+                        mops.append(("r", k, v))
+                    elif roll < 0.45:
+                        mops.append(("r", k, None))
+                    else:
+                        mops.append(("r", k, store.get(k)))
+                else:
+                    counters[k] = counters.get(k, 0) + 1
+                    store[k] = counters[k]
+                    mops.append(("w", k, counters[k]))
+            txns.append(("ok", mops))
+        res = anomalies_of(*txns)
+        got_cycle = cycle_classes & set(res["anomaly_types"])
+        # Skip histories with non-cycle anomalies (internal/garbage/G1b
+        # make the serial-execution oracle's read model inapplicable).
+        if set(res["anomaly_types"]) - got_cycle:
+            continue
+        checked += 1
+        # The inference may MISS anomalies (it is deliberately
+        # incomplete), so only flagged histories are cross-checked: a
+        # reported cycle class must be a REAL serializability violation.
+        if got_cycle:
+            flagged += 1
+            assert not _serializable(txns), (txns, res["anomaly_types"])
+    assert checked > 100, f"fuzz too tame: only {checked} usable"
+    assert flagged >= 5, f"fuzz too tame: only {flagged} cycle cases"
