@@ -138,7 +138,14 @@ def prepare_pallas_batch(model: Model, cfg: DenseConfig, slot_tabs, slot_active,
     return colmask, tg, lengths
 
 
-def _kernel_body(cfg: DenseConfig):
+def _kernel_body(cfg: DenseConfig, resume: bool = False):
+    """Per-history kernel. With resume=True the search state enters and
+    leaves through operands — extra prefetch `mt` i32[B,5] (dead,
+    dead_step, maxf, cfgs, global step offset), extra input T_in and
+    extra output T_out — so a host loop (check_steps3_long_pallas) can
+    chain launches over step windows: the SMEM prefetch ceiling
+    (limits().max_r_pallas) bounds one LAUNCH, not the history. Per-step
+    semantics identical either way."""
     K, S, off = cfg.k_slots, cfg.n_states, cfg.state_offset
     W = 1 << (K - 5)
     Sp = max(8, (S + 7) // 8 * 8)
@@ -204,13 +211,19 @@ def _kernel_body(cfg: DenseConfig):
     # drops the per-sweep popcounts entirely).
     MAX_PAIRS = (cfg.rounds + 1) // 2
 
-    def body(ln_ref, tg_ref, cm_ref, out_ref, T_s, meta_s):
+    def body(ln_ref, *rest):
         """Grid is (B, NC): history b, step-chunk c. The colmask block is
         one RC-step chunk (long histories would blow the 16 MiB VMEM limit
         as a single block); the search state (table + metadata) carries
         across chunks in scratch, which persists over the sequential TPU
         grid. The scan trip is bounded by the history's REAL step count
         (ln_ref scalar prefetch): bucket-pad steps never execute."""
+        if resume:
+            (mt_ref, tg_ref, cm_ref, Tin_ref, out_ref, Tout_ref, T_s,
+             meta_s) = rest
+        else:
+            mt_ref = Tin_ref = Tout_ref = None
+            tg_ref, cm_ref, out_ref, T_s, meta_s = rest
         b = pl.program_id(0)
         c = pl.program_id(1)
         NC = pl.num_programs(1)
@@ -218,23 +231,35 @@ def _kernel_body(cfg: DenseConfig):
 
         @pl.when(c == 0)
         def _init():
-            # Initial table: bit 0 of word 0 in the init-state row (built
-            # with iota masks — scatter has no Mosaic lowering).
-            rows = jax.lax.broadcasted_iota(jnp.int32, (Sp, W), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (Sp, W), 1)
-            T_s[:, :] = jnp.where((rows == init_row) & (cols == 0),
-                                  jnp.uint32(1), jnp.uint32(0))
-            meta_s[0] = 0    # dead
-            meta_s[1] = -1   # dead_step
-            meta_s[2] = 1    # max_frontier
-            meta_s[3] = 0    # configs_explored
+            if resume:
+                # Continue the previous window's search state.
+                T_s[:, :] = Tin_ref[0]
+                meta_s[0] = mt_ref[b, 0]    # dead
+                meta_s[1] = mt_ref[b, 1]    # dead_step (global)
+                meta_s[2] = mt_ref[b, 2]    # max_frontier
+                meta_s[3] = mt_ref[b, 3]    # configs_explored
+            else:
+                # Initial table: bit 0 of word 0 in the init-state row
+                # (built with iota masks — scatter has no Mosaic
+                # lowering).
+                rows = jax.lax.broadcasted_iota(jnp.int32, (Sp, W), 0)
+                cols = jax.lax.broadcasted_iota(jnp.int32, (Sp, W), 1)
+                T_s[:, :] = jnp.where((rows == init_row) & (cols == 0),
+                                      jnp.uint32(1), jnp.uint32(0))
+                meta_s[0] = 0    # dead
+                meta_s[1] = -1   # dead_step
+                meta_s[2] = 1    # max_frontier
+                meta_s[3] = 0    # configs_explored
 
         trip = jnp.clip(ln_ref[b] - c * RC, 0, RC)
+        # Global step offset: dead_step stays comparable across windows.
+        off0 = mt_ref[b, 4] if resume else 0
 
         def step(i, carry):
             T, dead, dead_step, maxf, cfgs = carry
-            r = c * RC + i
-            t = jnp.maximum(tg_ref[b, r], 0)   # trip excludes pads (-1)
+            r = off0 + c * RC + i
+            # trip excludes pads (-1)
+            t = jnp.maximum(tg_ref[b, c * RC + i], 0)
             allowed = allowed_mask(t)
             cm = cm_ref[0, i]                                # u32[Sp, 128]
 
@@ -298,6 +323,8 @@ def _kernel_body(cfg: DenseConfig):
             out_ref[5 * b + 2] = dead_step
             out_ref[5 * b + 3] = maxf
             out_ref[5 * b + 4] = cfgs
+            if resume:
+                Tout_ref[0] = T_s[:, :]
 
     def bind(row):
         nonlocal init_row
@@ -305,6 +332,176 @@ def _kernel_body(cfg: DenseConfig):
         return body
 
     return bind
+
+
+def local_pallas_launcher_resumable(model: Model, cfg: DenseConfig,
+                                    interpret: bool = False):
+    """launch(R) for the RESUMABLE per-history kernel (B=1 windows):
+    jitted (ln i32[1], mt i32[1,5], tg i32[1,R], cm u32[1,R,Sp,128],
+    Tin u32[1,Sp,W]) -> (out i32[1,5], Tout u32[1,Sp,W]). The host loop
+    in check_steps3_long_pallas chains windows, carrying (Tout, out-derived
+    meta) into the next launch."""
+    max_k = limits().max_k_pallas
+    if cfg.k_slots > max_k:
+        raise ValueError(f"pallas kernel supports k_slots <= {max_k}, "
+                         f"got {cfg.k_slots}")
+    _require_converging_cap(cfg)
+    Sp = max(8, (cfg.n_states + 7) // 8 * 8)
+    W = 1 << (cfg.k_slots - 5)
+    row = int(model.init_state()) + cfg.state_offset
+    kernel = _kernel_body(cfg, resume=True)(row)
+
+    import functools
+
+    @functools.lru_cache(maxsize=None)
+    def launch(R: int):
+        RC = min(R, limits().pallas_step_chunk)
+        NC = (R + RC - 1) // RC
+        R_pad = NC * RC
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            # lengths [1] + meta [1,5] + targets [1,R_pad], all SMEM
+            num_scalar_prefetch=3,
+            grid=(1, NC),
+            in_specs=[
+                pl.BlockSpec((1, RC, Sp, 128),
+                             lambda b, c, ln, mt, tg: (b, c, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, Sp, W),
+                             lambda b, c, ln, mt, tg: (b, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((5,), lambda b, c, ln, mt, tg: (0,),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, Sp, W),
+                             lambda b, c, ln, mt, tg: (b, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((Sp, W), jnp.uint32),   # table carry
+                pltpu.SMEM((4,), jnp.int32),        # dead/step/maxf/cfgs
+            ],
+        )
+
+        def run(ln, mt, tg, cm, Tin):
+            if R_pad != R:
+                tg = jnp.pad(tg, ((0, 0), (0, R_pad - R)),
+                             constant_values=-1)
+                cm = jnp.pad(cm, ((0, 0), (0, R_pad - R), (0, 0), (0, 0)))
+            out, Tout = pl.pallas_call(
+                kernel,
+                grid_spec=grid_spec,
+                out_shape=[jax.ShapeDtypeStruct((5,), jnp.int32),
+                           jax.ShapeDtypeStruct((1, Sp, W), jnp.uint32)],
+                interpret=interpret,
+            )(ln, mt, tg, cm, Tin)
+            return out, Tout
+
+        return jax.jit(run)
+
+    return launch
+
+
+def check_steps3_long_pallas(rs, model: Model, cfg: DenseConfig,
+                             time_budget_s: float | None = None,
+                             interpret: bool = False) -> dict:
+    """Host-chained fused-kernel sweep for single histories whose step
+    count exceeds one launch's SMEM prefetch budget (the 100k-op lane):
+    windows of limits().max_r_pallas steps, the search state (table +
+    metadata + global step offset) carried between launches as operands.
+    Same verdict/metrics contract as wgl3.check_steps3_long, with the
+    kernel-side i32 configs accumulator (exact where the XLA path's f32
+    partial sums are approximate past 2^24)."""
+    import time as _time
+
+    from . import wgl3
+    from .wgl import verdict
+
+    t0 = _time.monotonic()
+    lim = limits()
+    # Largest step bucket that fits the per-launch SMEM prefetch ceiling
+    # (step_bucket values only, so every launch reuses ONE compiled
+    # shape; a sub-64 cap skips bucketing entirely). Window pads never
+    # execute — the kernel bounds its trip with the prefetched length.
+    window = lim.max_r_pallas
+    if window >= 64:
+        b = 64
+        while wgl3.step_bucket(b + 1) <= lim.max_r_pallas:
+            b = wgl3.step_bucket(b + 1)
+        window = b
+    launch = _cached_resumable_launcher(model, cfg, interpret)
+    prep = _cached_prep(model, cfg)
+    Sp = max(8, (cfg.n_states + 7) // 8 * 8)
+    W = 1 << (cfg.k_slots - 5)
+    Tin = np.zeros((1, Sp, W), np.uint32)
+    Tin[0, int(model.init_state()) + cfg.state_offset, 0] = 1
+    Tin = jnp.asarray(Tin)
+    meta = jnp.asarray(np.array([[0, -1, 1, 0, 0]], np.int32))
+    n = rs.n_steps
+    if n == 0:
+        # The initial state trivially survives an empty history (same
+        # contract as the XLA path finalizing its init carry).
+        return {"survived": True, "overflow": False, "dead_step": -1,
+                "max_frontier": 1, "configs_explored": 0, "valid": True}
+    out = None
+    # Unbudgeted: all windows dispatch ASYNC, metadata chained
+    # device-side, ONE fetch at the end (a dead table makes the
+    # remaining windows near-free — empty closures — so no early-exit
+    # fetch: on a tunneled backend it would cost more than the sweep).
+    # Budgeted: sync per window so the budget check sees device time —
+    # overshoot bounded by one window, same contract as the XLA rung.
+    for w0 in range(0, n, window):
+        if (time_budget_s is not None
+                and _time.monotonic() - t0 > time_budget_s):
+            return {"valid": "unknown", "survived": False, "overflow": True,
+                    "dead_step": -1, "max_frontier": -1,
+                    "configs_explored": -1, "kernel": "exhausted",
+                    "error": f"pallas long sweep exceeded its "
+                             f"{time_budget_s:.0f}s time budget at return "
+                             f"step {w0}"}
+        wn = min(window, n - w0)
+        sl = slice(w0, w0 + wn)
+        pad = ((0, window - wn),)
+        tg = np.pad(rs.targets[sl], pad, constant_values=-1)[None]
+        tabs = np.pad(rs.slot_tabs[sl],
+                      pad + ((0, 0), (0, 0)))[None]
+        act = np.pad(rs.slot_active[sl], pad + ((0, 0),))[None]
+        cm, tgd, ln = prep(jnp.asarray(tabs), jnp.asarray(act),
+                           jnp.asarray(tg))
+        out, Tin = launch(window)(ln, meta, tgd, cm, Tin)
+        meta = jnp.stack([1 - out[0], out[2], out[3], out[4],
+                          jnp.int32(w0 + wn)])[None]
+        if time_budget_s is not None:
+            np.asarray(out)   # sync: bound overshoot by one window
+    out_np = np.asarray(out)
+    res = {
+        "survived": bool(out_np[0]),
+        "overflow": False,
+        "dead_step": int(out_np[2]),
+        "max_frontier": int(out_np[3]),
+        "configs_explored": int(out_np[4]),
+    }
+    res["valid"] = verdict(res)
+    return res
+
+
+def _cached_resumable_launcher(model: Model, cfg: DenseConfig,
+                               interpret: bool = False):
+    key = ("pallas-resumable", model.cache_key(), cfg, interpret)
+    if key not in _CACHE:
+        _CACHE[key] = local_pallas_launcher_resumable(model, cfg,
+                                                      interpret)
+    return _CACHE[key]
+
+
+def _cached_prep(model: Model, cfg: DenseConfig):
+    import functools
+
+    key = ("pallas-prep", model.cache_key(), cfg)
+    if key not in _CACHE:
+        _CACHE[key] = jax.jit(
+            functools.partial(prepare_pallas_batch, model, cfg))
+    return _CACHE[key]
 
 
 def _require_converging_cap(cfg: DenseConfig) -> None:
@@ -892,6 +1089,10 @@ def check_encoded_general(enc: EncodedHistory, model: Model,
             out = check_steps_lattice_long(rs, model, cfg_lat,
                                            time_budget_s=remaining)
             name = "wgl3-dense-lattice-sharded"
+        elif use_pallas(cfg_dense):
+            out = check_steps3_long_pallas(rs, model, cfg_dense,
+                                           time_budget_s=remaining)
+            name = "wgl3-dense-pallas-chunked"
         else:
             out = wgl3.check_steps3_long(rs, model, cfg_dense,
                                          time_budget_s=remaining)
@@ -1018,15 +1219,24 @@ def check_batch_encoded_auto(encs: Sequence[EncodedHistory],
         else:
             if r_cap > limits().long_scan_max:
                 # Step count exceeds one scan program: host-driven chunked
-                # scans, one history at a time — arrays never stacked or
-                # transferred (check_steps3_long streams chunk by chunk).
+                # sweeps, one history at a time — arrays never stacked or
+                # transferred. On a live TPU the fused kernel runs in
+                # launch-sized windows with the search state carried
+                # between launches (check_steps3_long_pallas — the 100k-op
+                # lane); elsewhere the XLA scan streams chunk by chunk.
+                fused = use_pallas(cfg)
+                name = ("wgl3-dense-pallas-chunked" if fused
+                        else "wgl3-dense-chunked")
                 for i, s in zip(dense_idx, steps):
-                    one = wgl3.check_steps3_long(s, model, cfg)
+                    if fused:
+                        one = check_steps3_long_pallas(s, model, cfg)
+                    else:
+                        one = wgl3.check_steps3_long(s, model, cfg)
                     one["op_count"] = s.n_ops
                     one["table_cells"] = cfg.n_states * cfg.n_masks
-                    one.setdefault("kernel", "wgl3-dense-chunked")
+                    one.setdefault("kernel", name)
                     results[i] = one
-                kernels.add("wgl3-dense-chunked")
+                kernels.add(name)
             elif jax.device_count() > 1 and len(sub) > 1:
                 # Multi-device: shard the batch axis over all devices —
                 # the PRODUCTION multi-chip path (corpus / independent

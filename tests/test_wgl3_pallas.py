@@ -226,3 +226,36 @@ def test_grouped_kernel_multi_chunk_carry():
     finally:
         set_limits(prev)
     np.testing.assert_array_equal(ref, got)
+
+
+def test_resumable_long_sweep_matches_xla_chunked():
+    """check_steps3_long_pallas (host-chained fused-kernel windows, state
+    carried between launches) must match the XLA chunked sweep on every
+    field, windows exercised by a tiny max_r_pallas."""
+    import random
+
+    from jepsen_etcd_demo_tpu.ops.encode import (encode_return_steps,
+                                                 reslot_events)
+    from jepsen_etcd_demo_tpu.ops.limits import KernelLimits, set_limits
+    from jepsen_etcd_demo_tpu.utils.fuzz import mutate_history
+
+    prev = set_limits(KernelLimits(max_r_pallas=64, pallas_step_chunk=32))
+    try:
+        for trial in range(4):
+            h = gen_register_history(random.Random(trial), n_ops=300,
+                                     n_procs=6, p_info=0.01)
+            if trial % 2:
+                h = mutate_history(random.Random(100 + trial), h)
+            enc = encode_register_history(h, k_slots=16)
+            k = wgl3.tight_k_slots(enc)
+            cfg = wgl3.dense_config(MODEL, k, enc.max_value)
+            enc_r = reslot_events(enc, k) if enc.k_slots != k else enc
+            rs = encode_return_steps(enc_r)
+            ref = wgl3.check_steps3_long(rs, MODEL, cfg, chunk=64)
+            got = wgl3_pallas.check_steps3_long_pallas(rs, MODEL, cfg,
+                                                       interpret=True)
+            for f in ("valid", "survived", "dead_step", "max_frontier",
+                      "configs_explored"):
+                assert got[f] == ref[f], (trial, f, got, ref)
+    finally:
+        set_limits(prev)
