@@ -412,6 +412,41 @@ def bench_invalid_lane(model) -> dict:
                      e["configs_explored"]))
         lane["kernels"].append({"kernel": name, "mismatches": mm})
         lane["mismatches"] += mm
+
+    # The RESUMABLE windowed kernel's compiled dead path: one long
+    # mutated history driven in small windows (state carried across
+    # launches), against the XLA chunked sweep.
+    from dataclasses import replace
+
+    from jepsen_etcd_demo_tpu.ops.encode import (encode_return_steps,
+                                                 reslot_events)
+    from jepsen_etcd_demo_tpu.ops.limits import limits, set_limits
+
+    for _ in range(20):   # mutations are LIKELY-invalid; insist on it
+        h = mutate_history(rng, gen_register_history(
+            rng, n_ops=4000, n_procs=8, p_info=0.002))
+        enc = encode_register_history(h, k_slots=16)
+        k = wgl3.tight_k_slots(enc)
+        lcfg = wgl3.dense_config(model, k, enc.max_value)
+        enc = reslot_events(enc, k) if enc.k_slots != k else enc
+        rs = encode_return_steps(enc)
+        ref = wgl3.check_steps3_long(rs, model, lcfg, chunk=512)
+        if ref["valid"] is False:
+            break
+    assert ref["valid"] is False, "no invalid long mutation in 20 tries"
+    # replace(), not a fresh KernelLimits: the active profile may carry
+    # env overrides that must keep applying to the windowed launches.
+    prev = set_limits(replace(limits(), max_r_pallas=512))
+    try:
+        got = wgl3_pallas.check_steps3_long_pallas(rs, model, lcfg)
+    finally:
+        set_limits(prev)
+    mm = sum(1 for f in ("valid", "survived", "dead_step", "max_frontier")
+             if got[f] != ref[f])
+    lane["kernels"].append({"kernel": "wgl3-dense-pallas-chunked",
+                            "mismatches": mm,
+                            "valid": bool(ref["valid"])})
+    lane["mismatches"] += mm
     assert lane["mismatches"] == 0, f"invalid-lane certification: {lane}"
     return lane
 

@@ -474,12 +474,20 @@ def check_steps3_long_pallas(rs, model: Model, cfg: DenseConfig,
         if time_budget_s is not None:
             np.asarray(out)   # sync: bound overshoot by one window
     out_np = np.asarray(out)
+    cfgs = int(out_np[4])
+    if cfgs < 0:
+        # The i32 accumulator wrapped across windows: saturate, matching
+        # the XLA path's clip of its (equally approximate past 2^24) f32
+        # partial sums. A wrapped-back-to-positive count is undetectable
+        # here — both paths' counters are documented approximate at this
+        # scale; verdict fields are unaffected.
+        cfgs = 2**31 - 1
     res = {
         "survived": bool(out_np[0]),
         "overflow": False,
         "dead_step": int(out_np[2]),
         "max_frontier": int(out_np[3]),
-        "configs_explored": int(out_np[4]),
+        "configs_explored": cfgs,
     }
     res["valid"] = verdict(res)
     return res
@@ -601,8 +609,9 @@ def make_batch_checker_pallas(model: Model, cfg: DenseConfig,
     # Two SEPARATE jits, sequenced in Python: fusing the transition prep
     # into the same XLA program as the pallas custom-call serializes
     # pathologically on TPU (0.54 s vs 0.12 s for the identical work at
-    # B=256); as separate dispatches they pipeline.
-    prep = jax.jit(functools.partial(prepare_pallas_batch, model, cfg))
+    # B=256); as separate dispatches they pipeline. The prep jit is
+    # shared with the resumable long sweep (_cached_prep).
+    prep = _cached_prep(model, cfg)
     launch = cached_pallas_launcher(model, cfg, interpret)
 
     def check(slot_tabs, slot_active, targets):
@@ -891,7 +900,7 @@ def make_batch_checker_pallas_grouped(model: Model, cfg: DenseConfig,
     import functools
 
     G = group or limits().pallas_group
-    prep = jax.jit(functools.partial(prepare_pallas_batch, model, cfg))
+    prep = _cached_prep(model, cfg)
     launch = local_pallas_launcher_grouped(model, cfg, G, interpret)
 
     def check(slot_tabs, slot_active, targets):
