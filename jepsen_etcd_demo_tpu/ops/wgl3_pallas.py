@@ -638,9 +638,10 @@ def _kernel_body_grouped(cfg: DenseConfig, G: int):
     times; the costs are lockstep convergence (each step runs max rounds
     over the group) and a vectorized data-driven prune (every variant
     computed once per step, selected per history) instead of one switch
-    branch. Measured on v5e, 1024x150-op corpus: batch wall 0.34-0.35 s
-    -> 0.17-0.21 s across runs at G=16 (~1.6-2.1x end-to-end; spread =
-    tunnel fetch + launch variance; ~2.3x kernel-side).
+    branch. Measured on v5e, 1024x150-op corpus (r4 paired-sweep design):
+    48 ms device time vs ~230 ms per-history — grouping plus the r4
+    redesign together are ~2.3x over r3's grouped kernel (110 ms) and the
+    corpus wall sits at ~0.10 s including the tunnel round trip.
 
     Semantics are identical to _kernel_body per history (same banking,
     same fixpoint sweep order, same metrics; pads contribute nothing)."""
@@ -1159,9 +1160,9 @@ def packed_batch_checker(model: Model, cfg: DenseConfig,
             f"check_batch_encoded_auto or wgl3.check_steps3_long")
     if use_pallas(cfg, n_steps, batch):
         # Grouped kernel: G histories per program amortize per-step
-        # instruction overhead — measured 1.6-2.1x end-to-end on the v5e
-        # bench corpus (0.34-0.35 s -> 0.17-0.21 s across runs) at G=16
-        # for 8-sublane states.
+        # instruction overhead — ~48 ms device time for the 1024x150-op
+        # v5e bench corpus at G=16 vs ~230 ms per-history (r4 numbers,
+        # see the module tuning notes) for 8-sublane states.
         # Bit-identical to the per-history kernel. ONLY for Sp=8 models:
         # wider states spill Mosaic's scoped VMEM at full group size, and
         # the reduced group that fits (G=4 at Sp=32) measured 14% SLOWER
