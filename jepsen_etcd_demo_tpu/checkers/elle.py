@@ -381,8 +381,9 @@ class ElleRwChecker(ElleChecker):
         any read that still saw v); a read of nil anti-depends on EVERY
         writer of the key.
 
-    Direct anomalies: internal (own-txn read contradicts own earlier
-    write), G1a (observed a :fail txn's value), G1b (observed a txn's
+    Direct anomalies: internal (own-txn read contradicts the state the
+    txn's earlier writes OR reads established), G1a (observed a :fail
+    txn's value), G1b (observed a txn's
     non-final write), garbage-read (observed a value nobody wrote),
     cyclic-versions. :info txns: their writes may legitimately be
     observed (never G1a) but contribute no edges."""
@@ -418,18 +419,25 @@ class ElleRwChecker(ElleChecker):
                         (failed_vals if typ == "fail"
                          else info_vals).add((mop[1], mop[2]))
 
-        # Internal: after a txn's own write to k, its later reads of k
-        # must observe the latest own write.
+        # Internal: each read must match the txn's own intermediate state
+        # for that key — established by a prior own WRITE or a prior own
+        # READ (elle's rw-register :internal covers both; ADVICE r4: a
+        # read-read contradiction was only caught indirectly via wr/rw
+        # cycles before, which needs the versions to be orderable). A
+        # read also PINS the observed state: later reads must agree
+        # until an own write changes it.
         for i, (_, _, value, *_pos) in enumerate(oks):
             own_last: dict[Any, Any] = {}
             for mop in value:
                 if mop[0] == "w":
                     own_last[mop[1]] = mop[2]
-                elif (mop[0] == "r" and mop[1] in own_last
-                        and mop[2] != own_last[mop[1]]):
-                    anomalies["internal"].append(
-                        {"key": mop[1], "expected": own_last[mop[1]],
-                         "read": mop[2], "txn": i})
+                elif mop[0] == "r":
+                    if (mop[1] in own_last
+                            and mop[2] != own_last[mop[1]]):
+                        anomalies["internal"].append(
+                            {"key": mop[1], "expected": own_last[mop[1]],
+                             "read": mop[2], "txn": i})
+                    own_last[mop[1]] = mop[2]
 
         # External reads: (reader, key, observed) with own-value reads
         # excluded (covered by internal above; no self-edges).
