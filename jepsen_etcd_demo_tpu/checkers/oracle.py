@@ -46,7 +46,16 @@ class OracleResult:
         }
 
 
-def check_events_oracle(enc: EncodedHistory, model: Model) -> OracleResult:
+class OracleBudgetExceeded(Exception):
+    """Raised by check_events_oracle when `max_configs` transition
+    attempts were spent without reaching a verdict. The caller (the
+    product router at ops/wgl3_pallas.py) falls back to the capped
+    device ladder — the oracle route must never become an unbounded
+    exponential host search."""
+
+
+def check_events_oracle(enc: EncodedHistory, model: Model,
+                        max_configs: int | None = None) -> OracleResult:
     events = np.asarray(enc.events)
     slots: dict[int, tuple[int, int, int, int]] = {}
     frontier: set[tuple[int, int]] = {(int(model.init_state()), 0)}
@@ -71,6 +80,10 @@ def check_events_oracle(enc: EncodedHistory, model: Model) -> OracleResult:
                     continue
                 legal, nxt = model.step_py(state, f, a1, a2, rv)
                 explored += 1
+                if max_configs is not None and explored > max_configs:
+                    raise OracleBudgetExceeded(
+                        f"oracle spent {explored} transition attempts "
+                        f"(budget {max_configs}) without a verdict")
                 if legal:
                     cfg = (int(nxt), mask | (1 << slot))
                     if cfg not in seen:

@@ -60,11 +60,24 @@ class KernelLimits:
     max_prefetch_pallas: int = 1 << 18
     # [worker] Event-count crossover below which a SINGLE history on a
     # live TPU backend routes to the exact host oracle instead of a
-    # device launch: the dispatch+fetch round trip (~0.1 s on the axon
-    # tunnel; tens of ms on a local runtime) exceeds the oracle's whole
-    # runtime at tutorial scale. ~1000 ops is the measured break-even on
-    # the tunnel (BENCH long_history[1000]); batches are never routed.
-    oracle_crossover_events: int = 2048
+    # device launch: the dispatch+fetch round trip exceeds the oracle's
+    # whole runtime at tutorial scale. -1 (default) = MEASURED per
+    # platform at first use (ops/calibrate.py: dispatch floor x oracle
+    # events/s, persisted next to the compile cache); 0 = never route
+    # (bench.py pins 0 for its kernel lanes); >0 = fixed crossover.
+    # Batches are never routed regardless.
+    oracle_crossover_events: int = -1
+    # [arch] Concurrency ceiling for the oracle route: the frontier can
+    # hold up to 2^pending configurations per state, so a wide-pending
+    # history must take the capped/budgeted device ladder even when its
+    # event count is tiny. 12 pending ops bounds the closure at ~4k
+    # masks/state — comfortably inside the config budget below.
+    oracle_route_max_pending: int = 12
+    # [arch] Transition-attempt budget for a routed oracle run; on
+    # expiry the route abandons the host search and falls through to the
+    # device ladder (ADVICE r4: no unbounded exponential host search on
+    # the product path). ~2M step_py calls is <1 s of host time.
+    oracle_config_budget: int = 2_000_000
     # [arch] Histories per pallas program in the grouped batch kernel
     # (tables stacked on a leading group axis; amortizes per-step
     # instruction overhead — measured 1.6-2.1x end-to-end / ~2.3x

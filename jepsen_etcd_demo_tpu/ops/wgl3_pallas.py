@@ -75,7 +75,34 @@ re-attributed the r3 numbers and drove a 2.4x kernel redesign, 110 ms ->
     routing complexity — while G=4 still OOMs; the gset lane's 1.5x
     target was met by the redesign itself (374 -> 236 ms wall);
     replacing the K-way prune switch (per-history kernel) with dynamic
-    shift+roll+select — 12% slower (r3 measurement, still believed).
+    shift+roll+select — 12% slower (r3 measurement, still believed);
+    Sp=32 SUBLANE-packing (two 32-state tables per 64-sublane block, the
+    r4 verdict's one untried shape) — closed by measurement without
+    building the kernel, because the r5 overhead probe shows there is no
+    overhead pool for ANY packing to reclaim: a 256-history gset corpus
+    of ~3-step histories costs 0.406 ms/history wall, which IS the
+    irreducible per-launch tunnel RT (0.104 s / 256 — see the wall-vs-
+    device note above), so the 150-op lane's 0.935 ms/history splits as
+    ~0.41 launch floor + ~0.53 device work; and 0.53 ms/history of
+    device work is already BELOW the 16x dense-table work ratio vs the
+    Sp=8 grouped lane (32 source-state selects over 4x the rows =>
+    16 x 0.047 ms/history = 0.75 predicted). The per-history Sp=32
+    kernel thus runs ABOVE the grouped kernel's per-op efficiency;
+    sublane-packing would add a rows<32 select per source state (+2 ops
+    in the innermost loop) to amortize per-program costs that measure
+    near zero. The gset <=0.5 ms/history wall target is unreachable on
+    this backend not by kernel shape but by the launch floor itself.
+  * Wall vs device (r5): the corpus wall's non-device share is the
+    tunnel's per-launch round trip itself — an EMPTY compiled launch +
+    one-word fetch measures ~0.104 s, more than the whole wall-minus-
+    device gap (~0.06 s), so the single batched launch already sits on
+    the floor. Wave-pipelining is a measured dead end on this backend:
+    dispatching W sub-batches before any fetch costs ~0.1 s PER WAVE,
+    serialized (2 waves 0.20 s, 4 waves 0.44 s, 8 waves 0.85 s vs
+    0.15 s single) — async dispatch does not overlap tunnel RTs. The
+    bench records empty_launch_s / pipelined_2wave_s every run
+    (bench.py _dispatch_floor); on a local-PCIe runtime the same probes
+    would show a lower floor and waves worth revisiting.
   * Calibration: a peak microbench (independent 8-chain int32 ALU loop,
     zero memory traffic, 5 ops/chain-iteration) sustains ~4.0 G
     vreg-ops/s (~4.1 T word-ops/s) on this v5e core — the honest VPU
@@ -338,9 +365,10 @@ def local_pallas_launcher_resumable(model: Model, cfg: DenseConfig,
                                     interpret: bool = False):
     """launch(R) for the RESUMABLE per-history kernel (B=1 windows):
     jitted (ln i32[1], mt i32[1,5], tg i32[1,R], cm u32[1,R,Sp,128],
-    Tin u32[1,Sp,W]) -> (out i32[1,5], Tout u32[1,Sp,W]). The host loop
-    in check_steps3_long_pallas chains windows, carrying (Tout, out-derived
-    meta) into the next launch."""
+    Tin u32[1,Sp,W], end i32) -> (out i32[5], Tout u32[1,Sp,W],
+    mt_next i32[1,5]). The host loop in check_steps3_long_pallas chains
+    windows, feeding (Tout, mt_next) straight into the next launch — the
+    whole chain is device-side and ONE compiled program per geometry."""
     max_k = limits().max_k_pallas
     if cfg.k_slots > max_k:
         raise ValueError(f"pallas kernel supports k_slots <= {max_k}, "
@@ -383,7 +411,7 @@ def local_pallas_launcher_resumable(model: Model, cfg: DenseConfig,
             ],
         )
 
-        def run(ln, mt, tg, cm, Tin):
+        def run(ln, mt, tg, cm, Tin, end):
             if R_pad != R:
                 tg = jnp.pad(tg, ((0, 0), (0, R_pad - R)),
                              constant_values=-1)
@@ -395,7 +423,18 @@ def local_pallas_launcher_resumable(model: Model, cfg: DenseConfig,
                            jax.ShapeDtypeStruct((1, Sp, W), jnp.uint32)],
                 interpret=interpret,
             )(ln, mt, tg, cm, Tin)
-            return out, Tout
+            # The NEXT window's metadata, chained device-side INSIDE the
+            # jit. `end` (the global step offset after this window) is an
+            # OPERAND, not a Python int: embedding it as a constant gave
+            # every window its own one-off XLA program, and on a remote-
+            # compile backend those tiny compiles (~2 s each over the
+            # tunnel) dwarfed the kernel compile itself — the r4 "16.6 s
+            # cold" was 5 windows of constant-baked stack() programs, not
+            # Mosaic (measured r5: prep 1.5 s + kernel 1.8 s + first
+            # sweep 10.5 s -> 0.4 s warm).
+            mt_next = jnp.stack([1 - out[0], out[2], out[3], out[4],
+                                 end])[None]
+            return out, Tout, mt_next
 
         return jax.jit(run)
 
@@ -468,9 +507,8 @@ def check_steps3_long_pallas(rs, model: Model, cfg: DenseConfig,
         act = np.pad(rs.slot_active[sl], pad + ((0, 0),))[None]
         cm, tgd, ln = prep(jnp.asarray(tabs), jnp.asarray(act),
                            jnp.asarray(tg))
-        out, Tin = launch(window)(ln, meta, tgd, cm, Tin)
-        meta = jnp.stack([1 - out[0], out[2], out[3], out[4],
-                          jnp.int32(w0 + wn)])[None]
+        out, Tin, meta = launch(window)(
+            ln, meta, tgd, cm, Tin, jnp.asarray(w0 + wn, jnp.int32))
         if time_budget_s is not None:
             np.asarray(out)   # sync: bound overshoot by one window
     out_np = np.asarray(out)
@@ -1195,20 +1233,36 @@ def check_batch_encoded_auto(encs: Sequence[EncodedHistory],
     launch.
 
     Tiny SINGLE histories on a live TPU backend route to the exact host
-    oracle instead (VERDICT r3 item 5): below the crossover the device
-    dispatch+fetch round trip alone exceeds the oracle's whole runtime
-    (tutorial-scale analyze, ~150 ops, is ~5 ms host vs ~100 ms of
-    dispatch latency). This is the SAME exact algorithm — not a
-    soundness fallback — and batches never take it (batching amortizes
-    the dispatch)."""
+    oracle instead (VERDICT r3 item 5): below the crossover — measured
+    per platform, ops/calibrate.py — the device dispatch+fetch round
+    trip alone exceeds the oracle's whole runtime (tutorial-scale
+    analyze, ~150 ops, is ~5 ms host vs ~100 ms of dispatch latency on
+    the axon tunnel). This is the SAME exact algorithm — not a soundness
+    fallback — and batches never take it (batching amortizes the
+    dispatch). The route is bounded both ways: wide-pending histories
+    are excluded up front and a transition budget aborts into the device
+    ladder (ADVICE r4 medium)."""
     from . import wgl3
 
     if model is None:
         from ..models import CASRegister
         model = CASRegister()
     if (len(encs) == 1 and pallas_available()
-            and encs[0].n_events <= limits().oracle_crossover_events):
-        return [_oracle_result(encs[0], model)], "oracle-small-history"
+            and encs[0].n_events <= _oracle_crossover()
+            and encs[0].max_pending <= limits().oracle_route_max_pending):
+        # max_pending gate + transition budget (ADVICE r4 medium): the
+        # frontier holds up to 2^pending masks per state, so a tiny-event
+        # but wide-concurrency history could grind an exponential host
+        # search. Wide histories and budget expiries take the capped
+        # device ladder below instead — same verdicts, bounded cost.
+        from ..checkers.oracle import OracleBudgetExceeded
+
+        try:
+            return ([_oracle_result(encs[0], model,
+                                    limits().oracle_config_budget)],
+                    "oracle-small-history")
+        except OracleBudgetExceeded:
+            pass
     dense_idx, general_idx = [], []
     for i, e in enumerate(encs):
         ok = dense_config(model, wgl3.tight_k_slots(e), e.max_value)
@@ -1284,11 +1338,32 @@ def check_batch_encoded_auto(encs: Sequence[EncodedHistory],
     return results, (kernels.pop() if len(kernels) == 1 else "mixed")
 
 
-def _oracle_result(enc: EncodedHistory, model: Model) -> dict:
+def _oracle_crossover() -> int:
+    """Active oracle-route crossover: a non-negative limits() value is
+    authoritative (0 = route off — bench.py pins this for kernel lanes;
+    >0 = fixed); -1 (the default) defers to the per-platform measurement
+    (ops/calibrate.py — dispatch floor x oracle throughput, persisted)."""
+    fixed = limits().oracle_crossover_events
+    if fixed >= 0:
+        return fixed
+    from .calibrate import get_calibration
+
+    return get_calibration().crossover_events
+
+
+def _oracle_result(enc: EncodedHistory, model: Model,
+                   max_configs: int | None = None) -> dict:
     """Host-oracle run shaped like a kernel result (the schema of
-    wgl3.assemble_batch_results, so callers can't tell the backends
-    apart): dead_event (event index) translates to the v2 kernel's
-    return-step index by counting returns strictly before it."""
+    wgl3.assemble_batch_results — `valid`/`dead_step`/`overflow` agree
+    field-for-field with the dense kernel; the search metrics
+    `max_frontier`/`configs_explored` count the SAME quantities — live
+    configs high-water mark and transition attempts — but can differ in
+    value because the oracle's JIT closure regenerates beyond-boundary
+    configs the dense table keeps, see tests/test_oracle.py's
+    field-agreement test): dead_event (event index) translates to the v2
+    kernel's return-step index by counting returns strictly before it.
+    Raises OracleBudgetExceeded past `max_configs` transition attempts —
+    the router falls back to the device ladder."""
     import numpy as np
 
     from ..checkers.oracle import check_events_oracle
@@ -1296,7 +1371,7 @@ def _oracle_result(enc: EncodedHistory, model: Model) -> dict:
 
     from . import wgl3
 
-    res = check_events_oracle(enc, model)
+    res = check_events_oracle(enc, model, max_configs)
     if res.dead_event < 0:
         dead_step = -1
     else:
