@@ -77,6 +77,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="number of times to run the test")
     t.add_argument("--username", default="root", help="ssh username")
     t.add_argument("--private-key", default=None, help="ssh identity file")
+    t.add_argument("--password", default=None,
+                   help="ssh password (jepsen's standard flag; rides "
+                        "sshpass — the password travels via the SSHPASS "
+                        "env var, never on a visible argv)")
     t.add_argument("--seed", type=int, default=0,
                    help="schedule/value rng seed (determinism!)")
     t.add_argument("--store", default="store", help="results store root")
@@ -174,7 +178,8 @@ def _test_opts(args) -> dict:
         "no_nemesis": args.no_nemesis,
         "nemesis": args.nemesis,
         "version": args.version,
-        "ssh": {"username": args.username, "private_key": args.private_key},
+        "ssh": {"username": args.username, "private_key": args.private_key,
+                "password": args.password},
         "stale_read_prob": args.stale_read_prob,
         "lost_write_prob": args.lost_write_prob,
         "duplicate_cas_prob": args.duplicate_cas_prob,
@@ -369,9 +374,11 @@ def cmd_corpus(args) -> int:
         if multislice:
             from ..parallel.multislice import check_corpus_multislice
 
-            results = check_corpus_multislice([e[2] for e in entries],
-                                              model)
-            kernel = "wgl3-dense-multislice"
+            # kernel comes back from the checker itself (ADVICE r4: the
+            # dense-infeasible minority — or a whole corpus — can fall
+            # back to the per-process local ladder; don't misreport it).
+            results, kernel = check_corpus_multislice(
+                [e[2] for e in entries], model)
         else:
             results, kernel = wgl3_pallas.check_batch_encoded_auto(
                 [e[2] for e in entries], model)

@@ -158,10 +158,14 @@ class EtcdClient:
         side loop verschlimmbesserung's swap! runs (reference set.clj:26-31)."""
         for _ in range(64):
             cur, idx = await self.get_with_index(key, quorum=True)
-            new = fn(cur)
+            # str() BEFORE returning, not just before sending: the store
+            # holds strings, so the value this call reports must be the
+            # value a subsequent get() observes (caught by the live
+            # five-call integration test when fn returns an int).
+            new = str(fn(cur))
             body = await self._request(
                 "PUT", self._url(key),
-                data={"value": str(new)}, params={"prevIndex": str(idx)})
+                data={"value": new}, params={"prevIndex": str(idx)})
             if body.get("errorCode") == ETCD_CAS_FAILED:
                 continue
             self._raise_for(body)

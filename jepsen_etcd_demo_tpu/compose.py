@@ -427,7 +427,11 @@ def _pause_nemesis(seed: int):
 def etcd_test(opts: dict) -> dict:
     """The real composition (reference etcd-test, :146-175): Debian OS prep,
     etcd v3.1.5 DB, SSH control, iptables partition nemesis."""
-    test = compose_test(opts, etcd_conn_factory())
+    from .db.etcd import CLIENT_PORT
+
+    # The DB layer's (env-overridable) client port, so the data plane
+    # dials wherever the daemon actually listens.
+    test = compose_test(opts, etcd_conn_factory(port=CLIENT_PORT))
     test["db"] = EtcdDB(version=opts.get("version", "v3.1.5"))
     test["os_setup"] = lambda runner, node: debian_setup(runner, node)
     test["nemesis"] = pick_nemesis(test, db=test["db"])

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import asyncio
+import os
 import shlex
 from dataclasses import dataclass
 from typing import Optional, Sequence
@@ -60,11 +61,13 @@ class Runner:
         raise NotImplementedError
 
     async def _spawn(self, argv: Sequence[str], check: bool,
-                     timeout_s: float) -> CommandResult:
+                     timeout_s: float,
+                     env: Optional[dict] = None) -> CommandResult:
         proc = await asyncio.create_subprocess_exec(
             *argv,
             stdout=asyncio.subprocess.PIPE,
-            stderr=asyncio.subprocess.PIPE)
+            stderr=asyncio.subprocess.PIPE,
+            env=env)
         try:
             out, err = await asyncio.wait_for(proc.communicate(), timeout_s)
         except asyncio.TimeoutError:
@@ -115,56 +118,75 @@ class SSHRunner(Runner):
 
     def __init__(self, node: str, username: str = "root",
                  port: int = 22, private_key: Optional[str] = None,
+                 password: Optional[str] = None,
                  strict_host_key_checking: bool = False,
                  connect_timeout_s: int = 10):
         self.node = node
         self.username = username
         self.port = port
         self.private_key = private_key
+        self.password = password
         self.strict = strict_host_key_checking
         self.connect_timeout_s = connect_timeout_s
 
-    def _ssh_argv(self, cmd: str) -> list[str]:
-        argv = ["ssh", "-p", str(self.port),
-                "-o", "BatchMode=yes",
-                "-o", f"ConnectTimeout={self.connect_timeout_s}"]
+    def _common_opts(self) -> list[str]:
+        # Password auth (jepsen's --password, the jsch password session)
+        # rides sshpass: OpenSSH refuses passwords on argv/stdin by
+        # design, and BatchMode=yes would disable the prompt sshpass
+        # answers — so BatchMode only guards the key-auth mode.
+        opts = (["-o", "NumberOfPasswordPrompts=1"] if self.password
+                else ["-o", "BatchMode=yes"])
         if not self.strict:
-            argv += ["-o", "StrictHostKeyChecking=no",
+            opts += ["-o", "StrictHostKeyChecking=no",
                      "-o", "UserKnownHostsFile=/dev/null",
                      "-o", "LogLevel=ERROR"]
         if self.private_key:
-            argv += ["-i", self.private_key]
-        argv += [f"{self.username}@{self.node}", cmd]
-        return argv
+            opts += ["-i", self.private_key]
+        return opts
+
+    def _transport(self, argv: list[str]) -> tuple[list[str], Optional[dict]]:
+        """Final (argv, env) for one ssh/scp invocation. The password is
+        handed to sshpass through the environment (`-e`/SSHPASS), never
+        on argv — argv is visible to every local `ps`."""
+        if not self.password:
+            return argv, None
+        import shutil
+
+        if shutil.which("sshpass") is None:
+            # Fail with the remedy, not a FileNotFoundError five frames
+            # deep in asyncio's spawn path.
+            raise RuntimeError(
+                "--password auth rides the sshpass binary (OpenSSH "
+                "refuses passwords on argv by design) and sshpass is "
+                "not on PATH; install it or use --private-key")
+        env = dict(os.environ, SSHPASS=self.password)
+        return ["sshpass", "-e"] + argv, env
+
+    def _ssh_argv(self, cmd: str) -> list[str]:
+        return (["ssh", "-p", str(self.port),
+                 "-o", f"ConnectTimeout={self.connect_timeout_s}"]
+                + self._common_opts()
+                + [f"{self.username}@{self.node}", cmd])
 
     async def run(self, cmd: str, su: bool = False, check: bool = True,
                   timeout_s: float = 120.0) -> CommandResult:
         if su and self.username != "root":
             cmd = f"sudo sh -c {shellquote(cmd)}"
-        return await self._spawn(self._ssh_argv(cmd), check, timeout_s)
+        argv, env = self._transport(self._ssh_argv(cmd))
+        return await self._spawn(argv, check, timeout_s, env)
 
     async def upload(self, local_path: str, remote_path: str) -> CommandResult:
-        argv = ["scp", "-P", str(self.port), "-o", "BatchMode=yes"]
-        if not self.strict:
-            argv += ["-o", "StrictHostKeyChecking=no",
-                     "-o", "UserKnownHostsFile=/dev/null",
-                     "-o", "LogLevel=ERROR"]
-        if self.private_key:
-            argv += ["-i", self.private_key]
-        argv += [local_path, f"{self.username}@{self.node}:{remote_path}"]
-        return await self._spawn(argv, True, 300.0)
+        argv, env = self._transport(
+            ["scp", "-P", str(self.port)] + self._common_opts()
+            + [local_path, f"{self.username}@{self.node}:{remote_path}"])
+        return await self._spawn(argv, True, 300.0, env)
 
     async def download(self, remote_path: str, local_path: str,
                        check: bool = False) -> CommandResult:
-        argv = ["scp", "-P", str(self.port), "-o", "BatchMode=yes"]
-        if not self.strict:
-            argv += ["-o", "StrictHostKeyChecking=no",
-                     "-o", "UserKnownHostsFile=/dev/null",
-                     "-o", "LogLevel=ERROR"]
-        if self.private_key:
-            argv += ["-i", self.private_key]
-        argv += [f"{self.username}@{self.node}:{remote_path}", local_path]
-        return await self._spawn(argv, check, 300.0)
+        argv, env = self._transport(
+            ["scp", "-P", str(self.port)] + self._common_opts()
+            + [f"{self.username}@{self.node}:{remote_path}", local_path])
+        return await self._spawn(argv, check, 300.0, env)
 
 
 def runner_for(test: dict, node: str) -> Runner:
@@ -176,4 +198,5 @@ def runner_for(test: dict, node: str) -> Runner:
                      username=ssh.get("username", "root"),
                      port=ssh.get("port", 22),
                      private_key=ssh.get("private_key"),
+                     password=ssh.get("password"),
                      strict_host_key_checking=ssh.get("strict", False))

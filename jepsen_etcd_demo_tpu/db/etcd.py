@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 
 from ..control.runner import Runner
 from ..control.daemon import install_archive, start_daemon, stop_daemon
@@ -17,13 +18,21 @@ from .base import DB
 
 log = logging.getLogger(__name__)
 
-DIR = "/opt/etcd"                       # reference :25
+# JEPSEN_TPU_ETCD_DIR: hermetic runs (the in-image minietcd integration
+# lane) relocate the install under a scratch dir; the default is the
+# reference's path. Resolved at import: the env travels to the CLI
+# subprocess, not across a long-lived interpreter.
+DIR = os.environ.get("JEPSEN_TPU_ETCD_DIR", "/opt/etcd")   # reference :25
 BINARY = "etcd"                         # :26
 LOGFILE = f"{DIR}/etcd.log"             # :27
 PIDFILE = f"{DIR}/etcd.pid"             # :28
 
-PEER_PORT = 2380                        # support.clj:9-12
-CLIENT_PORT = 2379                      # support.clj:14-17
+# Port env overrides exist for the same hermetic lane (several runs on
+# one host must not fight over fixed ports); the defaults are etcd's.
+PEER_PORT = int(os.environ.get(
+    "JEPSEN_TPU_ETCD_PEER_PORT", "2380"))       # support.clj:9-12
+CLIENT_PORT = int(os.environ.get(
+    "JEPSEN_TPU_ETCD_CLIENT_PORT", "2379"))     # support.clj:14-17
 
 DEFAULT_VERSION = "v3.1.5"              # reference :162
 
@@ -47,16 +56,25 @@ def initial_cluster(nodes: list[str]) -> str:
 
 
 def tarball_url(version: str) -> str:
-    """Release tarball location (reference :37-40)."""
+    """Release tarball location (reference :37-40).
+    JEPSEN_TPU_ETCD_TARBALL overrides it wholesale (any scheme curl
+    speaks, file:// included) — how the in-image lane feeds the minietcd
+    release tarball through the UNCHANGED install path."""
+    override = os.environ.get("JEPSEN_TPU_ETCD_TARBALL")
+    if override:
+        return override
     return (f"https://storage.googleapis.com/etcd/{version}/"
             f"etcd-{version}-linux-amd64.tar.gz")
 
 
 class EtcdDB(DB):
     def __init__(self, version: str = DEFAULT_VERSION,
-                 settle_s: float = 10.0):
+                 settle_s: float | None = None):
         self.version = version
-        self.settle_s = settle_s  # convergence wait (reference :55)
+        # Convergence wait (reference :55); a single-member stand-in
+        # settles instantly, so the hermetic lane shrinks it by env.
+        self.settle_s = (settle_s if settle_s is not None else float(
+            os.environ.get("JEPSEN_TPU_ETCD_SETTLE_S", "10.0")))
 
     async def setup(self, test: dict, r: Runner, node: str) -> None:
         log.info("installing etcd %s on %s", self.version, node)

@@ -21,13 +21,19 @@ RESULTS_FILE = "results.json"
 def _jsonable_test(test: dict) -> dict:
     """The test map holds live objects (client, checker, generator); persist
     the data fields and the repr of the rest, like jepsen prunes its test map
-    before serialization."""
+    before serialization. Credentials never reach disk: the ssh password
+    (control/runner.py routes it via the SSHPASS env precisely to keep it
+    out of observable surfaces) is redacted here — the store is a shareable
+    results artifact."""
     out = {}
     for k, v in test.items():
         if isinstance(v, (str, int, float, bool, type(None), list, dict)):
             out[k] = v
         else:
             out[k] = repr(v)
+    ssh = out.get("ssh")
+    if isinstance(ssh, dict) and ssh.get("password"):
+        out["ssh"] = {**ssh, "password": "<redacted>"}
     return out
 
 

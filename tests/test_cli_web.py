@@ -56,6 +56,18 @@ class TestParser:
         assert a.ops_per_key == 100       # :184
         assert a.nodes == "n1,n2,n3,n4,n5"  # noop-test defaults [dep]
 
+    def test_password_flag_reaches_ssh_opts(self):
+        # jepsen's standard ssh opt set includes password auth
+        # (noop-test ssh map [dep]); plumbed through to runner_for's
+        # ssh dict (control/runner.py sshpass transport).
+        from jepsen_etcd_demo_tpu.cli.main import _test_opts
+        a = build_parser().parse_args(
+            ["test", "-w", "register", "--password", "pw",
+             "--username", "u"])
+        opts = _test_opts(a)
+        assert opts["ssh"] == {"username": "u", "private_key": None,
+                               "password": "pw"}
+
 
 class TestExitContract:
     def test_valid_run_exits_zero_and_stores(self, tmp_path, capsys):

@@ -6,9 +6,17 @@ over the ("slice", "batch") mesh — the one-machine simulation of a
 multi-host pod. Marked slow: two process spawns + two kernel compiles.
 """
 
+import json
+import os
+import subprocess
+import sys
+import time
+
 import pytest
 
-from jepsen_etcd_demo_tpu.parallel.multislice import dryrun_multislice
+from jepsen_etcd_demo_tpu.parallel.multislice import (
+    MultisliceWorkerFailed, _free_port, dryrun_multislice,
+    supervise_workers)
 
 
 @pytest.mark.slow
@@ -19,19 +27,45 @@ def test_multislice_two_processes_agree_with_oracle():
 
 
 @pytest.mark.slow
-def test_corpus_cli_multislice_parity(tmp_path):
-    """VERDICT r3 item 4: the DCN multislice path must be reachable
-    THROUGH the product CLI (`corpus --coordinator ...`), not only from
-    dryrun helpers — two localhost processes over virtual CPU devices
-    must print the identical gathered verdict, agreeing with the
-    single-process corpus run on the same store."""
-    import json
-    import os
-    import subprocess
-    import sys
-
+def test_multislice_worker_death_fails_fast():
+    """VERDICT r4 weak #5: a worker dying mid-run must produce a named
+    error promptly — not a survivors-blocked hang bounded only by the
+    overall timeout. The crash hook kills worker 1 right after it joins
+    the distributed system; the supervisor must kill the survivors and
+    raise within seconds."""
     from jepsen_etcd_demo_tpu.parallel.multislice import _free_port
 
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["JEPSEN_TPU_MULTISLICE_CRASH_PID"] = "1"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m",
+             "jepsen_etcd_demo_tpu.parallel.multislice",
+             coord, "2", str(pid), "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for pid in range(2)
+    ]
+    t0 = time.monotonic()
+    with pytest.raises(MultisliceWorkerFailed) as e:
+        supervise_workers(procs, timeout_s=600.0)
+    # Named: WHICH worker, and fast: far under the 600 s budget (the
+    # survivor was still alive, blocked on the dead peer).
+    assert e.value.pid == 1 and e.value.returncode == 3
+    assert "CRASH_HOOK" in str(e.value)
+    assert time.monotonic() - t0 < 120
+    for p in procs:
+        assert p.poll() is not None      # nothing left running
+
+
+def _cli_multislice_run(tmp_path, n_procs: int, devices_per_proc: int,
+                        seed: str = "3"):
+    """Shared CLI-path harness: `test --fake` builds a store, a single-
+    process `corpus` gives the reference verdict, then n_procs CLI
+    workers re-check it over the ("slice","batch") mesh. Returns
+    (single_out, [per-process out])."""
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     env["JAX_PLATFORMS"] = "cpu"
@@ -39,7 +73,7 @@ def test_corpus_cli_multislice_parity(tmp_path):
     cli = [sys.executable, "-m", "jepsen_etcd_demo_tpu.cli.main"]
     run = subprocess.run(
         cli + ["test", "-w", "register", "--fake", "--time-limit", "1",
-               "--rate", "50", "--store", store, "--seed", "3"],
+               "--rate", "50", "--store", store, "--seed", seed],
         env=env, capture_output=True, text=True, timeout=300)
     assert run.returncode == 0, run.stderr[-2000:]
 
@@ -54,29 +88,51 @@ def test_corpus_cli_multislice_parity(tmp_path):
     procs = [
         subprocess.Popen(
             cli + ["corpus", store, "--coordinator", coord,
-                   "--num-processes", "2", "--process-id", str(pid),
-                   "--local-devices", "2"],
-            env=ms_env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True)
-        for pid in range(2)
+                   "--num-processes", str(n_procs),
+                   "--process-id", str(pid),
+                   "--local-devices", str(devices_per_proc)],
+            env=ms_env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for pid in range(n_procs)
     ]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=600)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        assert p.returncode == 0, err[-2000:]
-        outs.append(json.loads(out.strip().splitlines()[-1]))
-
+    outs = [json.loads(out.strip().splitlines()[-1])
+            for out in supervise_workers(procs, timeout_s=600.0)]
     for pid, o in enumerate(outs):
-        assert o["kernel"] == "wgl3-dense-multislice"
-        assert o["processes"] == 2 and o["devices"] == 4
+        assert o["processes"] == n_procs
+        assert o["devices"] == n_procs * devices_per_proc
         assert o["process_id"] == pid
         # Verdict parity with the single-process pass over the same store.
         assert o["valid"] == single_out["valid"]
         assert o["keys"] == single_out["keys"]
         assert o["runs"] == single_out["runs"]
         assert o["invalid"] == single_out["invalid"]
+    return single_out, outs
+
+
+@pytest.mark.slow
+def test_corpus_cli_multislice_parity(tmp_path):
+    """VERDICT r3 item 4: the DCN multislice path must be reachable
+    THROUGH the product CLI (`corpus --coordinator ...`), not only from
+    dryrun helpers — two localhost processes over virtual CPU devices
+    must print the identical gathered verdict, agreeing with the
+    single-process corpus run on the same store."""
+    _, outs = _cli_multislice_run(tmp_path, n_procs=2, devices_per_proc=2)
+    for o in outs:
+        assert o["kernel"] == "wgl3-dense-multislice"
+
+
+@pytest.mark.slow
+def test_corpus_cli_multislice_three_processes_ragged(tmp_path):
+    """VERDICT r4 weak #5: n>=3 processes through the CLI path, over a
+    corpus whose key count does NOT divide the 3x2=6 mesh shards — the
+    pad-with-empty-histories path must produce the same verdicts as the
+    single-process pass."""
+    single_out, outs = _cli_multislice_run(
+        tmp_path, n_procs=3, devices_per_proc=2, seed="7")
+    # The point of this lane is raggedness: the corpus must not divide
+    # evenly over the 6 shards (the seed is chosen to guarantee it; if a
+    # generator change breaks this, pick a new seed — don't delete the
+    # assert).
+    assert single_out["keys"] % 6 != 0
+    for o in outs:
+        assert o["kernel"] == "wgl3-dense-multislice"
