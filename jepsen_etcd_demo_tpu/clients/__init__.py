@@ -5,7 +5,8 @@ demo (register client: src/jepsen/etcdemo.clj:76-108; set client:
 src/jepsen/etcdemo/set.clj:10-40).
 """
 
-from .base import Client, ClientError, Timeout, NotFound  # noqa: F401
+from .base import (Client, ClientError, ConnectionRefused,  # noqa: F401
+                   NotFound, Timeout)
 from .fake_kv import FakeKVStore  # noqa: F401
 from .queue_client import QueueClient  # noqa: F401
 from .register import RegisterClient  # noqa: F401

@@ -41,6 +41,18 @@ class RetriesExhausted(ClientError):
     inside the loop raises Timeout out of it directly instead."""
 
 
+class ConnectionRefused(ClientError):
+    """TCP connect failed before any request byte was transmitted — a
+    DETERMINATE failure (the op cannot have taken effect), so every
+    client's generic ClientError arm maps it to :fail. Distinguishing it
+    from the indeterminate Timeout -> :info matters operationally: under
+    a kill nemesis every op in the dead window is refused, and mapping
+    those to :info would flood the history with forever-pending slots
+    (~rate x window of them) the linearizability search must then carry
+    — measured r5: a 6 s kill window at rate 20 adds ~100 pending ops
+    and pushes the check toward its wall-clock budget for nothing."""
+
+
 class Timeout(Exception):
     """Indeterminate: the op may or may not have taken effect
     (SocketTimeoutException edge, src/jepsen/etcdemo.clj:100-102)."""

@@ -20,8 +20,8 @@ from typing import Any, Optional
 
 import httpx
 
-from .base import (ClientError, IndeterminateDequeue, NotFound,
-                   RetriesExhausted, Timeout)
+from .base import (ClientError, ConnectionRefused, IndeterminateDequeue,
+                   NotFound, RetriesExhausted, Timeout)
 
 ETCD_KEY_MISSING = 100   # etcd v2 errorCode for absent key (reference :104)
 ETCD_CAS_FAILED = 101    # compare failed
@@ -64,8 +64,21 @@ class EtcdClient:
         try:
             resp = await self.http.request(method, url, **kw)
             return resp.json()
-        except (httpx.TimeoutException, httpx.ConnectError,
-                httpx.ReadError, httpx.RemoteProtocolError) as e:
+        except httpx.ConnectError as e:
+            # No TCP connection ever formed: the request was never
+            # transmitted, so the failure is DETERMINATE (:fail), unlike
+            # the indeterminate cases below. ConnectTimeout is excluded
+            # on purpose — a SYN that got no reply proves nothing about
+            # what the peer received.
+            raise ConnectionRefused(str(e)) from e
+        except (httpx.TimeoutException, httpx.ReadError, httpx.WriteError,
+                httpx.CloseError, httpx.RemoteProtocolError) as e:
+            # Includes WriteError/CloseError: a reused keep-alive
+            # connection to a just-killed server fails on SEND
+            # (EPIPE/ECONNRESET) — bytes may have been transmitted, so
+            # these stay indeterminate, and mapping them here keeps them
+            # out of the runner's crash arm (which would also burn a
+            # logical process on reincarnation).
             raise Timeout(str(e)) from e
 
     # -- the 5-call surface ----------------------------------------------

@@ -6,6 +6,8 @@ including the load-bearing error mapping:
   * timeout on write/cas   -> :info (indeterminate!)      [:100-102]
   * key-missing (etcd 100) -> :fail (:error :not-found)   [:104-105]
   * cas returned false     -> :fail                       [:95-98]
+  * connection refused     -> :fail (determinate — the request never
+    left; clients/base.py ConnectionRefused, via the ClientError arm)
 
 Values are (key, value) independent-tuples (reference :84,:90); reads parse
 the stored string to an int, None surviving for missing keys (:71-74,:87-90).

@@ -256,9 +256,15 @@ def main(argv=None) -> int:
         print(f"etcd Version: {VERSION}\nGit SHA: none\n"
               f"Go Version: none (python stand-in)")
         return 0
-    if args.data_dir:
-        os.makedirs(args.data_dir, exist_ok=True)
-    store = KeyStore(args.data_dir)
+    # Real etcd defaults its data dir to <name>.etcd under the working
+    # directory; matching it means EtcdDB's argv (which passes no
+    # --data-dir, reference :42-54) gets DURABLE state under the install
+    # dir — a kill-nemesis restart must not lose acked writes, and
+    # teardown's rm -rf of the install dir wipes it exactly like the
+    # reference's teardown.
+    data_dir = args.data_dir or f"{args.name}.etcd"
+    os.makedirs(data_dir, exist_ok=True)
+    store = KeyStore(data_dir)
     host, port = _url_port(args.listen_client_urls, 2379)
     peer_host, peer_port = _url_port(args.listen_peer_urls, 2380)
     # Hold the peer port like real etcd does: a second member pointed at
@@ -276,7 +282,7 @@ def main(argv=None) -> int:
         target=server.shutdown, daemon=True).start())
     print(f"minietcd {VERSION} member {args.name}: serving client "
           f"requests on http://{host}:{port} (peer {peer_port}, "
-          f"data-dir {args.data_dir or 'none'})", flush=True)
+          f"data-dir {data_dir})", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -275,6 +275,35 @@ class TestEtcdClient:
             await c.close()
         go(t())
 
+    def test_connection_refused_is_determinate_fail(self):
+        """A dead server (kill-nemesis window) refuses TCP outright: the
+        request was never transmitted, so the client raises the
+        DETERMINATE ConnectionRefused (a ClientError -> :fail), not the
+        indeterminate Timeout -> :info — otherwise every op in a kill
+        window becomes a forever-pending slot the checker must carry."""
+        import socket
+
+        from jepsen_etcd_demo_tpu.clients.base import ConnectionRefused
+        from jepsen_etcd_demo_tpu.clients.register import RegisterClient
+        from jepsen_etcd_demo_tpu.ops.op import Op
+
+        with socket.socket() as s:          # reserve a port nobody serves
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        async def t():
+            c = EtcdClient(f"http://127.0.0.1:{port}", timeout_s=2.0)
+            with pytest.raises(ConnectionRefused):
+                await c.get("k")
+            rc = RegisterClient(lambda test, node: c, conn=c)
+            done = await rc.invoke({}, Op(type="invoke", f="write",
+                                          value=("0", 1), process=0))
+            await c.close()
+            return done
+
+        done = go(t())
+        assert done.type == "fail"          # determinate, NOT info
+
 
 # --- daemon helpers over LocalRunner ---------------------------------------
 

@@ -315,24 +315,11 @@ def test_etcd_queue_fifo(etcd_server):
 
 # -- full product path: CLI test -> SSH -> install -> daemon -> HTTP --------
 
-@pytest.mark.slow
-def test_full_cli_run_against_spawned_etcd(tmp_path):
-    """VERDICT r4 missing #1 / next #2: the COMPLETE L3->L4->L5a product
-    path executing in this image, nothing stubbed in-process:
-
-      `cli test -w register` (a real subprocess)
-        -> SSHRunner over the argv-compatible transport shim   (L3)
-        -> EtcdDB: tarball install_archive + start_daemon      (L4)
-           of a real spawned etcd-compatible server process
-           (db/minietcd.py via the release-shaped tarball)
-        -> EtcdClient HTTP traffic from 5 concurrent workers   (L5a)
-        -> linearizability verdict + store artifact            (L2/L1)
-
-    The shim is used UNCONDITIONALLY here (not only when OpenSSH is
-    absent): the CLI has no ssh-port flag, so a throwaway sshd on an
-    ephemeral port is unreachable through the product surface — and the
-    lane's point is the path, not the crypto. Real-sshd transport is
-    covered by the SSHRunner tests above on hosts that have one."""
+def _spawned_etcd_cli_run(tmp_path, extra_args, timeout_s=600):
+    """Shared harness for product-path lanes against the spawned
+    minietcd: shims on PATH, release-shaped tarball, hermetic env, one
+    CLI `test` subprocess. Returns (verdict, run_dir, history, etcd_dir,
+    raw stdout/stderr)."""
     import json
     import sys
 
@@ -365,28 +352,51 @@ def test_full_cli_run_against_spawned_etcd(tmp_path):
     out = subprocess.run(
         [sys.executable, "-m", "jepsen_etcd_demo_tpu.cli.main",
          "test", "-w", "register", "--nodes", "localhost",
-         "--nemesis", "noop", "--time-limit", "4", "--rate", "30",
          "--concurrency", "5", "--store", str(store), "--seed", "5",
-         # Password auth rides the whole path too (sshpass shim asserts
-         # the -e/SSHPASS contract; store redaction asserted below).
-         "--password", "sekrit-pw"],
-        env=env, capture_output=True, text=True, timeout=600)
+         *extra_args],
+        env=env, capture_output=True, text=True, timeout=timeout_s)
     assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-3000:])
     verdict = json.loads(out.stdout.strip().splitlines()[-1])
+    runs = sorted(store.glob("*/*/history.jsonl"))
+    assert runs, list(store.rglob("*"))
+    hist = [json.loads(ln) for ln in
+            runs[0].read_text().splitlines() if ln.strip()]
+    return verdict, runs[0].parent, hist, etcd_dir, out
+
+
+@pytest.mark.slow
+def test_full_cli_run_against_spawned_etcd(tmp_path):
+    """VERDICT r4 missing #1 / next #2: the COMPLETE L3->L4->L5a product
+    path executing in this image, nothing stubbed in-process:
+
+      `cli test -w register` (a real subprocess)
+        -> SSHRunner over the argv-compatible transport shim   (L3)
+        -> EtcdDB: tarball install_archive + start_daemon      (L4)
+           of a real spawned etcd-compatible server process
+           (db/minietcd.py via the release-shaped tarball)
+        -> EtcdClient HTTP traffic from 5 concurrent workers   (L5a)
+        -> linearizability verdict + store artifact            (L2/L1)
+
+    The shim is used UNCONDITIONALLY here (not only when OpenSSH is
+    absent): the CLI has no ssh-port flag, so a throwaway sshd on an
+    ephemeral port is unreachable through the product surface — and the
+    lane's point is the path, not the crypto. Real-sshd transport is
+    covered by the SSHRunner tests above on hosts that have one."""
+    verdict, run_dir, hist, etcd_dir, _ = _spawned_etcd_cli_run(
+        tmp_path,
+        ["--nemesis", "noop", "--time-limit", "4", "--rate", "30",
+         # Password auth rides the whole path too (sshpass shim asserts
+         # the -e/SSHPASS contract; store redaction asserted below).
+         "--password", "sekrit-pw"])
     assert verdict["valid"] is True
     assert verdict["op_count"] > 20          # real traffic flowed
     # Store artifact (L1): history + per-run log + the DB log the
     # teardown path downloaded off the "node".
-    runs = sorted((store).glob("*/*/history.jsonl"))
-    assert runs, list(store.rglob("*"))
-    run_dir = runs[0].parent
     assert (run_dir / "jepsen.log").exists()
     assert (run_dir / "localhost-etcd.log").exists()
     assert "minietcd" in (run_dir / "localhost-etcd.log").read_text()
     # History really went over HTTP to the spawned server: ops completed
     # with ok/fail, not all info-timeouts.
-    hist = [json.loads(ln) for ln in
-            runs[0].read_text().splitlines() if ln.strip()]
     assert any(op["type"] == "ok" for op in hist)
     # The password reached the transport (SSHPASS env) but must NOT
     # reach the store artifact (store/store.py redaction).
@@ -395,3 +405,69 @@ def test_full_cli_run_against_spawned_etcd(tmp_path):
     assert "<redacted>" in test_json
     # Teardown killed the daemon and removed the install dir.
     assert not (etcd_dir / "etcd.pid").exists()
+
+
+@pytest.mark.slow
+def test_kill_nemesis_against_spawned_etcd(tmp_path):
+    """The process fault plane against a REAL daemon (previously only
+    ever fired e2e against the in-process fake): the kill nemesis stops
+    the spawned minietcd mid-run (in-flight ops degrade to :info;
+    refused connections in the dead window are determinate :fail),
+    the :stop op re-runs EtcdDB.setup (reinstall + restart), acked
+    writes survive the kill (etcd-default <name>.etcd data dir under
+    the install dir), and the whole history still checks linearizable."""
+    # 25 s main phase against the 5 s/5 s nemesis cycle: kill@5, stop
+    # fires @10 but the restart (reinstall + start + 3 s settle over the
+    # shim) completes ~16-17 — leaving a ~5 s served window before the
+    # next kill@~22. A 17 s limit measured the restart completing AT the
+    # limit with zero client ops after it.
+    verdict, run_dir, hist, etcd_dir, _ = _spawned_etcd_cli_run(
+        tmp_path,
+        ["--nemesis", "kill", "--time-limit", "25", "--rate", "20"],
+        timeout_s=900)
+    assert verdict["valid"] is True
+    nem = [op for op in hist if op["process"] == "nemesis"
+           and op["type"] == "info"]
+    killed = [op for op in nem if op["f"] == "start"
+              and isinstance(op["value"], dict)
+              and op["value"].get("killed") == ["localhost"]]
+    restarted = [op for op in nem if op["f"] == "stop"
+                 and isinstance(op["value"], dict)
+                 and op["value"].get("restarted") == ["localhost"]]
+    assert killed and restarted, nem
+    # Traffic flowed BOTH before the first kill and after the first
+    # MID-RUN restart (the heal-phase stop at history end has no client
+    # ops after it by construction) — the restart path really served,
+    # persistence included.
+    first_kill = next(i for i, op in enumerate(hist)
+                      if op["process"] == "nemesis" and op["f"] == "start")
+    first_restart = next(
+        i for i, op in enumerate(hist)
+        if op["process"] == "nemesis" and op["f"] == "stop"
+        and isinstance(op["value"], dict)
+        and op["value"].get("restarted") == ["localhost"])
+    assert any(op["type"] == "ok" for op in hist[:first_kill])
+    assert any(op["type"] == "ok" for op in hist[first_restart:])
+
+
+@pytest.mark.slow
+def test_pause_nemesis_against_spawned_etcd(tmp_path):
+    """SIGSTOP/SIGCONT against the real daemon: a paused server answers
+    nothing (a SIGSTOPped process still ACCEPTS the TCP connection via
+    the kernel backlog, so ops time out -> :info, never :fail — the op
+    may still apply on resume), resumes without restart, history stays
+    linearizable."""
+    verdict, _, hist, _, _ = _spawned_etcd_cli_run(
+        tmp_path,
+        ["--nemesis", "pause", "--time-limit", "12", "--rate", "20"],
+        timeout_s=900)
+    assert verdict["valid"] is True
+    nem = [op for op in hist if op["process"] == "nemesis"
+           and op["type"] == "info"]
+    assert any(op["f"] == "start" and isinstance(op["value"], dict)
+               and op["value"].get("paused") == ["localhost"]
+               for op in nem), nem
+    assert any(op["f"] == "stop" and isinstance(op["value"], dict)
+               and op["value"].get("resumed") == ["localhost"]
+               for op in nem), nem
+    assert any(op["type"] == "ok" for op in hist)

@@ -1,0 +1,125 @@
+"""minietcd unit surface (db/minietcd.py): KeyStore v2 semantics,
+flag-parser argv compatibility, packaging helpers. The spawned-process
+behavior (daemon lifecycle, kill/pause survival, full product path) is
+covered by tests/test_integration.py."""
+
+from __future__ import annotations
+
+import json
+import os
+import tarfile
+
+import pytest
+
+from jepsen_etcd_demo_tpu.db import etcd as etcd_mod
+from jepsen_etcd_demo_tpu.db.minietcd import (KeyStore, VERSION,
+                                              build_parser,
+                                              make_release_tarball,
+                                              write_launcher)
+
+
+class TestKeyStore:
+    def test_get_missing_is_100(self):
+        st = KeyStore()
+        status, body = st.get("nope")
+        assert status == 404 and body["errorCode"] == 100
+
+    def test_put_get_roundtrip_bumps_index(self):
+        st = KeyStore()
+        s1, b1 = st.put("k", "a", None, None)
+        s2, b2 = st.put("k", "b", None, None)
+        assert (s1, s2) == (200, 200)
+        assert b2["node"]["modifiedIndex"] == b1["node"]["modifiedIndex"] + 1
+        assert st.get("k")[1]["node"]["value"] == "b"
+
+    def test_cas_prev_value_and_index(self):
+        st = KeyStore()
+        st.put("k", "1", None, None)
+        idx = st.get("k")[1]["node"]["modifiedIndex"]
+        assert st.put("k", "2", "0", None)[1]["errorCode"] == 101
+        assert st.put("k", "2", "1", None)[0] == 200
+        assert st.put("k", "3", None, idx)[1]["errorCode"] == 101  # stale
+        s, _ = st.put("k", "3", None, idx + 1)
+        assert s == 200
+        # CAS on a missing key is 100 (NotFound), matching etcd — the
+        # client maps it to NotFound, not a compare failure.
+        assert st.put("ghost", "1", "0", None)[1]["errorCode"] == 100
+
+    def test_post_in_order_keys_and_dir_listing(self):
+        st = KeyStore()
+        for v in "abc":
+            s, body = st.post("q", v)
+            assert s == 201 and body["action"] == "create"
+        s, body = st.get("q")
+        assert s == 200 and body["node"]["dir"] is True
+        assert [n["value"] for n in body["node"]["nodes"]] == ["a", "b", "c"]
+        # Lexicographic node-name order == creation order (zero padding).
+        names = [n["key"] for n in body["node"]["nodes"]]
+        assert names == sorted(names)
+
+    def test_compare_and_delete(self):
+        st = KeyStore()
+        st.post("q", "head")
+        node = st.get("q")[1]["node"]["nodes"][0]
+        key = node["key"].lstrip("/")
+        assert st.delete(key, node["modifiedIndex"] + 1)[1]["errorCode"] \
+            == 101
+        assert st.delete(key, node["modifiedIndex"])[0] == 200
+        assert st.delete(key, None)[1]["errorCode"] == 100   # gone
+
+    def test_persistence_roundtrip(self, tmp_path):
+        st = KeyStore(str(tmp_path))
+        st.put("k", "v", None, None)
+        st.post("q", "x")
+        st2 = KeyStore(str(tmp_path))
+        assert st2.index == st.index
+        assert st2.get("k")[1]["node"]["value"] == "v"
+        assert st2.get("q")[1]["node"]["nodes"][0]["value"] == "x"
+
+    def test_snapshot_is_single_file_json(self, tmp_path):
+        st = KeyStore(str(tmp_path))
+        st.put("k", "v", None, None)
+        snap = json.loads((tmp_path / "minietcd.json").read_text())
+        assert snap["index"] == 1 and snap["keys"]["k"] == ["v", 1]
+
+
+class TestArgv:
+    def test_accepts_the_etcddb_flag_surface(self):
+        # The EXACT argv EtcdDB passes (db/etcd.py setup) must parse.
+        args = build_parser().parse_args([
+            "--log-output", "stderr",
+            "--name", "n1",
+            "--listen-peer-urls", "http://n1:2380",
+            "--listen-client-urls", "http://n1:2379",
+            "--advertise-client-urls", "http://n1:2379",
+            "--initial-cluster-state", "new",
+            "--initial-advertise-peer-urls", "http://n1:2380",
+            "--initial-cluster", "n1=http://n1:2380"])
+        assert args.name == "n1"
+
+    def test_unknown_flag_rejected_like_real_etcd(self, capsys):
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(["--no-such-flag", "x"])
+
+    def test_version_string_parses_as_v2_era(self):
+        # test_integration._etcd_version reads the major.minor to decide
+        # --enable-v2; the stand-in must claim a pre-3.2 version.
+        major, minor = VERSION.split(".")[:2]
+        assert (int(major), int(minor)) < (3, 2)
+
+
+class TestPackaging:
+    def test_launcher_is_executable_and_names_this_package(self, tmp_path):
+        p = write_launcher(str(tmp_path / "etcd"))
+        assert os.access(p, os.X_OK)
+        body = open(p).read()
+        assert "jepsen_etcd_demo_tpu.db.minietcd" in body
+
+    def test_tarball_matches_release_layout(self, tmp_path):
+        tb = make_release_tarball(str(tmp_path / "rel.tar.gz"), "v3.1.5")
+        names = [m.name for m in tarfile.open(tb).getmembers()]
+        # install_archive strips the top dir -> <dir>/etcd, the exact
+        # path EtcdDB starts (db/etcd.py BINARY under DIR).
+        assert names == ["etcd-v3.1.5-linux-amd64/etcd"]
+        url = etcd_mod.tarball_url("v3.1.5")
+        assert url.endswith("etcd-v3.1.5-linux-amd64.tar.gz")
