@@ -287,21 +287,24 @@ class TestEtcdClient:
         from jepsen_etcd_demo_tpu.clients.register import RegisterClient
         from jepsen_etcd_demo_tpu.ops.op import Op
 
-        with socket.socket() as s:          # reserve a port nobody serves
+        # Hold the port BOUND (never listen()ed) for the test's whole
+        # duration: connects get ECONNREFUSED deterministically, and no
+        # other process can grab the port in a close-to-connect gap.
+        with socket.socket() as s:
             s.bind(("127.0.0.1", 0))
             port = s.getsockname()[1]
 
-        async def t():
-            c = EtcdClient(f"http://127.0.0.1:{port}", timeout_s=2.0)
-            with pytest.raises(ConnectionRefused):
-                await c.get("k")
-            rc = RegisterClient(lambda test, node: c, conn=c)
-            done = await rc.invoke({}, Op(type="invoke", f="write",
-                                          value=("0", 1), process=0))
-            await c.close()
-            return done
+            async def t():
+                c = EtcdClient(f"http://127.0.0.1:{port}", timeout_s=2.0)
+                with pytest.raises(ConnectionRefused):
+                    await c.get("k")
+                rc = RegisterClient(lambda test, node: c, conn=c)
+                done = await rc.invoke({}, Op(type="invoke", f="write",
+                                              value=("0", 1), process=0))
+                await c.close()
+                return done
 
-        done = go(t())
+            done = go(t())
         assert done.type == "fail"          # determinate, NOT info
 
 
