@@ -422,6 +422,24 @@ def test_full_cli_run_against_spawned_etcd(tmp_path):
 
 
 @pytest.mark.slow
+def test_set_workload_against_spawned_etcd(tmp_path):
+    """The set workload's read-modify-write appends ride EtcdClient.swap
+    (prevIndex CAS retry loop) — the exact call the live five-call test
+    caught returning fn's raw value instead of the stored string — here
+    under 5 concurrent workers against the real server, where CAS
+    conflicts and retries actually happen, plus the final durability
+    read."""
+    verdict, _, hist, _, _ = _spawned_etcd_cli_run(
+        tmp_path,
+        ["--nemesis", "noop", "--time-limit", "4", "--rate", "30"],
+        workload="set")
+    assert verdict["valid"] is True
+    oks = [op for op in hist if op["type"] == "ok"]
+    assert any(op["f"] == "add" for op in oks)
+    assert any(op["f"] == "read" for op in oks)   # the final read fired
+
+
+@pytest.mark.slow
 def test_queue_workload_against_spawned_etcd(tmp_path):
     """The in-order-keys queue recipe (POST create, sorted dir read,
     prevIndex compare-and-delete) against the real spawned server under
