@@ -4,10 +4,11 @@ Mirror of the reference's entry (src/jepsen/etcdemo.clj:192-199: cli/run!
 over single-test-cmd + serve-cmd) with the demo's four flags
 (-q/--quorum, -r/--rate, --ops-per-key, -w/--workload; :177-190) plus the
 framework-standard flags the test-map merge supplies (--nodes, --time-limit,
---concurrency, --test-count, --username; :147-152 docstring + noop-test
-[dep]). `analyze` is the stored-history re-check flow (check is re-runnable
-without re-running the cluster, SURVEY.md §5.4); the reference demo itself
-doesn't expose it but jepsen does.
+--concurrency, --test-count, --username, --password, --ssh-port,
+--private-key; :147-152 docstring + noop-test [dep]). `analyze` is the
+stored-history re-check flow (check is re-runnable without re-running the
+cluster, SURVEY.md §5.4); the reference demo itself doesn't expose it but
+jepsen does.
 
 Exit code contract: nonzero iff a test's result is not valid (jepsen's run!
 behavior [dep])."""
@@ -81,6 +82,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ssh password (jepsen's standard flag; rides "
                         "sshpass — the password travels via the SSHPASS "
                         "env var, never on a visible argv)")
+    t.add_argument("--ssh-port", type=positive_int, default=22,
+                   help="ssh port on every node (jepsen's standard flag; "
+                        "also makes a non-22 throwaway sshd reachable "
+                        "through the product surface)")
     t.add_argument("--seed", type=int, default=0,
                    help="schedule/value rng seed (determinism!)")
     t.add_argument("--store", default="store", help="results store root")
@@ -179,7 +184,7 @@ def _test_opts(args) -> dict:
         "nemesis": args.nemesis,
         "version": args.version,
         "ssh": {"username": args.username, "private_key": args.private_key,
-                "password": args.password},
+                "password": args.password, "port": args.ssh_port},
         "stale_read_prob": args.stale_read_prob,
         "lost_write_prob": args.lost_write_prob,
         "duplicate_cas_prob": args.duplicate_cas_prob,

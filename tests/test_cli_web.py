@@ -57,16 +57,19 @@ class TestParser:
         assert a.nodes == "n1,n2,n3,n4,n5"  # noop-test defaults [dep]
 
     def test_password_flag_reaches_ssh_opts(self):
-        # jepsen's standard ssh opt set includes password auth
-        # (noop-test ssh map [dep]); plumbed through to runner_for's
-        # ssh dict (control/runner.py sshpass transport).
+        # jepsen's standard ssh opt set includes password auth and a
+        # per-run port (noop-test ssh map [dep]); plumbed through to
+        # runner_for's ssh dict (control/runner.py sshpass transport,
+        # SSHRunner port).
         from jepsen_etcd_demo_tpu.cli.main import _test_opts
         a = build_parser().parse_args(
             ["test", "-w", "register", "--password", "pw",
-             "--username", "u"])
+             "--username", "u", "--ssh-port", "2222"])
         opts = _test_opts(a)
         assert opts["ssh"] == {"username": "u", "private_key": None,
-                               "password": "pw"}
+                               "password": "pw", "port": 2222}
+        a = build_parser().parse_args(["test", "-w", "register"])
+        assert _test_opts(a)["ssh"]["port"] == 22
 
 
 class TestExitContract:

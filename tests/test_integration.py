@@ -380,9 +380,8 @@ def test_full_cli_run_against_spawned_etcd(tmp_path):
         -> linearizability verdict + store artifact            (L2/L1)
 
     The shim is used UNCONDITIONALLY here (not only when OpenSSH is
-    absent): the CLI has no ssh-port flag, so a throwaway sshd on an
-    ephemeral port is unreachable through the product surface — and the
-    lane's point is the path, not the crypto. Real-sshd transport is
+    absent): this image has no sshd to dial even with `--ssh-port`, and
+    the lane's point is the path, not the crypto. Real-sshd transport is
     covered by the SSHRunner tests above on hosts that have one."""
     verdict, run_dir, hist, etcd_dir, env = _spawned_etcd_cli_run(
         tmp_path,
