@@ -95,9 +95,12 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--no-nemesis", action="store_true",
                    help="disable fault injection")
     t.add_argument("--nemesis", default="partition",
-                   choices=["partition", "clock", "kill", "pause", "noop"],
+                   choices=["partition", "partition-node",
+                            "partition-bridge", "partition-ring",
+                            "clock", "kill", "pause", "noop"],
                    help="fault to inject on the nemesis channel "
-                        "(kill/pause need a real DB, not --fake)")
+                        "(kill/pause and partition-bridge/-ring need a "
+                        "real DB, not --fake)")
     t.add_argument("--version", default="v3.1.5",
                    help="etcd version to install")
     t.add_argument("--stale-read-prob", type=float, default=0.0,

@@ -397,8 +397,12 @@ def pick_nemesis(opts: dict, store: Optional[FakeKVStore] = None, db=None):
     kind = opts.get("nemesis", "partition")
     seed = int(opts.get("seed", 0))
     if store is not None:
+        from .nemesis.partition import FakeIsolatedNodeNemesis
+
         fakes = {
             "partition": lambda: FakePartitionNemesis(store, seed=seed),
+            "partition-node": lambda: FakeIsolatedNodeNemesis(store,
+                                                              seed=seed),
             "clock": lambda: FakeClockSkewNemesis(store, seed=seed),
             "noop": NoopNemesis,
         }
@@ -407,8 +411,18 @@ def pick_nemesis(opts: dict, store: Optional[FakeKVStore] = None, db=None):
                 f"nemesis {kind!r} not available in --fake mode "
                 f"(have: {sorted(fakes)})")
         return fakes[kind]()
+    from .nemesis.partition import (PartitionBridge, PartitionIsolatedNode,
+                                    PartitionMajoritiesRing)
+
     reals = {
         "partition": lambda: PartitionRandomHalves(seed=seed),
+        # The rest of the jepsen.nemesis partition family (same iptables
+        # machinery, different grudge): REAL clusters only — the fake
+        # store models reachability as one isolated set and cannot
+        # express bridge/ring overlap.
+        "partition-node": lambda: PartitionIsolatedNode(seed=seed),
+        "partition-bridge": lambda: PartitionBridge(seed=seed),
+        "partition-ring": lambda: PartitionMajoritiesRing(seed=seed),
         "clock": lambda: ClockSkewNemesis(seed=seed),
         "kill": lambda: KillNemesis(db, seed=seed),
         "pause": lambda: _pause_nemesis(seed),

@@ -20,5 +20,22 @@ class DB(abc.ABC):
     async def teardown(self, test: dict, r: Runner, node: str) -> None:
         ...
 
+    async def start(self, test: dict, r: Runner, node: str) -> None:
+        """Restart a stopped daemon WITHOUT reinstalling (the restart leg
+        of jepsen's db/kill! cycle — the binary and data dir are still on
+        the node). Default falls back to full setup for DBs that don't
+        distinguish."""
+        await self.setup(test, r, node)
+
+    async def kill(self, test: dict, r: Runner, node: str) -> None:
+        """Kill the daemon process, leaving install + data in place (the
+        kill leg of jepsen's db/kill!; start() is its inverse). The kill
+        nemesis drives BOTH legs through the DB protocol, so a subclass
+        must override this (or inherit an implementation) before
+        KillNemesis can target it."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement kill(); the kill "
+            f"nemesis needs both db.kill and db.start")
+
     def log_files(self, test: dict, node: str) -> list[str]:
         return []

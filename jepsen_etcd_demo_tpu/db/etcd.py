@@ -79,6 +79,13 @@ class EtcdDB(DB):
     async def setup(self, test: dict, r: Runner, node: str) -> None:
         log.info("installing etcd %s on %s", self.version, node)
         await install_archive(r, tarball_url(self.version), DIR)
+        await self.start(test, r, node)
+
+    async def start(self, test: dict, r: Runner, node: str) -> None:
+        """Start (or restart) the daemon against the EXISTING install and
+        data dir — the restart leg the kill nemesis drives; a reinstall
+        would waste the kill window and is not what jepsen's db/start!
+        does."""
         nodes = test["nodes"]
         await start_daemon(
             r, f"{DIR}/{BINARY}",
@@ -92,6 +99,10 @@ class EtcdDB(DB):
              "--initial-cluster", initial_cluster(nodes)],
             logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
         await asyncio.sleep(self.settle_s)
+
+    async def kill(self, test: dict, r: Runner, node: str) -> None:
+        """SIGKILL by pidfile; install and data dir stay (db/kill!)."""
+        await stop_daemon(r, PIDFILE)
 
     async def teardown(self, test: dict, r: Runner, node: str) -> None:
         log.info("tearing down etcd on %s", node)

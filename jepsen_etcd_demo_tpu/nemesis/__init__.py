@@ -8,7 +8,9 @@ the final phase (:170-171).
 
 from .base import Nemesis, NoopNemesis  # noqa: F401
 from .partition import (  # noqa: F401
-    PartitionRandomHalves, FakePartitionNemesis, bisect_nodes, random_halves,
+    FakeIsolatedNodeNemesis, FakePartitionNemesis, GrudgePartitioner,
+    PartitionBridge, PartitionIsolatedNode, PartitionMajoritiesRing,
+    PartitionRandomHalves, bisect_nodes, random_halves,
 )
 from .process_faults import KillNemesis, PauseNemesis  # noqa: F401
 from .clock import ClockSkewNemesis, FakeClockSkewNemesis  # noqa: F401

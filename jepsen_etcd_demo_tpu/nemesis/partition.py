@@ -6,6 +6,25 @@ majority/minority and drop traffic between the halves with iptables over the
 control plane; on :stop, heal. FakePartitionNemesis does the same against the
 in-process FakeKVStore (isolates the minority) so partition tests run
 hermetically.
+
+The rest of the jepsen.nemesis partition family rides the same iptables
+machinery via pluggable "grudge" functions (node -> reachable set, the
+term jepsen.nemesis uses):
+
+  * PartitionIsolatedNode  — cut one random node off from everyone
+    (jepsen's partition-node / isolate-self-primaries style single cut);
+  * PartitionBridge        — two halves that cannot see each other, plus
+    one bridge node both halves still see (jepsen's `bridge`: raft must
+    not count the bridge toward BOTH quorums at once);
+  * PartitionMajoritiesRing — every node sees a majority, but no two
+    nodes see the SAME majority (jepsen's partition-majorities-ring,
+    the classic raft split-brain stressor): symmetric ring
+    neighborhoods of the smallest radius whose window is a majority.
+
+These three are REAL-cluster shapes (iptables over SSH). The hermetic
+FakeKVStore models reachability as one isolated set, which can express
+random-halves and isolated-node but not bridge/ring overlap — the fake
+registry (compose.pick_nemesis) lists exactly what it supports.
 """
 
 from __future__ import annotations
@@ -40,22 +59,76 @@ def random_halves(nodes: list[str], rng: random.Random
     return reach
 
 
-class PartitionRandomHalves(Nemesis):
-    """iptables-based partition over SSH, like jepsen's partitioner."""
+def isolated_node_grudge(nodes: list[str], rng: random.Random
+                         ) -> dict[str, list[str]]:
+    """One random node cut off from every peer."""
+    victim = rng.choice(list(nodes))
+    reach = {n: [p for p in nodes if p != victim] for n in nodes
+             if n != victim}
+    reach[victim] = [victim]
+    return reach
+
+
+def bridge_grudge(nodes: list[str], rng: random.Random
+                  ) -> dict[str, list[str]]:
+    """Two halves that cannot see each other; one bridge node sees (and
+    is seen by) everyone. Needs n >= 3."""
+    if len(nodes) < 3:
+        raise ValueError("bridge partition needs >= 3 nodes")
+    shuffled = list(nodes)
+    rng.shuffle(shuffled)
+    bridge = shuffled[0]
+    rest = shuffled[1:]
+    half = len(rest) // 2
+    a, b = rest[:half], rest[half:]
+    reach = {bridge: list(nodes)}
+    for n in a:
+        reach[n] = a + [bridge]
+    for n in b:
+        reach[n] = b + [bridge]
+    return reach
+
+
+def majorities_ring_grudge(nodes: list[str], rng: random.Random
+                           ) -> dict[str, list[str]]:
+    """Every node sees a majority; adjacent ring positions see shifted
+    (distinct, overlapping) majorities. The radius is the smallest h
+    with 2h+1 >= majority(n); for n <= 3 the window is all nodes and no
+    cut exists (same degenerate edge jepsen has)."""
+    ring = list(nodes)
+    rng.shuffle(ring)
+    n = len(ring)
+    majority = n // 2 + 1
+    h = (majority - 1 + 1) // 2        # ceil((majority-1)/2)
+    reach = {}
+    for i, node in enumerate(ring):
+        reach[node] = sorted({ring[(i + d) % n]
+                              for d in range(-h, h + 1)})
+    return reach
+
+
+class GrudgePartitioner(Nemesis):
+    """iptables-based partition over SSH, like jepsen's partitioner:
+    :start computes a reachability map ("grudge") and drops every
+    non-reachable pair symmetrically; :stop flushes the rules. Subclasses
+    pick the grudge (jepsen.nemesis's partitioner/grudge split)."""
+
+    #: grudge(nodes, rng) -> {node: reachable nodes (incl. itself)}
+    grudge = staticmethod(random_halves)
 
     def __init__(self, seed: int = 0):
         self.rng = random.Random(seed)
-        self.active: Optional[tuple[list[str], list[str]]] = None
+        self.active: Optional[dict[str, list[str]]] = None
 
     async def setup(self, test: dict) -> None:
         await self._heal(test)
 
     async def invoke(self, test: dict, op: Op) -> Op:
         if op.f == "start":
-            minority, majority = bisect_nodes(test["nodes"], self.rng)
-            await self._partition(test, minority, majority)
-            self.active = (minority, majority)
-            value = {"isolated": minority, "majority": majority}
+            reach = type(self).grudge(test["nodes"], self.rng)
+            await self._partition(test, reach)
+            self.active = reach
+            value = self.describe(reach)
         elif op.f == "stop":
             await self._heal(test)
             self.active = None
@@ -64,17 +137,22 @@ class PartitionRandomHalves(Nemesis):
             value = f"unknown nemesis op {op.f}"
         return Op(type="info", f=op.f, value=value, process=op.process)
 
+    def describe(self, reach: dict[str, list[str]]):
+        """The :info value recorded in the history."""
+        return {"reachable": reach}
+
     async def teardown(self, test: dict) -> None:
         await self._heal(test)
 
-    async def _partition(self, test: dict, minority: list[str],
-                         majority: list[str]) -> None:
-        # Drop in both directions on every node so the cut is symmetric even
-        # if one side's rules fail to land.
-        for side, other in ((minority, majority), (majority, minority)):
-            for node in side:
-                r = runner_for(test, node)
-                for peer in other:
+    async def _partition(self, test: dict,
+                         reach: dict[str, list[str]]) -> None:
+        # Drop INPUT on both endpoints of every cut pair so the cut is
+        # symmetric even if one side's rules fail to land.
+        for node in test["nodes"]:
+            r = runner_for(test, node)
+            reachable = set(reach.get(node, [])) | {node}
+            for peer in test["nodes"]:
+                if peer != node and peer not in reachable:
                     await r.run(
                         f"iptables -A INPUT -s {peer} -j DROP -w", su=True,
                         check=False)
@@ -84,6 +162,43 @@ class PartitionRandomHalves(Nemesis):
             r = runner_for(test, node)
             await r.run("iptables -F -w && iptables -X -w", su=True,
                         check=False)
+
+
+class PartitionRandomHalves(GrudgePartitioner):
+    """The reference's shape (src/jepsen/etcdemo.clj:164)."""
+
+    grudge = staticmethod(random_halves)
+
+    def describe(self, reach):
+        # Keep the reference-era history value shape (tests and the
+        # timeline rendering read isolated/majority).
+        sides = sorted({frozenset(v) for v in reach.values()},
+                       key=lambda s: (len(s), sorted(s)))
+        if len(sides) == 1:               # degenerate n<2: nothing cut
+            return {"isolated": [], "majority": sorted(sides[0])}
+        return {"isolated": sorted(sides[0]),
+                "majority": sorted(sides[-1])}
+
+
+class PartitionIsolatedNode(GrudgePartitioner):
+    grudge = staticmethod(isolated_node_grudge)
+
+    def describe(self, reach):
+        victim = next(n for n, v in reach.items() if v == [n])
+        return {"isolated": [victim],
+                "majority": sorted(n for n in reach if n != victim)}
+
+
+class PartitionBridge(GrudgePartitioner):
+    grudge = staticmethod(bridge_grudge)
+
+    def describe(self, reach):
+        bridge = max(reach, key=lambda n: len(reach[n]))
+        return {"bridge": bridge, "reachable": reach}
+
+
+class PartitionMajoritiesRing(GrudgePartitioner):
+    grudge = staticmethod(majorities_ring_grudge)
 
 
 class FakePartitionNemesis(Nemesis):
@@ -97,9 +212,15 @@ class FakePartitionNemesis(Nemesis):
         self.store = store
         self.rng = random.Random(seed)
 
+    def _split(self, nodes: list[str]) -> tuple[list[str], list[str]]:
+        """(isolated, rest) — the one degree of freedom the fake's
+        single-isolated-set reachability model allows; subclasses pick
+        differently."""
+        return bisect_nodes(nodes, self.rng)
+
     async def invoke(self, test: dict, op: Op) -> Op:
         if op.f == "start":
-            minority, majority = bisect_nodes(test["nodes"], self.rng)
+            minority, majority = self._split(test["nodes"])
             self.store.isolate(set(minority))
             value = {"isolated": minority, "majority": majority}
         elif op.f == "stop":
@@ -111,3 +232,13 @@ class FakePartitionNemesis(Nemesis):
 
     async def teardown(self, test: dict) -> None:
         self.store.heal()
+
+
+class FakeIsolatedNodeNemesis(FakePartitionNemesis):
+    """Single-node cut against the FakeKVStore — the one non-default
+    partition shape its one-isolated-set reachability model can express
+    (bridge/ring overlap cannot be faked; those are real-cluster-only)."""
+
+    def _split(self, nodes: list[str]) -> tuple[list[str], list[str]]:
+        victim = self.rng.choice(list(nodes))
+        return [victim], [n for n in nodes if n != victim]

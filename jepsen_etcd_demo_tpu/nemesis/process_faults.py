@@ -26,14 +26,18 @@ class KillNemesis(Nemesis):
             self.killed = random_minority(self.rng, test["nodes"])
             for node in self.killed:
                 r = runner_for(test, node)
-                from ..db.etcd import PIDFILE
-                from ..control.daemon import stop_daemon
-                await stop_daemon(r, PIDFILE)
+                # Both legs go through the DB protocol (db.kill /
+                # db.start) so a non-etcd DB is killable by overriding
+                # them, not by happening to share etcd's pidfile path.
+                await self.db.kill(test, r, node)
             value = {"killed": self.killed}
         elif op.f == "stop":
             for node in self.killed:
                 r = runner_for(test, node)
-                await self.db.setup(test, r, node)
+                # start, not setup: the binary and data dir survived the
+                # kill; reinstalling would stretch the outage for nothing
+                # (jepsen's db/kill! restart leg).
+                await self.db.start(test, r, node)
             value = {"restarted": self.killed}
             self.killed = []
         else:

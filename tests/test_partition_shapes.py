@@ -1,0 +1,165 @@
+"""The jepsen.nemesis partition family beyond the demo's random-halves
+(nemesis/partition.py grudges): shape properties, iptables assembly, and
+the fake-store single-node cut, end to end."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+
+from jepsen_etcd_demo_tpu.nemesis.partition import (
+    FakeIsolatedNodeNemesis, PartitionBridge, PartitionIsolatedNode,
+    PartitionMajoritiesRing, bridge_grudge, isolated_node_grudge,
+    majorities_ring_grudge, random_halves)
+from jepsen_etcd_demo_tpu.ops.op import Op
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def go(coro):
+    return asyncio.run(coro)
+
+
+def symmetric(reach):
+    return all((a in reach[b]) == (b in reach[a])
+               for a in reach for b in reach)
+
+
+class TestGrudges:
+    def test_isolated_node(self):
+        for seed in range(10):
+            reach = isolated_node_grudge(NODES, random.Random(seed))
+            victims = [n for n, v in reach.items() if v == [n]]
+            assert len(victims) == 1
+            v = victims[0]
+            for n in NODES:
+                if n != v:
+                    assert v not in reach[n]
+                    assert set(reach[n]) == set(NODES) - {v}
+            assert symmetric(reach)
+
+    def test_bridge(self):
+        for seed in range(10):
+            reach = bridge_grudge(NODES, random.Random(seed))
+            bridge = max(reach, key=lambda n: len(reach[n]))
+            assert set(reach[bridge]) == set(NODES)   # bridge sees all
+            halves = {frozenset(v) - {bridge} for n, v in reach.items()
+                      if n != bridge}
+            assert len(halves) == 2
+            a, b = halves
+            assert not (a & b)                        # halves disjoint
+            assert a | b == set(NODES) - {bridge}
+            for n in NODES:
+                assert bridge in reach[n]             # all see the bridge
+            assert symmetric(reach)
+
+    def test_bridge_needs_three_nodes(self):
+        with pytest.raises(ValueError):
+            bridge_grudge(["a", "b"], random.Random(0))
+
+    def test_majorities_ring(self):
+        for n_nodes in (4, 5, 7):
+            nodes = [f"m{i}" for i in range(n_nodes)]
+            majority = n_nodes // 2 + 1
+            reach = majorities_ring_grudge(nodes, random.Random(3))
+            for n in nodes:
+                assert n in reach[n]
+                assert len(reach[n]) >= majority      # everyone: a majority
+            # The defining property: no two nodes see the SAME majority.
+            assert len({frozenset(v) for v in reach.values()}) == n_nodes
+            assert symmetric(reach)
+
+
+class TestIptablesAssembly:
+    def _start(self, nem_cls, nodes=NODES, seed=7):
+        import jepsen_etcd_demo_tpu.nemesis.partition as part
+
+        from test_cluster_plane import RecordingRunner
+
+        log = []
+        orig = part.runner_for
+        part.runner_for = lambda t, node: RecordingRunner(node, log)
+        try:
+            nem = nem_cls(seed=seed)
+            go(nem.invoke({"nodes": nodes},
+                          Op(type="invoke", f="start", value=None,
+                             process="nemesis")))
+        finally:
+            part.runner_for = orig
+        return log, nem
+
+    def _drop_pairs(self, log):
+        return {(n, c.split("-s ")[1].split(" ")[0])
+                for n, c, su in log if "iptables -A INPUT" in c}
+
+    def test_isolated_node_drops_exactly_victim_pairs(self):
+        log, nem = self._start(PartitionIsolatedNode)
+        victim = nem.active and next(
+            n for n, v in nem.active.items() if v == [n])
+        drops = self._drop_pairs(log)
+        # victim drops 4 peers; 4 peers drop the victim: 8 rules.
+        assert len(drops) == 8
+        assert all(victim in pair for pair in drops)
+        assert all(su for _, _, su in log)
+
+    def test_bridge_drops_cross_half_pairs_only(self):
+        log, nem = self._start(PartitionBridge)
+        bridge = max(nem.active, key=lambda n: len(nem.active[n]))
+        drops = self._drop_pairs(log)
+        # 2x2 halves, both directions = 8 rules; none involve the bridge.
+        assert len(drops) == 8
+        assert all(bridge not in pair for pair in drops)
+
+    def test_ring_cut_is_symmetric(self):
+        log, nem = self._start(PartitionMajoritiesRing)
+        drops = self._drop_pairs(log)
+        assert drops                                  # n=5 ring does cut
+        assert {(b, a) for a, b in drops} == drops    # both directions
+
+    def test_stop_heals_every_node(self):
+        import jepsen_etcd_demo_tpu.nemesis.partition as part
+
+        from test_cluster_plane import RecordingRunner
+
+        log = []
+        orig = part.runner_for
+        part.runner_for = lambda t, node: RecordingRunner(node, log)
+        try:
+            go(PartitionMajoritiesRing(seed=1).invoke(
+                {"nodes": NODES},
+                Op(type="invoke", f="stop", value=None, process="nemesis")))
+        finally:
+            part.runner_for = orig
+        assert sorted(n for n, c, _ in log if "iptables -F" in c) == NODES
+
+
+@pytest.mark.slow
+def test_fake_isolated_node_end_to_end(tmp_path):
+    """--nemesis partition-node over the hermetic store: the cut fires,
+    heals, and the run stays linearizable (quorum survives a 1-node cut)."""
+    import json
+
+    from jepsen_etcd_demo_tpu.cli.main import main
+
+    # 7 s: the nemesis cycle's first :start fires at t=5 (compose
+    # default interval); a shorter limit never cuts at all.
+    rc = main(["test", "-w", "register", "--fake", "--time-limit", "7",
+               "--rate", "100", "--nemesis", "partition-node",
+               "--store", str(tmp_path / "store"), "--seed", "4"])
+    assert rc == 0
+    hist_file = sorted((tmp_path / "store").glob("*/*/history.jsonl"))[0]
+    hist = [json.loads(ln) for ln in
+            hist_file.read_text().splitlines() if ln.strip()]
+    cuts = [op for op in hist if op["process"] == "nemesis"
+            and op["type"] == "info" and op["f"] == "start"
+            and isinstance(op["value"], dict)]
+    assert cuts and all(len(op["value"]["isolated"]) == 1 for op in cuts)
+
+
+def test_fake_mode_rejects_unfakeable_shapes():
+    from jepsen_etcd_demo_tpu.compose import fake_test
+
+    with pytest.raises(ValueError, match="not available in --fake"):
+        fake_test({"nemesis": "partition-bridge", "workload": "register"})
