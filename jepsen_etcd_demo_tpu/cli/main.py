@@ -97,10 +97,12 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--nemesis", default="partition",
                    choices=["partition", "partition-node",
                             "partition-bridge", "partition-ring",
-                            "clock", "kill", "pause", "noop"],
+                            "clock", "clock-strobe", "kill", "pause",
+                            "noop"],
                    help="fault to inject on the nemesis channel "
-                        "(kill/pause and partition-bridge/-ring need a "
-                        "real DB, not --fake)")
+                        "(kill/pause, clock-strobe and "
+                        "partition-bridge/-ring need a real DB, "
+                        "not --fake)")
     t.add_argument("--version", default="v3.1.5",
                    help="etcd version to install")
     t.add_argument("--stale-read-prob", type=float, default=0.0,

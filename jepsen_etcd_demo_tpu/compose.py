@@ -27,9 +27,10 @@ from .clients.set_client import SetClient
 from .db.debian import debian_setup
 from .db.etcd import EtcdDB
 from .db.fake import FakeDB
-from .nemesis import (ClockSkewNemesis, FakeClockSkewNemesis,
-                      FakePartitionNemesis, KillNemesis, NoopNemesis,
-                      PartitionRandomHalves, PauseNemesis)
+from .nemesis import (ClockSkewNemesis, ClockStrobeNemesis,
+                      FakeClockSkewNemesis, FakePartitionNemesis,
+                      KillNemesis, NoopNemesis, PartitionRandomHalves,
+                      PauseNemesis)
 
 # noop-test-style defaults (reference tests/noop-test [dep]: n1..n5,
 # concurrency, time-limit; overridden by CLI opts then by the demo map,
@@ -424,6 +425,7 @@ def pick_nemesis(opts: dict, store: Optional[FakeKVStore] = None, db=None):
         "partition-bridge": lambda: PartitionBridge(seed=seed),
         "partition-ring": lambda: PartitionMajoritiesRing(seed=seed),
         "clock": lambda: ClockSkewNemesis(seed=seed),
+        "clock-strobe": lambda: ClockStrobeNemesis(seed=seed),
         "kill": lambda: KillNemesis(db, seed=seed),
         "pause": lambda: _pause_nemesis(seed),
         "noop": NoopNemesis,
@@ -436,6 +438,8 @@ def pick_nemesis(opts: dict, store: Optional[FakeKVStore] = None, db=None):
 def _pause_nemesis(seed: int):
     from .db.etcd import PIDFILE
     return PauseNemesis(PIDFILE, seed=seed)
+
+
 
 
 def etcd_test(opts: dict) -> dict:

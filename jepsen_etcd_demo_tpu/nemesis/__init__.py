@@ -13,4 +13,5 @@ from .partition import (  # noqa: F401
     PartitionRandomHalves, bisect_nodes, random_halves,
 )
 from .process_faults import KillNemesis, PauseNemesis  # noqa: F401
-from .clock import ClockSkewNemesis, FakeClockSkewNemesis  # noqa: F401
+from .clock import (ClockSkewNemesis, ClockStrobeNemesis,  # noqa: F401
+                    FakeClockSkewNemesis)

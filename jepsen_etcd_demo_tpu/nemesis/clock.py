@@ -78,6 +78,67 @@ class ClockSkewNemesis(Nemesis):
         await self._restore(test)
 
 
+class ClockStrobeNemesis(ClockSkewNemesis):
+    """jepsen's strobe-clock: rapidly OSCILLATE a minority's clocks
+    (+delta, -delta, ...) for a short burst instead of holding a steady
+    skew — the shape that breaks lease/TTL logic which tolerates a
+    constant offset but not a clock that won't advance monotonically.
+
+    The whole burst runs as ONE shell program per node, concurrently
+    across the minority, and ends by restoring the wall clock from the
+    MONOTONIC clock (/proc/uptime): every `date -s` truncates fractions,
+    so a naive balanced loop walks the clock ~2*cycles*period_s behind
+    real time — instead the restore computes t0 + elapsed-monotonic and
+    sets that, under a shell EXIT trap so an interrupted burst (shell
+    TERM, ssh drop) still restores. A SIGKILL of the remote shell can
+    leak the in-flight half-cycle's skew, same exposure jepsen's
+    strobe has; `applied` stays empty because the program self-restores."""
+
+    def __init__(self, seed: int = 0, max_skew_s: float = 8.0,
+                 cycles: int = 20, period_s: float = 0.1):
+        super().__init__(seed=seed, max_skew_s=max_skew_s)
+        self.cycles = cycles
+        self.period_s = period_s
+
+    def _burst_cmd(self, delta: int) -> str:
+        return (
+            "t0=$(date +%s.%N); m0=$(cut -d' ' -f1 /proc/uptime); "
+            "restore() { m1=$(cut -d' ' -f1 /proc/uptime); "
+            "date -s @$(awk -v t0=\"$t0\" -v m0=\"$m0\" -v m1=\"$m1\" "
+            "'BEGIN{printf \"%.6f\", t0 + (m1 - m0)}') >/dev/null; }; "
+            "trap restore EXIT; "
+            f"for i in $(seq {self.cycles}); do "
+            f"date -s @$(( $(date +%s) + {delta} )) >/dev/null; "
+            f"sleep {self.period_s}; "
+            f"date -s @$(( $(date +%s) - {delta} )) >/dev/null; "
+            f"sleep {self.period_s}; "
+            "done")
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        if op.f != "start":
+            return await super().invoke(test, op)
+        import asyncio
+
+        # Deltas drawn BEFORE the gather: rng order stays deterministic
+        # regardless of task interleaving.
+        targets = [(node, self.rng.randint(1, max(1, int(self.max_skew_s))))
+                   for node in random_minority(self.rng, test["nodes"])]
+        timeout = 60.0 + 4 * self.cycles * self.period_s
+
+        async def burst(node: str, delta: int) -> bool:
+            r = runner_for(test, node)
+            res = await r.run(self._burst_cmd(delta), su=True, check=False,
+                              timeout_s=timeout)
+            return res.ok
+
+        # Concurrent: the fault shape is the MINORITY strobing at once,
+        # not nodes taking turns.
+        oks = await asyncio.gather(*(burst(n, d) for n, d in targets))
+        value = {"strobed": {n: {"delta_s": d, "cycles": self.cycles}
+                             for (n, d), ok in zip(targets, oks) if ok}}
+        return Op(type="info", f=op.f, value=value, process=op.process)
+
+
 class FakeClockSkewNemesis(Nemesis):
     """Hermetic twin: records skews on the FakeKVStore (which is
     linearizable regardless, so the checker verdict must stay valid)."""

@@ -597,6 +597,40 @@ class TestClockSkewCommands:
         for n, ds in shifts.items():
             assert len(ds) == 2 and ds[0] + ds[1] == 0, (n, ds)
 
+    def test_strobe_runs_oscillation_with_monotonic_restore(self):
+        """ClockStrobeNemesis (jepsen's strobe-clock): one shell program
+        per node that oscillates +/-delta and then restores the wall
+        clock from the MONOTONIC clock under an EXIT trap — `date -s`
+        truncation would otherwise walk the clock ~2*cycles*period_s
+        behind real time, and an interrupted burst must still restore."""
+        from jepsen_etcd_demo_tpu.nemesis import clock as clk
+        from jepsen_etcd_demo_tpu.ops.op import Op
+
+        log = []
+        test = {"nodes": ["n1", "n2", "n3", "n4", "n5"]}
+        nem = clk.ClockStrobeNemesis(seed=7, max_skew_s=8, cycles=5)
+        orig = clk.runner_for
+        clk.runner_for = lambda t, node: RecordingRunner(node, log)
+        try:
+            op = go(nem.invoke(test, Op(type="invoke", f="start",
+                                        value=None, process="nemesis")))
+        finally:
+            clk.runner_for = orig
+        assert log and all(su for _, _, su in log)
+        for node, cmd, _ in log:
+            assert "for i in $(seq 5)" in cmd
+            assert cmd.count("date -s @$(( $(date +%s) + ") == 1
+            assert cmd.count("date -s @$(( $(date +%s) - ") == 1
+            # Monotonic-anchored restore under a trap: t0 + elapsed
+            # uptime, applied however the loop exits.
+            assert "/proc/uptime" in cmd
+            assert "trap restore EXIT" in cmd
+            assert "t0 + (m1 - m0)" in cmd
+            assert node in op.value["strobed"]
+            assert op.value["strobed"][node]["cycles"] == 5
+        # The burst self-restores: there is nothing recorded to invert.
+        assert nem.applied == {}
+
 
 def test_pick_nemesis_registry():
     from jepsen_etcd_demo_tpu.compose import pick_nemesis
@@ -615,6 +649,19 @@ def test_pick_nemesis_registry():
         pick_nemesis({"nemesis": "kill"}, store=store)
     assert isinstance(pick_nemesis({}), PartitionRandomHalves)
     assert isinstance(pick_nemesis({"nemesis": "clock"}), ClockSkewNemesis)
+    from jepsen_etcd_demo_tpu.nemesis import (ClockStrobeNemesis,
+                                              PartitionBridge,
+                                              PartitionIsolatedNode,
+                                              PartitionMajoritiesRing)
+
+    assert isinstance(pick_nemesis({"nemesis": "clock-strobe"}),
+                      ClockStrobeNemesis)
+    assert isinstance(pick_nemesis({"nemesis": "partition-node"}),
+                      PartitionIsolatedNode)
+    assert isinstance(pick_nemesis({"nemesis": "partition-bridge"}),
+                      PartitionBridge)
+    assert isinstance(pick_nemesis({"nemesis": "partition-ring"}),
+                      PartitionMajoritiesRing)
     with pytest.raises(ValueError, match="unknown"):
         pick_nemesis({"nemesis": "sharknado"})
 
