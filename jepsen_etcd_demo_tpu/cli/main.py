@@ -447,10 +447,30 @@ def enable_compilation_cache() -> None:
         pass
 
 
+def _honor_platform_env() -> None:
+    """Make JAX_PLATFORMS effective even where a sitecustomize pre-imports
+    jax before this process's env-based selection would apply (the axon
+    image does): re-assert it via jax.config before any backend init.
+    Without this, a hermetic `JAX_PLATFORMS=cpu` CLI run still dials the
+    TPU tunnel — and hangs with it — despite needing no device."""
+    import os
+
+    plats = os.environ.get("JAX_PLATFORMS")
+    if not plats:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", plats)
+    except Exception:   # platform forcing is best-effort, never fatal
+        pass
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    _honor_platform_env()
     enable_compilation_cache()
     args = build_parser().parse_args(argv)
     if args.command == "test":

@@ -440,8 +440,6 @@ def _pause_nemesis(seed: int):
     return PauseNemesis(PIDFILE, seed=seed)
 
 
-
-
 def etcd_test(opts: dict) -> dict:
     """The real composition (reference etcd-test, :146-175): Debian OS prep,
     etcd v3.1.5 DB, SSH control, iptables partition nemesis."""

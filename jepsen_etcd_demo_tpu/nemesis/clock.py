@@ -105,14 +105,20 @@ class ClockStrobeNemesis(ClockSkewNemesis):
             "t0=$(date +%s.%N); m0=$(cut -d' ' -f1 /proc/uptime); "
             "restore() { m1=$(cut -d' ' -f1 /proc/uptime); "
             "date -s @$(awk -v t0=\"$t0\" -v m0=\"$m0\" -v m1=\"$m1\" "
-            "'BEGIN{printf \"%.6f\", t0 + (m1 - m0)}') >/dev/null; }; "
-            "trap restore EXIT; "
-            f"for i in $(seq {self.cycles}); do "
-            f"date -s @$(( $(date +%s) + {delta} )) >/dev/null; "
+            "'BEGIN{printf \"%.6f\", t0 + (m1 - m0)}') >/dev/null || :; }; "
+            # Signals exit via `exit` so the EXIT trap (the restore)
+            # still fires — a bare TERM/HUP would skip it in dash.
+            "trap restore EXIT; trap 'exit 143' TERM HUP INT; "
+            # Every failed set marks the burst failed (no CAP_SYS_TIME /
+            # sudo misconfiguration must not record the node as
+            # strobed): the loop's last `sleep` would otherwise mask
+            # every date error with exit 0.
+            f"fail=0; for i in $(seq {self.cycles}); do "
+            f"date -s @$(( $(date +%s) + {delta} )) >/dev/null || fail=1; "
             f"sleep {self.period_s}; "
-            f"date -s @$(( $(date +%s) - {delta} )) >/dev/null; "
+            f"date -s @$(( $(date +%s) - {delta} )) >/dev/null || fail=1; "
             f"sleep {self.period_s}; "
-            "done")
+            "done; exit $fail")
 
     async def invoke(self, test: dict, op: Op) -> Op:
         if op.f != "start":
