@@ -56,6 +56,28 @@ class TestParser:
         assert a.ops_per_key == 100       # :184
         assert a.nodes == "n1,n2,n3,n4,n5"  # noop-test defaults [dep]
 
+    def test_cli_honors_jax_platforms_env(self):
+        """cli/main.py _honor_platform_env: env JAX_PLATFORMS must pick
+        the backend even where a sitecustomize pre-imports jax (the axon
+        image) — otherwise hermetic CPU runs dial the TPU tunnel and
+        hang with it when it's down (observed live, round 5)."""
+        import os
+        import subprocess
+        import sys
+
+        code = (
+            "import os; os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "from jepsen_etcd_demo_tpu.cli.main import _honor_platform_env\n"
+            "_honor_platform_env()\n"
+            "import jax; print('backend=' + jax.default_backend())\n")
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env=dict(os.environ, PYTHONPATH=os.getcwd(),
+                     JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-1000:]
+        assert "backend=cpu" in out.stdout
+
     def test_password_flag_reaches_ssh_opts(self):
         # jepsen's standard ssh opt set includes password auth and a
         # per-run port (noop-test ssh map [dep]); plumbed through to
