@@ -186,7 +186,16 @@ class EtcdClient:
         raise RetriesExhausted("swap retry budget exhausted: 64 determinate CAS failures")
 
 
-def etcd_conn_factory(port: int = 2379, timeout_s: float = 5.0):
+def etcd_conn_factory(port: Optional[int] = None, timeout_s: float = 5.0):
+    """Per-node connections. port=None (default) resolves each node's
+    client port through the DB layer (db/etcd.py client_port_for — the
+    env-overridable default, or the per-node PORT_MAP when several
+    daemons share one host); a fixed port pins every node."""
     def factory(test, node):
+        if port is None:
+            from ..db.etcd import client_port_for
+
+            return EtcdClient.connect(node, port=client_port_for(node),
+                                      timeout_s=timeout_s)
         return EtcdClient.connect(node, port=port, timeout_s=timeout_s)
     return factory

@@ -436,18 +436,19 @@ def pick_nemesis(opts: dict, store: Optional[FakeKVStore] = None, db=None):
 
 
 def _pause_nemesis(seed: int):
-    from .db.etcd import PIDFILE
-    return PauseNemesis(PIDFILE, seed=seed)
+    # Per-node resolution: co-hosted nodes (PORT_MAP) have their own
+    # pidfiles; everywhere else this resolves to the shared default.
+    from .db.etcd import pidfile_for
+    return PauseNemesis(pidfile_for, seed=seed)
 
 
 def etcd_test(opts: dict) -> dict:
     """The real composition (reference etcd-test, :146-175): Debian OS prep,
     etcd v3.1.5 DB, SSH control, iptables partition nemesis."""
-    from .db.etcd import CLIENT_PORT
-
-    # The DB layer's (env-overridable) client port, so the data plane
-    # dials wherever the daemon actually listens.
-    test = compose_test(opts, etcd_conn_factory(port=CLIENT_PORT))
+    # The factory resolves each node's client port through the DB layer
+    # (env override and per-node PORT_MAP included), so the data plane
+    # dials wherever that node's daemon actually listens.
+    test = compose_test(opts, etcd_conn_factory())
     test["db"] = EtcdDB(version=opts.get("version", "v3.1.5"))
     test["os_setup"] = lambda runner, node: debian_setup(runner, node)
     test["nemesis"] = pick_nemesis(test, db=test["db"])

@@ -34,6 +34,43 @@ PEER_PORT = int(os.environ.get(
 CLIENT_PORT = int(os.environ.get(
     "JEPSEN_TPU_ETCD_CLIENT_PORT", "2379"))     # support.clj:14-17
 
+
+def _parse_port_map(raw: str) -> dict[str, tuple[int, int]]:
+    """JEPSEN_TPU_ETCD_PORT_MAP="n1=2379/2380,n2=2479/2480": per-NODE
+    client/peer ports, for multi-node runs where several daemons share
+    one host (every "node" resolving to localhost). Real multi-host
+    clusters never need this — one port per host, the reference's model."""
+    out = {}
+    for part in raw.split(","):
+        if not part.strip():
+            continue
+        node, ports = part.split("=")
+        c, p = ports.split("/")
+        out[node.strip()] = (int(c), int(p))
+    return out
+
+
+PORT_MAP = _parse_port_map(os.environ.get("JEPSEN_TPU_ETCD_PORT_MAP", ""))
+
+
+def client_port_for(node: str) -> int:
+    return PORT_MAP.get(node, (CLIENT_PORT, PEER_PORT))[0]
+
+
+def peer_port_for(node: str) -> int:
+    return PORT_MAP.get(node, (CLIENT_PORT, PEER_PORT))[1]
+
+
+def pidfile_for(node: str) -> str:
+    """Co-hosted nodes (PORT_MAP) each need their own pidfile — a shared
+    one makes the second start_daemon see 'already-running'. Off the
+    map, the reference's single path."""
+    return f"{DIR}/etcd-{node}.pid" if node in PORT_MAP else PIDFILE
+
+
+def logfile_for(node: str) -> str:
+    return f"{DIR}/etcd-{node}.log" if node in PORT_MAP else LOGFILE
+
 DEFAULT_VERSION = "v3.1.5"              # reference :162
 
 
@@ -43,11 +80,11 @@ def node_url(node: str, port: int) -> str:
 
 
 def peer_url(node: str) -> str:
-    return node_url(node, PEER_PORT)
+    return node_url(node, peer_port_for(node))
 
 
 def client_url(node: str) -> str:
-    return node_url(node, CLIENT_PORT)
+    return node_url(node, client_port_for(node))
 
 
 def initial_cluster(nodes: list[str]) -> str:
@@ -76,9 +113,21 @@ class EtcdDB(DB):
         self.settle_s = (settle_s if settle_s is not None else float(
             os.environ.get("JEPSEN_TPU_ETCD_SETTLE_S", "10.0")))
 
+    # Serializes co-hosted installs: PORT_MAP nodes share one host, one
+    # tarball tmp path and one DIR; concurrent setup_one tasks would race
+    # the download/extraction (real multi-host nodes never contend — each
+    # installs on its own machine).
+    _install_lock: asyncio.Lock | None = None
+
     async def setup(self, test: dict, r: Runner, node: str) -> None:
         log.info("installing etcd %s on %s", self.version, node)
-        await install_archive(r, tarball_url(self.version), DIR)
+        if node in PORT_MAP:
+            if EtcdDB._install_lock is None:
+                EtcdDB._install_lock = asyncio.Lock()
+            async with EtcdDB._install_lock:
+                await install_archive(r, tarball_url(self.version), DIR)
+        else:
+            await install_archive(r, tarball_url(self.version), DIR)
         await self.start(test, r, node)
 
     async def start(self, test: dict, r: Runner, node: str) -> None:
@@ -97,17 +146,26 @@ class EtcdDB(DB):
              "--initial-cluster-state", "new",
              "--initial-advertise-peer-urls", peer_url(node),
              "--initial-cluster", initial_cluster(nodes)],
-            logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
+            logfile=logfile_for(node), pidfile=pidfile_for(node), chdir=DIR)
         await asyncio.sleep(self.settle_s)
 
     async def kill(self, test: dict, r: Runner, node: str) -> None:
         """SIGKILL by pidfile; install and data dir stay (db/kill!)."""
-        await stop_daemon(r, PIDFILE)
+        await stop_daemon(r, pidfile_for(node))
 
     async def teardown(self, test: dict, r: Runner, node: str) -> None:
         log.info("tearing down etcd on %s", node)
-        await stop_daemon(r, PIDFILE)
-        await r.run(f"rm -rf {DIR}", su=True, check=False)
+        await stop_daemon(r, pidfile_for(node))
+        if node in PORT_MAP:
+            # Co-hosted: DIR is shared, and node teardowns run
+            # concurrently — a whole-DIR wipe here would delete a peer's
+            # pidfile before ITS stop_daemon runs (leaking the daemon)
+            # and its log before collection. Wipe only this node's state.
+            await r.run(
+                f"rm -rf {DIR}/{node}.etcd {pidfile_for(node)} "
+                f"{logfile_for(node)}", su=True, check=False)
+        else:
+            await r.run(f"rm -rf {DIR}", su=True, check=False)
 
     def log_files(self, test: dict, node: str) -> list[str]:
-        return [LOGFILE]
+        return [logfile_for(node)]

@@ -49,26 +49,35 @@ class KillNemesis(Nemesis):
 
 
 class PauseNemesis(Nemesis):
-    """:start SIGSTOPs the daemon on a random subset; :stop SIGCONTs."""
+    """:start SIGSTOPs the daemon on a random subset; :stop SIGCONTs.
 
-    def __init__(self, pidfile: str, seed: int = 0):
+    `pidfile` may be a fixed path or a node->path callable — co-hosted
+    nodes (db/etcd.py PORT_MAP) write per-node pidfiles, and a pause
+    aimed at the shared default path would silently hit nothing while
+    the history records the fault as fired."""
+
+    def __init__(self, pidfile, seed: int = 0):
         self.pidfile = pidfile
         self.rng = random.Random(seed)
         self.paused: list[str] = []
+
+    def _pidfile(self, node: str) -> str:
+        return self.pidfile(node) if callable(self.pidfile) \
+            else self.pidfile
 
     async def invoke(self, test: dict, op: Op) -> Op:
         if op.f == "start":
             self.paused = random_minority(self.rng, test["nodes"])
             for node in self.paused:
                 r = runner_for(test, node)
-                await r.run(f"kill -STOP $(cat {self.pidfile})", su=True,
-                            check=False)
+                await r.run(f"kill -STOP $(cat {self._pidfile(node)})",
+                            su=True, check=False)
             value = {"paused": self.paused}
         elif op.f == "stop":
             for node in self.paused:
                 r = runner_for(test, node)
-                await r.run(f"kill -CONT $(cat {self.pidfile})", su=True,
-                            check=False)
+                await r.run(f"kill -CONT $(cat {self._pidfile(node)})",
+                            su=True, check=False)
             value = {"resumed": self.paused}
             self.paused = []
         else:
@@ -78,5 +87,5 @@ class PauseNemesis(Nemesis):
     async def teardown(self, test: dict) -> None:
         for node in self.paused:
             r = runner_for(test, node)
-            await r.run(f"kill -CONT $(cat {self.pidfile})", su=True,
-                        check=False)
+            await r.run(f"kill -CONT $(cat {self._pidfile(node)})",
+                        su=True, check=False)

@@ -65,17 +65,24 @@ class TestParser:
         import subprocess
         import sys
 
+        # Reproduce the precondition ON ANY HOST: import jax FIRST with
+        # the env var unset (the sitecustomize pre-import — jax snapshots
+        # JAX_PLATFORMS at import), then set the env and assert the
+        # helper pushes it into jax.config anyway.
         code = (
+            "import jax\n"
             "import os; os.environ['JAX_PLATFORMS'] = 'cpu'\n"
             "from jepsen_etcd_demo_tpu.cli.main import _honor_platform_env\n"
             "_honor_platform_env()\n"
-            "import jax; print('backend=' + jax.default_backend())\n")
+            "print('platforms=' + str(jax.config.jax_platforms))\n"
+            "print('backend=' + jax.default_backend())\n")
+        env = dict(os.environ, PYTHONPATH=os.getcwd())
+        env.pop("JAX_PLATFORMS", None)    # unset at jax-import time
         out = subprocess.run(
             [sys.executable, "-c", code],
-            env=dict(os.environ, PYTHONPATH=os.getcwd(),
-                     JAX_PLATFORMS="cpu"),
-            capture_output=True, text=True, timeout=300)
+            env=env, capture_output=True, text=True, timeout=300)
         assert out.returncode == 0, out.stderr[-1000:]
+        assert "platforms=cpu" in out.stdout
         assert "backend=cpu" in out.stdout
 
     def test_password_flag_reaches_ssh_opts(self):

@@ -421,6 +421,62 @@ def test_full_cli_run_against_spawned_etcd(tmp_path):
 
 
 @pytest.mark.slow
+def test_divergent_two_node_cluster_detected(tmp_path):
+    """The harness must CATCH a real broken distributed system, not only
+    the fake store's injected bugs: two minietcds posing as a 2-node
+    'cluster' are two INDEPENDENT stores (minietcd does not replicate —
+    its docstring says exactly this), i.e. a replication system whose
+    every write is silently lost on the other node. Workers spread
+    round-robin across nodes, so reads observe the divergence and the
+    linearizability verdict must be INVALID, with the run exiting 1 and
+    a witness artifact naming a failing op."""
+    import json
+    import sys
+
+    from jepsen_etcd_demo_tpu.db.minietcd import make_release_tarball
+
+    shim_dir = tmp_path / "bin"
+    shim_dir.mkdir()
+    for name, body in (("ssh", _SSH_SHIM), ("scp", _SCP_SHIM)):
+        p = shim_dir / name
+        p.write_text(body.replace("SHEBANG", sys.executable, 1))
+        p.chmod(0o755)
+    tarball = make_release_tarball(str(tmp_path / "etcd-rel.tar.gz"))
+    store = tmp_path / "store"
+    ports = [_free_port() for _ in range(4)]
+    env = dict(
+        os.environ,
+        PATH=f"{shim_dir}{os.pathsep}{os.environ['PATH']}",
+        JAX_PLATFORMS="cpu",
+        JEPSEN_TPU_ETCD_DIR=str(tmp_path / "opt" / "etcd"),
+        JEPSEN_TPU_ETCD_TARBALL=f"file://{tarball}",
+        JEPSEN_TPU_ETCD_SETTLE_S="3.0",
+        # Two "nodes", both localhost, each its own daemon on its own
+        # ports (and per-node pidfile/data-dir under the install dir).
+        JEPSEN_TPU_ETCD_PORT_MAP=(
+            f"localhost={ports[0]}/{ports[1]},"
+            f"127.0.0.1={ports[2]}/{ports[3]}"),
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "jepsen_etcd_demo_tpu.cli.main",
+         "test", "-w", "register", "--nodes", "localhost,127.0.0.1",
+         "--nemesis", "noop", "--time-limit", "4", "--rate", "30",
+         "--concurrency", "4", "--store", str(store), "--seed", "5"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 1, (out.stdout[-1000:], out.stderr[-3000:])
+    verdict = json.loads(out.stdout.strip().splitlines()[-1])
+    assert verdict["valid"] is False
+    # The explanation artifact exists and names a concrete failing op
+    # (knossos linear.json parity) for at least one divergent key.
+    runs = sorted(store.glob("*/*/results.json"))
+    assert runs
+    witnesses = sorted(runs[0].parent.glob("linear*.json"))
+    assert witnesses, list(runs[0].parent.iterdir())
+    w = json.loads(witnesses[0].read_text())
+    assert w["valid"] is False and w.get("op")
+
+
+@pytest.mark.slow
 def test_set_workload_against_spawned_etcd(tmp_path):
     """The set workload's read-modify-write appends ride EtcdClient.swap
     (prevIndex CAS retry loop) — the exact call the live five-call test
