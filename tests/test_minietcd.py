@@ -108,6 +108,32 @@ class TestArgv:
         assert (int(major), int(minor)) < (3, 2)
 
 
+class TestPortMap:
+    def test_parse_and_resolution(self, monkeypatch):
+        from jepsen_etcd_demo_tpu.db import etcd as m
+
+        pm = m._parse_port_map("n1=2379/2380, n2=2479/2480")
+        monkeypatch.setattr(m, "PORT_MAP", pm)
+        assert m.client_port_for("n1") == 2379
+        assert m.peer_port_for("n2") == 2480
+        # Unmapped nodes fall back to the (env-overridable) defaults and
+        # the shared reference-path pidfile/logfile.
+        assert m.client_port_for("other") == m.CLIENT_PORT
+        assert m.pidfile_for("other") == m.PIDFILE
+        # Mapped nodes get their own pidfile/logfile (co-hosted daemons
+        # must not collide on the shared default).
+        assert m.pidfile_for("n1").endswith("etcd-n1.pid")
+        assert m.logfile_for("n2").endswith("etcd-n2.log")
+        assert m.client_url("n2") == "http://n2:2479"
+        assert m.peer_url("n1") == "http://n1:2380"
+
+    def test_empty_map_is_default_behavior(self):
+        from jepsen_etcd_demo_tpu.db import etcd as m
+
+        assert m._parse_port_map("") == {}
+        assert m._parse_port_map(" , ") == {}
+
+
 class TestPackaging:
     def test_launcher_is_executable_and_names_this_package(self, tmp_path):
         p = write_launcher(str(tmp_path / "etcd"))
