@@ -639,7 +639,54 @@ def bench_100k(model) -> dict:
     return d
 
 
+def _backend_alive(timeout_s: float = 240.0) -> tuple[bool, str]:
+    """Probe the default JAX backend in a SUBPROCESS with a hard timeout:
+    a wedged remote-TPU tunnel hangs backend init indefinitely and
+    un-interruptibly from within the process (observed live: a mid-round
+    tunnel outage turned every backend touch into a forever-hang). The
+    bench must fail FAST with a diagnosable line, not silently eat the
+    driver's whole budget. Returns (ok, reason): a timeout and a fast
+    crash are DIFFERENT failures and the reason says which (with the
+    probe's stderr tail for the crash case). The probe enables the same
+    persistent compile cache the bench uses, so on a healthy machine it
+    costs one trivial cached compile (~1-2 s warm; ~20-40 s only the
+    very first time ever — 240 s bounds that with margin)."""
+    import subprocess
+
+    code = ("from jepsen_etcd_demo_tpu.cli.main import "
+            "_honor_platform_env, enable_compilation_cache; "
+            # JAX_PLATFORMS must steer the PROBE too (the sitecustomize
+            # pre-import otherwise dials the default tunnel even under
+            # JAX_PLATFORMS=cpu — the exact trap cli/main works around).
+            "_honor_platform_env(); enable_compilation_cache(); "
+            "import numpy, jax, jax.numpy as jnp; "
+            "numpy.asarray(jax.jit(lambda a: a + 1)(jnp.zeros(4))); "
+            "print('BACKEND_OK')")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, (f"trivial jit round trip exceeded {timeout_s:.0f}s "
+                       f"— remote TPU tunnel down/wedged?")
+    except OSError as e:
+        return False, f"could not spawn the probe: {e}"
+    if "BACKEND_OK" in out.stdout:
+        return True, ""
+    return False, (f"probe exited {out.returncode} without completing a "
+                   f"trivial jit; stderr tail: {out.stderr[-500:]}")
+
+
 def main():
+    ok, reason = _backend_alive()
+    if not ok:
+        print(json.dumps({
+            "metric": "wgl_check_throughput", "value": 0,
+            "unit": "history-events/sec", "vs_baseline": 0,
+            "error": f"JAX backend unusable ({reason}); bench aborted "
+                     f"instead of hanging"}))
+        return 1
+
     import jax
 
     from jepsen_etcd_demo_tpu.cli.main import enable_compilation_cache
@@ -715,4 +762,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
