@@ -23,6 +23,7 @@ import numpy as np
 from .base import Checker, merge_valid
 from .compose import Compose
 from .linearizable import Linearizable
+from .. import obs
 from ..ops.op import Op, INVOKE
 
 
@@ -129,6 +130,18 @@ def _batched_linearizable(lin: Linearizable, keyed: dict[Any, list[Op]],
 
     Prefers the dense lattice kernel (wgl3) — exact, no overflow — whenever
     the shared config table is feasible; falls back to the sort kernel."""
+    with obs.get_tracer().span("check.linearizable.batched",
+                               model=lin.model.name,
+                               keys=len(keyed)) as sp:
+        out = _batched_linearizable_traced(lin, keyed, store_dir)
+        sp.set(settled=sum(1 for r in out.values()
+                           if r.get("valid") is True))
+        return out
+
+
+def _batched_linearizable_traced(lin: Linearizable,
+                                 keyed: dict[Any, list[Op]],
+                                 store_dir=None) -> dict[Any, dict]:
     from ..ops import wgl3
 
     event_encs = {k: lin.encode(h) for k, h in keyed.items()}

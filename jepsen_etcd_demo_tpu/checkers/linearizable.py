@@ -19,6 +19,7 @@ from typing import Any, Sequence
 
 from .base import Checker
 from .oracle import check_events_oracle
+from .. import obs
 from ..ops.encode import EV_RETURN
 from ..models import Model, get_model
 from ..ops.op import Op
@@ -77,6 +78,15 @@ class Linearizable(Checker):
     # -- checking ---------------------------------------------------------
     def check(self, test: dict, history: Sequence[Op],
               opts: dict | None = None) -> dict[str, Any]:
+        with obs.get_tracer().span(
+                "check.linearizable", model=self.model.name,
+                backend=self.backend,
+                key=str((opts or {}).get("key", ""))) as sp:
+            res = self._check_traced(test, history, opts, sp)
+        return res
+
+    def _check_traced(self, test: dict, history: Sequence[Op],
+                      opts: dict | None, sp) -> dict[str, Any]:
         # Fault-plane ops (nemesis start/stop) are not client operations —
         # drop them like knossos does [dep]. Workloads under the
         # independent wrapper never see them (split_by_key filters), but a
@@ -102,14 +112,23 @@ class Linearizable(Checker):
             res["dead_step"] = _event_to_step(enc, res.pop("dead_event"))
             res["backend"] = "oracle"
             res["op_count"] = enc.n_ops
+            # The jax branch's kernel paths record their own search
+            # metrics at the launch sites (recording here too would
+            # double-count wgl.configs_explored); the oracle path has no
+            # kernel site, so it records here.
+            obs.record_check_result(res)
         else:
             # f_cap_floor: a batched pre-pass (checkers/independent.py)
             # may have proven smaller frontier capacities dead — start the
             # escalation ladder past them.
             res = self._check_jax(
                 enc, f_cap_floor=int((opts or {}).get("f_cap_floor", 0)))
+        sp.set(valid=str(res.get("valid")),
+               backend=res.get("backend", self.backend),
+               op_count=res.get("op_count"))
         if res.get("valid") is False:
-            self._explain(res, enc, history, opts)
+            with obs.get_tracer().span("check.witness"):
+                self._explain(res, enc, history, opts)
         return res
 
     def _explain(self, res: dict, enc: EncodedHistory,

@@ -72,6 +72,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "the jepsen-standard flag)")
     t.add_argument("--time-limit", type=positive_float, default=30.0,
                    help="main-phase wall clock budget in seconds")
+    t.add_argument("--recovery-wait", type=nonnegative_float, default=10.0,
+                   help="quiet window after healing before the final "
+                        "phase (seconds; the reference's post-nemesis "
+                        "sleep — hermetic runs can shrink it, the "
+                        "in-process fake heals instantly)")
     t.add_argument("--concurrency", type=positive_int, default=10,
                    help="client worker count")
     t.add_argument("--test-count", type=positive_int, default=1,
@@ -182,6 +187,7 @@ def _test_opts(args) -> dict:
         "ops_per_key": args.ops_per_key,
         "nodes": _read_nodes(args),
         "time_limit": args.time_limit,
+        "recovery_wait": args.recovery_wait,
         "concurrency": args.concurrency,
         "seed": args.seed,
         "store_root": args.store,

@@ -12,6 +12,7 @@ import asyncio
 import logging
 import os
 
+from .. import obs
 from ..control.runner import Runner
 from ..control.daemon import install_archive, start_daemon, stop_daemon
 from .base import DB
@@ -112,22 +113,35 @@ class EtcdDB(DB):
         # settles instantly, so the hermetic lane shrinks it by env.
         self.settle_s = (settle_s if settle_s is not None else float(
             os.environ.get("JEPSEN_TPU_ETCD_SETTLE_S", "10.0")))
+        # Serializes co-hosted installs: PORT_MAP nodes share one host,
+        # one tarball tmp path and one DIR; concurrent setup_one tasks
+        # would race the download/extraction (real multi-host nodes never
+        # contend — each installs on its own machine). Keyed by the
+        # RUNNING loop, not cached once: an asyncio.Lock binds to the
+        # loop that first awaits it, and `--test-count >= 2` runs each
+        # test under its own asyncio.run — a lock surviving the first run
+        # raises "bound to a different event loop" in the second
+        # (ADVICE.md round 5). One entry per run; the dict dies with the
+        # instance.
+        self._install_locks: dict[asyncio.AbstractEventLoop,
+                                  asyncio.Lock] = {}
 
-    # Serializes co-hosted installs: PORT_MAP nodes share one host, one
-    # tarball tmp path and one DIR; concurrent setup_one tasks would race
-    # the download/extraction (real multi-host nodes never contend — each
-    # installs on its own machine).
-    _install_lock: asyncio.Lock | None = None
+    def _install_lock(self) -> asyncio.Lock:
+        loop = asyncio.get_running_loop()
+        lock = self._install_locks.get(loop)
+        if lock is None:
+            lock = self._install_locks[loop] = asyncio.Lock()
+        return lock
 
     async def setup(self, test: dict, r: Runner, node: str) -> None:
         log.info("installing etcd %s on %s", self.version, node)
-        if node in PORT_MAP:
-            if EtcdDB._install_lock is None:
-                EtcdDB._install_lock = asyncio.Lock()
-            async with EtcdDB._install_lock:
+        with obs.get_tracer().span("db.install", node=node,
+                                   version=self.version):
+            if node in PORT_MAP:
+                async with self._install_lock():
+                    await install_archive(r, tarball_url(self.version), DIR)
+            else:
                 await install_archive(r, tarball_url(self.version), DIR)
-        else:
-            await install_archive(r, tarball_url(self.version), DIR)
         await self.start(test, r, node)
 
     async def start(self, test: dict, r: Runner, node: str) -> None:
@@ -136,36 +150,41 @@ class EtcdDB(DB):
         would waste the kill window and is not what jepsen's db/start!
         does."""
         nodes = test["nodes"]
-        await start_daemon(
-            r, f"{DIR}/{BINARY}",
-            ["--log-output", "stderr",
-             "--name", node,
-             "--listen-peer-urls", peer_url(node),
-             "--listen-client-urls", client_url(node),
-             "--advertise-client-urls", client_url(node),
-             "--initial-cluster-state", "new",
-             "--initial-advertise-peer-urls", peer_url(node),
-             "--initial-cluster", initial_cluster(nodes)],
-            logfile=logfile_for(node), pidfile=pidfile_for(node), chdir=DIR)
-        await asyncio.sleep(self.settle_s)
+        with obs.get_tracer().span("db.start", node=node):
+            await start_daemon(
+                r, f"{DIR}/{BINARY}",
+                ["--log-output", "stderr",
+                 "--name", node,
+                 "--listen-peer-urls", peer_url(node),
+                 "--listen-client-urls", client_url(node),
+                 "--advertise-client-urls", client_url(node),
+                 "--initial-cluster-state", "new",
+                 "--initial-advertise-peer-urls", peer_url(node),
+                 "--initial-cluster", initial_cluster(nodes)],
+                logfile=logfile_for(node), pidfile=pidfile_for(node),
+                chdir=DIR)
+            await asyncio.sleep(self.settle_s)
 
     async def kill(self, test: dict, r: Runner, node: str) -> None:
         """SIGKILL by pidfile; install and data dir stay (db/kill!)."""
-        await stop_daemon(r, pidfile_for(node))
+        with obs.get_tracer().span("db.kill", node=node):
+            await stop_daemon(r, pidfile_for(node))
 
     async def teardown(self, test: dict, r: Runner, node: str) -> None:
         log.info("tearing down etcd on %s", node)
-        await stop_daemon(r, pidfile_for(node))
-        if node in PORT_MAP:
-            # Co-hosted: DIR is shared, and node teardowns run
-            # concurrently — a whole-DIR wipe here would delete a peer's
-            # pidfile before ITS stop_daemon runs (leaking the daemon)
-            # and its log before collection. Wipe only this node's state.
-            await r.run(
-                f"rm -rf {DIR}/{node}.etcd {pidfile_for(node)} "
-                f"{logfile_for(node)}", su=True, check=False)
-        else:
-            await r.run(f"rm -rf {DIR}", su=True, check=False)
+        with obs.get_tracer().span("db.teardown", node=node):
+            await stop_daemon(r, pidfile_for(node))
+            if node in PORT_MAP:
+                # Co-hosted: DIR is shared, and node teardowns run
+                # concurrently — a whole-DIR wipe here would delete a
+                # peer's pidfile before ITS stop_daemon runs (leaking the
+                # daemon) and its log before collection. Wipe only this
+                # node's state.
+                await r.run(
+                    f"rm -rf {DIR}/{node}.etcd {pidfile_for(node)} "
+                    f"{logfile_for(node)}", su=True, check=False)
+            else:
+                await r.run(f"rm -rf {DIR}", su=True, check=False)
 
     def log_files(self, test: dict, node: str) -> list[str]:
         return [logfile_for(node)]

@@ -52,6 +52,7 @@ import sys
 import tarfile
 import tempfile
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -112,9 +113,36 @@ class KeyStore:
                                   "modifiedIndex": idx,
                                   "createdIndex": idx}}
 
+    def _write_conflict_locked(self, key: str, creating_dir: bool):
+        """etcd forbids file/dir conflicts at WRITE time (errorCode 102
+        "Not a file" writing a file over a dir, 104 "Not a directory"
+        writing under — or in-order-posting to — an existing file); the
+        store used to resolve the ambiguity silently at read time, which
+        let a workload whose register key collided with a queue dir
+        prefix behave differently here than on real etcd (ADVICE.md
+        round 5). Caller holds the lock."""
+        if not creating_dir and any(k.startswith(key + "/")
+                                    for k in self.data):
+            return 403, {"errorCode": 102, "message": "Not a file",
+                         "cause": f"/{key}", "index": self.index}
+        if creating_dir and key in self.data:
+            return 400, {"errorCode": 104, "message": "Not a directory",
+                         "cause": f"/{key}", "index": self.index}
+        parts = key.split("/")
+        for i in range(1, len(parts)):
+            ancestor = "/".join(parts[:i])
+            if ancestor in self.data:
+                return 400, {"errorCode": 104,
+                             "message": "Not a directory",
+                             "cause": f"/{ancestor}", "index": self.index}
+        return None
+
     def put(self, key: str, value: str, prev_value: str | None,
             prev_index: int | None):
         with self.lock:
+            conflict = self._write_conflict_locked(key, creating_dir=False)
+            if conflict is not None:
+                return conflict
             if prev_value is not None or prev_index is not None:
                 if key not in self.data:
                     return 404, {"errorCode": 100,
@@ -137,6 +165,9 @@ class KeyStore:
 
     def post(self, key: str, value: str):
         with self.lock:
+            conflict = self._write_conflict_locked(key, creating_dir=True)
+            if conflict is not None:
+                return conflict
             self.index += 1
             # Zero-padded index name: lexicographic sort == creation
             # order (etcd's in-order keys are ordered by createdIndex;
@@ -203,19 +234,32 @@ def _handler_for(store: KeyStore):
             self._reply(*store.get(self._key()))
 
         def do_PUT(self):
-            form, params = self._form(), self._params()
-            prev_index = params.get("prevIndex")
+            # Real etcd v2 accepts the payload fields in EITHER location
+            # (urlencoded form body or query string); merging both (form
+            # wins on collision, like etcd's form parse shadowing the
+            # URL's) keeps wire drift between our client and server from
+            # silently degrading a CAS to an unconditional PUT — the
+            # client sends value in the form and prevValue/prevIndex in
+            # the query today, but a drifted client using the other
+            # location must hit the same semantics (ADVICE.md round 5).
+            merged = {**self._params(), **self._form()}
+            prev_index = merged.get("prevIndex")
             self._reply(*store.put(
-                self._key(), form.get("value", ""),
-                params.get("prevValue"),
+                self._key(), merged.get("value", ""),
+                merged.get("prevValue"),
                 int(prev_index) if prev_index is not None else None))
 
         def do_POST(self):
-            form = self._form()
-            self._reply(*store.post(self._key(), form.get("value", "")))
+            merged = {**self._params(), **self._form()}
+            self._reply(*store.post(self._key(), merged.get("value", "")))
 
         def do_DELETE(self):
-            prev_index = self._params().get("prevIndex")
+            # Same either-location merge as do_PUT: a drifted client
+            # sending prevIndex in the body must not silently get an
+            # UNCONDITIONAL delete (compare-and-delete is the queue
+            # recipe's claim guard).
+            merged = {**self._params(), **self._form()}
+            prev_index = merged.get("prevIndex")
             self._reply(*store.delete(
                 self._key(),
                 int(prev_index) if prev_index is not None else None))
@@ -264,6 +308,7 @@ def main(argv=None) -> int:
     # reference's teardown.
     data_dir = args.data_dir or f"{args.name}.etcd"
     os.makedirs(data_dir, exist_ok=True)
+    t_start = time.monotonic()
     store = KeyStore(data_dir)
     host, port = _url_port(args.listen_client_urls, 2379)
     peer_host, peer_port = _url_port(args.listen_peer_urls, 2380)
@@ -280,9 +325,14 @@ def main(argv=None) -> int:
     # runs ON the serving (main) thread — calling it inline deadlocks.
     signal.signal(signal.SIGTERM, lambda *a: threading.Thread(
         target=server.shutdown, daemon=True).start())
+    # Start timing in the daemon log (obs satellite of the telemetry PR):
+    # the harness-side db.start span ends at start_daemon's pidfile
+    # check, so snapshot-load + bind cost is only visible HERE.
+    ready_ms = (time.monotonic() - t_start) * 1e3
     print(f"minietcd {VERSION} member {args.name}: serving client "
           f"requests on http://{host}:{port} (peer {peer_port}, "
-          f"data-dir {data_dir})", flush=True)
+          f"data-dir {data_dir}, ready in {ready_ms:.1f} ms, "
+          f"{len(store.data)} keys restored)", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -24,6 +24,7 @@ from __future__ import annotations
 import random
 from typing import Optional
 
+from .. import obs
 from ..control.runner import runner_for
 from ..ops.op import Op
 from .base import Nemesis, random_minority
@@ -61,10 +62,13 @@ class ClockSkewNemesis(Nemesis):
                                              int(self.max_skew_s))
                 if await self._shift(test, node, delta):
                     self.applied[node] = self.applied.get(node, 0) + delta
+                    obs.get_tracer().event("fault.clock_skew", node=node,
+                                           delta_s=delta)
             value = {"skewed": dict(self.applied)}
         elif op.f == "stop":
             await self._restore(test)
             value = "clocks restored"
+            obs.get_tracer().event("fault.clock_restore")
         else:
             value = f"unknown nemesis op {op.f}"
         return Op(type="info", f=op.f, value=value, process=op.process)

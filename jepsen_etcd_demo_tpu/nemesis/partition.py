@@ -32,6 +32,7 @@ from __future__ import annotations
 import random
 from typing import Optional
 
+from .. import obs
 from ..control.runner import Runner, runner_for
 from ..ops.op import Op
 from .base import Nemesis
@@ -129,10 +130,17 @@ class GrudgePartitioner(Nemesis):
             await self._partition(test, reach)
             self.active = reach
             value = self.describe(reach)
+            # Fault-plane telemetry: correlated by span id to the
+            # nemesis.<f> span the runner opened around this invoke.
+            obs.get_tracer().event("fault.partition",
+                                   kind=type(self).__name__,
+                                   cut={n: sorted(v)
+                                        for n, v in reach.items()})
         elif op.f == "stop":
             await self._heal(test)
             self.active = None
             value = "network healed"
+            obs.get_tracer().event("fault.heal", kind=type(self).__name__)
         else:
             value = f"unknown nemesis op {op.f}"
         return Op(type="info", f=op.f, value=value, process=op.process)
@@ -223,9 +231,13 @@ class FakePartitionNemesis(Nemesis):
             minority, majority = self._split(test["nodes"])
             self.store.isolate(set(minority))
             value = {"isolated": minority, "majority": majority}
+            obs.get_tracer().event("fault.partition",
+                                   kind=type(self).__name__,
+                                   isolated=sorted(minority))
         elif op.f == "stop":
             self.store.heal()
             value = "network healed"
+            obs.get_tracer().event("fault.heal", kind=type(self).__name__)
         else:
             value = f"unknown nemesis op {op.f}"
         return Op(type="info", f=op.f, value=value, process=op.process)

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import random
 
+from .. import obs
 from ..control.runner import runner_for
 from ..ops.op import Op
 from .base import Nemesis, random_minority
@@ -30,6 +31,7 @@ class KillNemesis(Nemesis):
                 # db.start) so a non-etcd DB is killable by overriding
                 # them, not by happening to share etcd's pidfile path.
                 await self.db.kill(test, r, node)
+                obs.get_tracer().event("fault.kill", node=node)
             value = {"killed": self.killed}
         elif op.f == "stop":
             for node in self.killed:
@@ -38,6 +40,7 @@ class KillNemesis(Nemesis):
                 # kill; reinstalling would stretch the outage for nothing
                 # (jepsen's db/kill! restart leg).
                 await self.db.start(test, r, node)
+                obs.get_tracer().event("fault.restart", node=node)
             value = {"restarted": self.killed}
             self.killed = []
         else:
@@ -72,12 +75,14 @@ class PauseNemesis(Nemesis):
                 r = runner_for(test, node)
                 await r.run(f"kill -STOP $(cat {self._pidfile(node)})",
                             su=True, check=False)
+                obs.get_tracer().event("fault.pause", node=node)
             value = {"paused": self.paused}
         elif op.f == "stop":
             for node in self.paused:
                 r = runner_for(test, node)
                 await r.run(f"kill -CONT $(cat {self._pidfile(node)})",
                             su=True, check=False)
+                obs.get_tracer().event("fault.resume", node=node)
             value = {"resumed": self.paused}
             self.paused = []
         else:

@@ -1,0 +1,252 @@
+"""obs — harness-wide telemetry: span tracing + metrics + per-run artifacts.
+
+The harness used to be a black box: the only observability was post-hoc
+charts over the op history, so a wedged backend (BENCH_r05's
+`wgl_check_throughput = 0`) had nothing to say about where time went.
+This package is the in-band answer — stdlib-only, near-zero-cost when
+idle:
+
+  * obs/trace.py   — span tracer (context-manager API, monotonic-ns,
+                     thread/async-safe) -> `telemetry.jsonl`
+  * obs/metrics.py — counters/gauges/histograms -> `metrics.json`
+  * this module    — the capture stack wiring instrumentation points to
+                     the active run, kernel compile/execute attribution,
+                     and the env-gated jax.profiler trace.
+
+Usage pattern: layers call `get_tracer()` / `get_metrics()` at the
+point of instrumentation; both return no-op singletons unless a
+`capture()` is active, so library use (imports, ad-hoc checker calls)
+records nothing and pays one list-index per call. The runner
+(runner/core.py run_test) and the bench (bench.py) open captures; the
+runner's capture writes `telemetry.jsonl` + `metrics.json` into the run
+dir next to history.jsonl/results.json.
+
+Env vars:
+  JEPSEN_TPU_TELEMETRY=0   disable capture entirely (spans/metrics
+                           become no-ops; no artifacts are written)
+  JEPSEN_TPU_JAX_TRACE=1   additionally capture a jax.profiler trace of
+                           the check phase into <run_dir>/jax_trace/
+                           (view with tensorboard/xprof)
+
+Well-known metric keys (pre-registered at zero by capture(), so they
+are never absent from metrics.json or the bench's kernel_phases):
+  wgl.compile_s      summed first-call wall of each compiled kernel
+                     geometry (jit tracing+compilation is synchronous on
+                     the first call, so this is compile-dominated)
+  wgl.execute_s      summed steady-state kernel call wall (dispatch +
+                     any in-call fetch; a lower bound on device time for
+                     async backends)
+  encode.encode_s    host-side history->tensor encoding seconds
+  wgl.frontier_peak  gauge; max over checks of the search's live-config
+                     high-water mark (kernel_phases reports its max)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Callable, Iterator, Optional
+
+from .metrics import MetricsRegistry, read_metrics
+from .trace import Tracer, read_jsonl
+
+TELEMETRY_FILE = "telemetry.jsonl"
+METRICS_FILE = "metrics.json"
+
+# The bench/e2e contract keys: pre-registered at zero on every capture.
+PHASE_COUNTERS = ("wgl.compile_s", "wgl.execute_s", "encode.encode_s")
+PHASE_GAUGE = "wgl.frontier_peak"
+
+_NULL_TRACER = Tracer(enabled=False)
+_NULL_METRICS = MetricsRegistry(enabled=False)
+
+
+class Capture:
+    """One active telemetry scope: a tracer + registry pair, optionally
+    bound to an output directory the artifacts land in on exit."""
+
+    def __init__(self, out_dir: Optional[str | Path] = None,
+                 enabled: bool = True):
+        self.enabled = enabled
+        self.out_dir = Path(out_dir) if out_dir is not None else None
+        self.tracer = Tracer(enabled=enabled)
+        self.metrics = MetricsRegistry(enabled=enabled)
+        if enabled:
+            for name in PHASE_COUNTERS:
+                self.metrics.counter(name)
+            self.metrics.gauge(PHASE_GAUGE)
+
+    def write(self) -> None:
+        if not self.enabled or self.out_dir is None:
+            return
+        try:
+            self.out_dir.mkdir(parents=True, exist_ok=True)
+            self.tracer.write(self.out_dir / TELEMETRY_FILE)
+            self.metrics.write(self.out_dir / METRICS_FILE)
+        except OSError:
+            # Telemetry is an observability aid, never a failure mode:
+            # a read-only or vanished store dir must not fail the run.
+            pass
+
+
+_lock = threading.Lock()
+_stack: list[Capture] = []
+
+
+def telemetry_enabled() -> bool:
+    return os.environ.get("JEPSEN_TPU_TELEMETRY", "1").lower() \
+        not in ("0", "false", "no", "off")
+
+
+def get_tracer() -> Tracer:
+    """The active capture's tracer, or a no-op singleton."""
+    stack = _stack
+    return stack[-1].tracer if stack else _NULL_TRACER
+
+
+def get_metrics() -> MetricsRegistry:
+    """The active capture's metrics registry, or a no-op singleton."""
+    stack = _stack
+    return stack[-1].metrics if stack else _NULL_METRICS
+
+
+@contextmanager
+def capture(out_dir: Optional[str | Path] = None) -> Iterator[Capture]:
+    """Install a fresh tracer+registry as the active telemetry sinks;
+    on exit, restore the previous ones and (when `out_dir` is given)
+    write telemetry.jsonl + metrics.json there. Nesting shadows: the
+    innermost capture receives the records (one capture per run)."""
+    cap = Capture(out_dir, enabled=telemetry_enabled())
+    if not cap.enabled:
+        yield cap
+        return
+    with _lock:
+        _stack.append(cap)
+    try:
+        yield cap
+    finally:
+        with _lock:
+            if cap in _stack:
+                _stack.remove(cap)
+        cap.write()
+
+
+# -- kernel phase attribution ----------------------------------------------
+
+def instrument_kernel(name: str, fn: Callable) -> Callable:
+    """Wrap a jit-compiled kernel callable for compile/execute
+    attribution. The FIRST call of a jitted function runs tracing + XLA
+    compilation synchronously before dispatch, so its wall time is
+    compile-dominated; later calls are steady-state dispatch. The
+    wrapper's first-call flag lives with the wrapped fn in the kernel
+    caches (ops/wgl2.py / wgl3.py / wgl3_pallas.py _CACHE), so the
+    granularity is exactly one flag per compiled geometry — and a
+    capture opened after the geometry warmed correctly records only
+    execute time (the compile happened outside the run).
+
+    Steady-state times are dispatch wall, NOT device time: kernels
+    dispatch asynchronously and callers rely on that (the chunked
+    sweeps pipeline windows), so the wrapper never blocks on results.
+    Device-true timings are the env-gated jax.profiler trace's job."""
+    state = {"first": True}
+
+    def wrapped(*args, **kwargs):
+        t0 = time.monotonic()
+        out = fn(*args, **kwargs)
+        dt = time.monotonic() - t0
+        m = get_metrics()
+        if state["first"]:
+            state["first"] = False
+            m.counter("wgl.compile_s").add(dt)
+            m.counter("wgl.compile_calls").add(1)
+            m.histogram(f"wgl.compile_s.{name}").observe(dt)
+            get_tracer().event("wgl.compile", kernel=name,
+                               seconds=round(dt, 6))
+        else:
+            m.counter("wgl.execute_s").add(dt)
+            m.counter("wgl.execute_calls").add(1)
+            m.histogram(f"wgl.execute_s.{name}").observe(dt)
+        return out
+
+    wrapped.__name__ = f"instrumented_{name}"
+    return wrapped
+
+
+def record_check_result(res: dict) -> None:
+    """Fold one WGL check result's search metrics into the registry:
+    frontier occupancy high-water mark and configs explored (the §5.1
+    unit of search work)."""
+    m = get_metrics()
+    try:
+        mf = float(res.get("max_frontier"))
+    except (TypeError, ValueError):
+        mf = -1.0
+    if mf >= 0:
+        m.gauge(PHASE_GAUGE).set(mf)
+    try:
+        cfgs = float(res.get("configs_explored"))
+    except (TypeError, ValueError):
+        cfgs = 0.0
+    if cfgs > 0:
+        m.counter("wgl.configs_explored").add(cfgs)
+
+
+def kernel_phases(metrics: Optional[MetricsRegistry] = None) -> dict:
+    """The bench's kernel-phase breakdown, from a registry snapshot.
+    With no registry (backend unreachable, telemetry disabled) every
+    field is zero — the contract is "zeros permitted, never absent"."""
+    out = {"compile_s": 0.0, "execute_s": 0.0, "encode_s": 0.0,
+           "frontier_peak": 0}
+    if metrics is None or not metrics.enabled:
+        return out
+    snap = metrics.snapshot()
+
+    def counter_value(key: str) -> float:
+        rec = snap.get(key)
+        return round(rec["value"], 4) if rec \
+            and rec.get("type") == "counter" else 0.0
+
+    out["compile_s"] = counter_value("wgl.compile_s")
+    out["execute_s"] = counter_value("wgl.execute_s")
+    out["encode_s"] = counter_value("encode.encode_s")
+    fp = snap.get(PHASE_GAUGE)
+    if fp and fp.get("max") is not None:
+        out["frontier_peak"] = int(fp["max"])
+    return out
+
+
+# -- env-gated jax.profiler capture ----------------------------------------
+
+def jax_trace_enabled() -> bool:
+    return os.environ.get("JEPSEN_TPU_JAX_TRACE", "").lower() \
+        in ("1", "true", "yes", "on")
+
+
+@contextmanager
+def maybe_jax_trace(out_dir: Optional[str | Path]) -> Iterator[None]:
+    """jax.profiler.trace into <out_dir>/jax_trace when the env gate
+    (JEPSEN_TPU_JAX_TRACE=1) is set and a run dir exists; a plain no-op
+    otherwise — including when jax itself is unimportable or the
+    profiler refuses (profiling is never a failure mode)."""
+    if out_dir is None or not jax_trace_enabled():
+        yield
+        return
+    ctx = None
+    try:
+        import jax
+
+        ctx = jax.profiler.trace(str(Path(out_dir) / "jax_trace"))
+        ctx.__enter__()
+    except Exception:
+        ctx = None
+    try:
+        yield
+    finally:
+        if ctx is not None:
+            try:
+                ctx.__exit__(None, None, None)
+            except Exception:
+                pass

@@ -1,0 +1,169 @@
+"""Metrics registry: counters, gauges, histograms (stdlib only).
+
+The aggregate half of the telemetry subsystem (the tracer in
+obs/trace.py is the per-occurrence half): bounded-memory running
+aggregates, serialized per run as `metrics.json`. Every instrument is a
+fixed-size record — a counter is one float, a gauge tracks
+last/min/max, a histogram tracks count/sum/min/max — so instrumenting
+hot paths (per-op dispatch, per-kernel-launch) costs one lock + a few
+float ops and can never grow with workload size.
+
+Naming convention (dotted, lowercase): `<layer>.<what>[_<unit>]`, e.g.
+`wgl.compile_s`, `runner.ops_ok`, `encode.event_bytes`. The suffix
+carries the unit. The well-known keys the bench/e2e contract depends on
+are pre-registered at zero by obs.capture() so consumers never see an
+absent key ("zeros permitted, never absent").
+
+snapshot() schema (metrics.json is {"metrics": snapshot(), ...}):
+  counter   {"type": "counter", "value": f}
+  gauge     {"type": "gauge", "last": f|null, "min": f|null, "max": f|null,
+             "n": int}
+  histogram {"type": "histogram", "count": int, "sum": f, "min": f|null,
+             "max": f|null, "avg": f|null}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+from typing import Optional
+
+
+class Counter:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0.0
+
+    def add(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+    def snapshot(self) -> dict:
+        return {"type": "counter", "value": self.value}
+
+
+class Gauge:
+    __slots__ = ("_lock", "last", "min", "max", "n")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.last: Optional[float] = None
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.n = 0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            v = float(v)
+            self.last = v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            self.n += 1
+
+    def snapshot(self) -> dict:
+        return {"type": "gauge", "last": self.last, "min": self.min,
+                "max": self.max, "n": self.n}
+
+
+class Histogram:
+    __slots__ = ("_lock", "count", "sum", "min", "max")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            v = float(v)
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+
+    def snapshot(self) -> dict:
+        return {"type": "histogram", "count": self.count,
+                "sum": self.sum, "min": self.min, "max": self.max,
+                "avg": (self.sum / self.count) if self.count else None}
+
+
+class _NullInstrument:
+    """Accepts every instrument method, stores nothing — what the
+    disabled registry hands out so call sites never branch."""
+
+    def add(self, n: float = 1.0) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+
+_NULL_INSTRUMENT = _NullInstrument()
+
+
+class MetricsRegistry:
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def _get(self, name: str, cls):
+        if not self.enabled:
+            return _NULL_INSTRUMENT
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(self._lock)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    # -- reading ----------------------------------------------------------
+
+    def snapshot(self) -> dict[str, dict]:
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: m.snapshot() for name, m in sorted(items)}
+
+    def value(self, name: str, default: float = 0.0) -> float:
+        """Scalar view for consumers that just want a number: a counter's
+        value, a gauge's last, a histogram's sum."""
+        with self._lock:
+            m = self._metrics.get(name)
+        if isinstance(m, Counter):
+            return m.value
+        if isinstance(m, Gauge):
+            return m.last if m.last is not None else default
+        if isinstance(m, Histogram):
+            return m.sum
+        return default
+
+    def to_json(self) -> str:
+        return json.dumps({"metrics": self.snapshot()}, indent=2) + "\n"
+
+    def write(self, path: str | Path) -> None:
+        Path(path).write_text(self.to_json())
+
+
+def read_metrics(path: str | Path) -> dict[str, dict]:
+    """Load a metrics.json back into its snapshot dict."""
+    return json.loads(Path(path).read_text())["metrics"]

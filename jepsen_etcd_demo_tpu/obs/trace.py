@@ -1,0 +1,171 @@
+"""Span tracer: the harness's own observability substrate (stdlib only).
+
+The post-hoc checkers (checkers/perf.py charts, checkers/timeline.py
+swimlanes) observe the *op history*; this module observes the HARNESS —
+where wall time goes across setup / generator-interpret / teardown /
+check / store, which kernel compiled when, when each fault fired. One
+tracer instance collects one run's records and serializes them as
+`telemetry.jsonl` next to the other store artifacts (obs/__init__.py
+capture()).
+
+Design constraints, in order:
+  * near-zero cost when disabled (the library default): every public
+    entry point is a single attribute check before bailing;
+  * thread- AND async-safe: span parentage rides a contextvars.ContextVar,
+    which is per-thread and per-asyncio-task (create_task copies the
+    context, so the runner's worker tasks inherit the "run" span as
+    parent exactly like jepsen's worker threads nest under run!);
+    record appends take one lock;
+  * monotonic-ns timestamps (never wall clock deltas): spans survive
+    clock-skew nemeses by construction. One wall-clock anchor is
+    recorded in the meta line for human correlation.
+
+Record schema (one JSON object per line, completion order):
+  {"kind": "meta",  "wall_start": iso8601, "clock": "monotonic_ns", ...}
+  {"kind": "span",  "id": n, "parent": n|null, "name": str,
+   "t0_ns": n, "t1_ns": n, "status": "ok"|"error", "attrs": {...}}
+  {"kind": "event", "id": n, "span": n|null, "name": str,
+   "t_ns": n, "attrs": {...}}
+
+t*_ns are offsets from the tracer's birth (the meta anchor), so files
+are small and diffable; span ids are unique within one tracer.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import threading
+import time
+from contextlib import contextmanager
+from datetime import datetime, timezone
+from pathlib import Path
+from typing import Any, Iterator, Optional
+
+
+class SpanHandle:
+    """What `with tracer.span(...) as sp` yields: lets the body annotate
+    the span after the fact (sp.set(valid=True, kernel="wgl3-dense"))."""
+
+    __slots__ = ("id", "attrs")
+
+    def __init__(self, span_id: Optional[int], attrs: dict):
+        self.id = span_id
+        self.attrs = attrs
+
+    def set(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+
+_NULL_HANDLE = SpanHandle(None, {})
+
+
+class Tracer:
+    """Collects spans + events for ONE run (or bench invocation).
+
+    `max_records` bounds memory for pathological workloads (a span per
+    client op at high rate): past the cap, records are dropped and
+    counted — the meta line reports `dropped` so truncation is never
+    silent."""
+
+    def __init__(self, enabled: bool = True, max_records: int = 200_000):
+        self.enabled = enabled
+        self.max_records = max_records
+        self._lock = threading.Lock()
+        self._records: list[dict] = []
+        self._dropped = 0
+        self._next_id = 1
+        self._current: contextvars.ContextVar[Optional[int]] = \
+            contextvars.ContextVar("jepsen_tpu_span", default=None)
+        self._t0_ns = time.monotonic_ns()
+        self._wall_start = datetime.now(timezone.utc).isoformat()
+
+    # -- recording --------------------------------------------------------
+
+    def _now(self) -> int:
+        return time.monotonic_ns() - self._t0_ns
+
+    def _append(self, rec: dict) -> None:
+        with self._lock:
+            if len(self._records) >= self.max_records:
+                self._dropped += 1
+                return
+            self._records.append(rec)
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[SpanHandle]:
+        """Context manager timing one phase; nests via contextvars (safe
+        across threads and asyncio tasks). Exceptions mark the span
+        status "error" and re-raise."""
+        if not self.enabled:
+            yield _NULL_HANDLE
+            return
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        parent = self._current.get()
+        token = self._current.set(sid)
+        handle = SpanHandle(sid, dict(attrs))
+        t0 = self._now()
+        status = "ok"
+        try:
+            yield handle
+        except BaseException:
+            status = "error"
+            raise
+        finally:
+            self._current.reset(token)
+            self._append({"kind": "span", "id": sid, "parent": parent,
+                          "name": name, "t0_ns": t0, "t1_ns": self._now(),
+                          "status": status, "attrs": handle.attrs})
+
+    def event(self, name: str, **attrs: Any) -> None:
+        """Point-in-time record, correlated to the enclosing span (if any)
+        via its id — how nemesis fault firings tie back to the phase and
+        nemesis-op spans they happened under."""
+        if not self.enabled:
+            return
+        with self._lock:
+            eid = self._next_id
+            self._next_id += 1
+        self._append({"kind": "event", "id": eid,
+                      "span": self._current.get(), "name": name,
+                      "t_ns": self._now(), "attrs": attrs})
+
+    def current_span_id(self) -> Optional[int]:
+        return self._current.get()
+
+    # -- serialization ----------------------------------------------------
+
+    def records(self) -> list[dict]:
+        with self._lock:
+            return list(self._records)
+
+    def to_jsonl(self) -> str:
+        with self._lock:
+            recs = list(self._records)
+            dropped = self._dropped
+        meta = {"kind": "meta", "wall_start": self._wall_start,
+                "clock": "monotonic_ns", "records": len(recs),
+                "dropped": dropped}
+        lines = [json.dumps(meta)]
+        lines.extend(json.dumps(r, default=str) for r in recs)
+        return "\n".join(lines) + "\n"
+
+    def write(self, path: str | Path) -> None:
+        Path(path).write_text(self.to_jsonl())
+
+
+def read_jsonl(path: str | Path) -> list[dict]:
+    """Parse a telemetry.jsonl back into records (meta line included);
+    tolerates a trailing partial line from an interrupted run."""
+    out = []
+    for line in Path(path).read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            break
+    return out

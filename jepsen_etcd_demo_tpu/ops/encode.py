@@ -34,11 +34,13 @@ surviving config has linearized the op, so its bit is cleared everywhere).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence
 
 import numpy as np
 
+from ..obs import get_metrics
 from .op import Op, INVOKE, OK, FAIL, INFO
 
 # Value encoding. The reference register draws values from (rand-int 5), i.e.
@@ -239,6 +241,7 @@ def encode_events(invocations: Sequence[Invocation], k_slots: int = 32
     its completion position. `fail` ops and `info` reads are excluded (see
     module docstring).
     """
+    t_enc = time.monotonic()
     points = _timeline_points(invocations)
 
     free = list(range(k_slots - 1, -1, -1))  # pop() yields lowest slot first
@@ -264,6 +267,13 @@ def encode_events(invocations: Sequence[Invocation], k_slots: int = 32
     events = np.asarray(rows, dtype=np.int32).reshape(-1, EVENT_WIDTH)
     n_ops = sum(1 for _, r, _i in points if not r)
     max_value = int(events[:, 3:6].max()) if len(rows) else 0
+    # Telemetry (obs/): host-side encode cost and the event-tensor bytes
+    # that will cross the host->device boundary (SURVEY §5.1 — the
+    # harness's own hot loop needs a breakdown, not just the op history).
+    m = get_metrics()
+    m.counter("encode.encode_s").add(time.monotonic() - t_enc)
+    m.counter("encode.histories").add(1)
+    m.counter("encode.event_bytes").add(int(events.nbytes))
     return EncodedHistory(events=events, n_events=len(rows), n_ops=n_ops,
                           k_slots=k_slots, max_pending=max_pending,
                           max_value=max_value)
@@ -361,6 +371,7 @@ def encode_return_steps(enc: EncodedHistory) -> ReturnSteps:
     slot k before p, and slot k is active iff its invokes before p outnumber
     its returns strictly before p (the returning op itself counts active).
     """
+    t_enc = time.monotonic()
     k = enc.k_slots
     n = enc.n_events
     ev = np.asarray(enc.events[:n])
@@ -388,6 +399,7 @@ def encode_return_steps(enc: EncodedHistory) -> ReturnSteps:
     last = last_inv[ret_pos]                   # [R, K]
     tabs = np.where(last[:, :, None] >= 0,
                     ev[np.maximum(last, 0)][:, :, 2:6], 0).astype(np.int32)
+    get_metrics().counter("encode.encode_s").add(time.monotonic() - t_enc)
     return ReturnSteps(
         slot_tabs=tabs,
         slot_active=active,

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.base import Model
+from ..obs import instrument_kernel, record_check_result
 from .encode import EncodedHistory, ReturnSteps, encode_return_steps
 
 
@@ -280,14 +281,18 @@ _CACHE: dict[tuple, Any] = {}
 def cached_checker2(model: Model, cfg: WGLConfig):
     key = ("single2", model.cache_key(), cfg)
     if key not in _CACHE:
-        _CACHE[key] = make_checker2(model, cfg)
+        # instrument_kernel (obs/): compile/execute attribution, one
+        # first-call flag per compiled geometry (this cache's key).
+        _CACHE[key] = instrument_kernel("wgl2-single",
+                                        make_checker2(model, cfg))
     return _CACHE[key]
 
 
 def cached_batch_checker2(model: Model, cfg: WGLConfig):
     key = ("batch2", model.cache_key(), cfg)
     if key not in _CACHE:
-        _CACHE[key] = make_batch_checker2(model, cfg)
+        _CACHE[key] = instrument_kernel("wgl2-batch",
+                                        make_batch_checker2(model, cfg))
     return _CACHE[key]
 
 
@@ -359,7 +364,7 @@ def _chunk_fn(model: Model, cfg: WGLConfig):
 def cached_chunk2(model: Model, cfg: WGLConfig):
     key = ("chunk2", model.cache_key(), cfg)
     if key not in _CACHE:
-        _CACHE[key] = _chunk_fn(model, cfg)
+        _CACHE[key] = instrument_kernel("wgl2-chunk", _chunk_fn(model, cfg))
     return _CACHE[key]
 
 
@@ -523,4 +528,8 @@ def check_encoded_resumable(enc: EncodedHistory, model: Model | None = None,
                                 time_budget_s=time_budget_s,
                                 keep_death_checkpoint=keep_death_checkpoint)
     out["op_count"] = enc.n_ops
+    # Telemetry (obs/): the kernel paths record their own search metrics
+    # at the launch/exit sites — consumers (checkers/linearizable.py)
+    # must NOT record again, or wgl.configs_explored double-counts.
+    record_check_result(out)
     return out

@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.base import Model
+from ..obs import get_metrics, instrument_kernel, record_check_result
 from .encode import EncodedHistory, ReturnSteps, encode_return_steps
 from .limits import limits
 
@@ -298,7 +299,9 @@ def default_scan_chunk(cfg: DenseConfig) -> int:
 def _cached_chunk_run(model: Model, cfg: DenseConfig, chunk: int):
     key = ("chunk3", model.cache_key(), cfg, chunk)
     if key not in _CACHE:
-        _CACHE[key] = _chunk_fn(model, cfg)
+        # instrument_kernel (obs/): compile/execute attribution, one
+        # first-call flag per compiled geometry (this cache's key).
+        _CACHE[key] = instrument_kernel("wgl3-chunk", _chunk_fn(model, cfg))
     return _CACHE[key]
 
 
@@ -360,6 +363,7 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
         "configs_explored": int(packed[3]),
     }
     out["valid"] = verdict(out)
+    record_check_result(out)
     return out
 
 
@@ -431,6 +435,7 @@ def _pack_result(out: dict) -> jax.Array:
 def unpack_np(arr) -> dict:
     """np i32[..., 5] (one fetch) -> result dict of np arrays/scalars."""
     arr = np.asarray(arr)
+    get_metrics().counter("wgl.d2h_bytes").add(int(arr.nbytes))
     return {"survived": arr[..., 0] != 0, "overflow": arr[..., 1] != 0,
             "dead_step": arr[..., 2], "max_frontier": arr[..., 3],
             "configs_explored": arr[..., 4]}
@@ -442,7 +447,8 @@ _CACHE: dict[tuple, Any] = {}
 def cached_batch_checker3(model: Model, cfg: DenseConfig):
     key = ("batch3", model.cache_key(), cfg)
     if key not in _CACHE:
-        _CACHE[key] = make_batch_checker3(model, cfg)
+        _CACHE[key] = instrument_kernel("wgl3-batch",
+                                        make_batch_checker3(model, cfg))
     return _CACHE[key]
 
 
@@ -450,7 +456,8 @@ def cached_checker3_packed(model: Model, cfg: DenseConfig):
     key = ("single3p", model.cache_key(), cfg)
     if key not in _CACHE:
         fn = _check_one_fn(model, cfg)
-        _CACHE[key] = jax.jit(lambda *a: _pack_result(fn(*a)))
+        _CACHE[key] = instrument_kernel(
+            "wgl3-single", jax.jit(lambda *a: _pack_result(fn(*a))))
     return _CACHE[key]
 
 
@@ -458,7 +465,8 @@ def cached_batch_checker3_packed(model: Model, cfg: DenseConfig):
     key = ("batch3p", model.cache_key(), cfg)
     if key not in _CACHE:
         fn = jax.vmap(_check_one_fn(model, cfg))
-        _CACHE[key] = jax.jit(lambda *a: _pack_result(fn(*a)))
+        _CACHE[key] = instrument_kernel(
+            "wgl3-batch", jax.jit(lambda *a: _pack_result(fn(*a))))
     return _CACHE[key]
 
 
@@ -507,6 +515,8 @@ def check_steps3(rs: ReturnSteps, model: Model | None = None,
                           jnp.asarray(rs.targets)))
     out["valid"] = verdict(out)
     out["configs_explored"] = int(out["configs_explored"])
+    out["max_frontier"] = int(out["max_frontier"])
+    record_check_result(out)
     return out
 
 
@@ -531,7 +541,9 @@ def prepare_dense(enc: EncodedHistory, model: Model,
     if enc.k_slots != k:
         enc = reslot_events(enc, k)
     rs = encode_return_steps(enc)
-    return cfg, rs.padded_to(step_bucket(rs.n_steps))
+    padded = rs.padded_to(step_bucket(rs.n_steps))
+    _record_padding([rs], padded.slot_tabs.shape[0])
+    return cfg, padded
 
 
 def check_encoded3(enc: EncodedHistory, model: Model | None = None,
@@ -565,12 +577,28 @@ def batch_steps3(encs: Sequence[EncodedHistory], model: Model,
     return cfg, steps, r_cap
 
 
+def _record_padding(steps, r_cap: int) -> None:
+    """Telemetry (obs/): per-launch step-bucket padding waste. Pads are
+    cheap (the closure exits immediately; the fused kernel never even
+    executes them) but the scan still walks them in the XLA path — the
+    gauge makes the waste visible per launch instead of folklore."""
+    real = int(sum(s.n_steps for s in steps))
+    total = len(steps) * int(r_cap)
+    if total:
+        get_metrics().gauge("wgl.step_padding_pct").set(
+            100.0 * (1.0 - real / total))
+
+
 def stack_steps3(steps, r_cap: int):
     """DEVICE-side half: pad to the common step count, stack, transfer."""
     padded = [s.padded_to(r_cap) for s in steps]
-    return (jnp.asarray(np.stack([p.slot_tabs for p in padded])),
-            jnp.asarray(np.stack([p.slot_active for p in padded])),
-            jnp.asarray(np.stack([p.targets for p in padded])))
+    tabs = np.stack([p.slot_tabs for p in padded])
+    act = np.stack([p.slot_active for p in padded])
+    tgt = np.stack([p.targets for p in padded])
+    _record_padding(steps, r_cap)
+    get_metrics().counter("wgl.h2d_bytes").add(
+        int(tabs.nbytes + act.nbytes + tgt.nbytes))
+    return jnp.asarray(tabs), jnp.asarray(act), jnp.asarray(tgt)
 
 
 def batch_arrays3(encs: Sequence[EncodedHistory], model: Model,
@@ -595,6 +623,7 @@ def assemble_batch_results(out: dict, steps, cfg: DenseConfig) -> list[dict]:
         one["op_count"] = s.n_ops
         one["configs_explored"] = int(one["configs_explored"])
         one["table_cells"] = cfg.n_states * cfg.n_masks
+        record_check_result(one)
         results.append(one)
     return results
 

@@ -124,6 +124,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..models.base import Model
+from ..obs import instrument_kernel, record_check_result
 from .encode import EncodedHistory
 from .limits import limits
 from .wgl3 import DenseConfig, _LO_MASK, batch_arrays3, dense_config
@@ -436,7 +437,9 @@ def local_pallas_launcher_resumable(model: Model, cfg: DenseConfig,
                                  end])[None]
             return out, Tout, mt_next
 
-        return jax.jit(run)
+        # obs/ compile/execute attribution: lru_cache gives one wrapper
+        # (and so one first-call flag) per compiled window shape R.
+        return instrument_kernel("wgl3-pallas-resumable", jax.jit(run))
 
     return launch
 
@@ -528,6 +531,7 @@ def check_steps3_long_pallas(rs, model: Model, cfg: DenseConfig,
         "configs_explored": cfgs,
     }
     res["valid"] = verdict(res)
+    record_check_result(res)
     return res
 
 
@@ -545,8 +549,9 @@ def _cached_prep(model: Model, cfg: DenseConfig):
 
     key = ("pallas-prep", model.cache_key(), cfg)
     if key not in _CACHE:
-        _CACHE[key] = jax.jit(
-            functools.partial(prepare_pallas_batch, model, cfg))
+        _CACHE[key] = instrument_kernel(
+            "wgl3-pallas-prep",
+            jax.jit(functools.partial(prepare_pallas_batch, model, cfg)))
     return _CACHE[key]
 
 
@@ -625,7 +630,7 @@ def local_pallas_launcher(model: Model, cfg: DenseConfig,
                 interpret=interpret,
             )(ln, tg, cm)[0].reshape(B, 5)
 
-        return jax.jit(run)
+        return instrument_kernel("wgl3-pallas", jax.jit(run))
 
     return launch
 
@@ -925,7 +930,7 @@ def local_pallas_launcher_grouped(model: Model, cfg: DenseConfig, G: int,
                 interpret=interpret,
             )(ln, tg, cm)[0].reshape(B, 5)
 
-        return jax.jit(run)
+        return instrument_kernel("wgl3-pallas-grouped", jax.jit(run))
 
     return launch
 
@@ -1383,7 +1388,7 @@ def _oracle_result(enc: EncodedHistory, model: Model,
     # have used, or 0 for a dense-infeasible tiny history (the oracle is
     # exact either way).
     cfg = wgl3.dense_config(model, wgl3.tight_k_slots(enc), enc.max_value)
-    return {
+    out = {
         "survived": bool(res.valid), "overflow": False,
         "dead_step": dead_step, "max_frontier": res.max_frontier,
         "configs_explored": int(res.configs_explored),
@@ -1391,6 +1396,8 @@ def _oracle_result(enc: EncodedHistory, model: Model,
         "table_cells": 0 if cfg is None else cfg.n_states * cfg.n_masks,
         "kernel": "oracle-small-history",
     }
+    record_check_result(out)
+    return out
 
 
 # First ladder rung after the batched tiers prove `top` overflows — shared
@@ -1502,6 +1509,7 @@ def _batch_general(encs, idxs, model, results, kernels, f_cap: int = 256
                     "f_cap": tier_cap, "escalations": 0,
                     "kernel": "wgl2-sort-batched",
                 }
+                record_check_result(results[i])
                 kernels.add("wgl2-sort-batched")
         return overflowed
 

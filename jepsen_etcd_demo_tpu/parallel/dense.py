@@ -36,6 +36,7 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 from ..models.base import Model
+from ..obs import instrument_kernel
 from ..ops import wgl3
 from ..ops.limits import limits
 from ..ops.wgl3 import DenseConfig
@@ -67,8 +68,14 @@ def sharded_batch_checker3_packed(model: Model, cfg: DenseConfig,
                  NamedSharding(mesh, P(axis, None, None)),
                  NamedSharding(mesh, P(axis, None)))
         out_sh = NamedSharding(mesh, P(axis, None))
-        _CACHE[key] = jax.jit(lambda *a: wgl3._pack_result(fn(*a)),
-                              in_shardings=in_sh, out_shardings=out_sh)
+        # instrument_kernel (obs/): compile/execute attribution for the
+        # sharded lane too — under virtual-device CI this IS the
+        # production dense path, and it must not be a telemetry blind
+        # spot.
+        _CACHE[key] = instrument_kernel(
+            "wgl3-dense-sharded",
+            jax.jit(lambda *a: wgl3._pack_result(fn(*a)),
+                    in_shardings=in_sh, out_shardings=out_sh))
     return _CACHE[key]
 
 
@@ -88,10 +95,12 @@ def sharded_batch_checker2(model: Model, cfg2, mesh: Mesh,
                  NamedSharding(mesh, P(axis, None, None)),
                  NamedSharding(mesh, P(axis, None)))
         out_sh = NamedSharding(mesh, P(axis))
-        _CACHE[key] = jax.jit(
-            fn, in_shardings=in_sh,
-            out_shardings={"survived": out_sh, "overflow": out_sh,
-                           "dead_step": out_sh, "max_frontier": out_sh})
+        _CACHE[key] = instrument_kernel(
+            "wgl2-sort-sharded",
+            jax.jit(fn, in_shardings=in_sh,
+                    out_shardings={"survived": out_sh, "overflow": out_sh,
+                                   "dead_step": out_sh,
+                                   "max_frontier": out_sh}))
     return _CACHE[key]
 
 

@@ -23,6 +23,7 @@ import random
 import time
 from typing import Any, Optional
 
+from .. import obs
 from ..clients.base import Client
 from ..generators.core import (Gen, GenContext, Pending, Phases, NEMESIS,
                                SECOND)
@@ -83,9 +84,16 @@ async def _worker(test: dict, gen: Gen, state: _RunState,
             op.process = process
             state.in_flight += 1
             state.recorder.append(op)
+            metrics = obs.get_metrics()
+            t_op = time.monotonic()
             try:
                 if is_nemesis:
-                    completion = await nemesis.invoke(test, op)
+                    # A span per fault op (rare, long): the nemesis's own
+                    # per-node fault events correlate to it by span id.
+                    with obs.get_tracer().span(
+                            f"nemesis.{op.f}",
+                            nemesis=type(nemesis).__name__):
+                        completion = await nemesis.invoke(test, op)
                 else:
                     await ensure_client()
                     completion = await client.invoke(test, op)
@@ -97,6 +105,12 @@ async def _worker(test: dict, gen: Gen, state: _RunState,
                 state.in_flight -= 1
             completion.process = process
             state.recorder.append(completion)
+            if not is_nemesis:
+                # Counters + latency histogram, not per-op spans: client
+                # ops are the hot path (rate * concurrency per second).
+                metrics.counter(f"runner.ops_{completion.type}").add(1)
+                metrics.histogram("runner.op_latency_s").observe(
+                    time.monotonic() - t_op)
             if not is_nemesis and completion.type == "info":
                 # Process crashed (indeterminate op): reincarnate.
                 if client is not None:
@@ -105,6 +119,11 @@ async def _worker(test: dict, gen: Gen, state: _RunState,
                     except Exception:
                         pass
                     client = None
+                obs.get_tracer().event(
+                    "worker.reincarnate", worker=worker_id,
+                    dead_process=int(process),
+                    new_process=int(process) + concurrency, f=op.f)
+                metrics.counter("runner.reincarnations").add(1)
                 process = int(process) + concurrency
             _maybe_open_barrier(gen, state)
             await state.notify()
@@ -194,7 +213,13 @@ async def _teardown_nodes(test: dict, store_dir=None):
 
 
 async def run_test(test: dict) -> dict:
-    """Execute a full test; returns the result map (with "valid")."""
+    """Execute a full test; returns the result map (with "valid").
+
+    Opens a telemetry capture for the run's lifetime: phase spans
+    (setup/run/teardown/check/store), worker/kernel metrics, and nemesis
+    fault events land in <run_dir>/telemetry.jsonl + metrics.json next
+    to the other store artifacts (obs/__init__.py; JEPSEN_TPU_TELEMETRY=0
+    disables)."""
     from ..store import Store
 
     store = None
@@ -202,43 +227,53 @@ async def run_test(test: dict) -> dict:
     if test.get("store_root") is not None:
         store = Store(test["store_root"]).new_run(test.get("name", "test"))
         log_handler = _attach_file_log(store.path)
-    try:
-        return await _run_test_inner(test, store)
-    finally:
-        # Detach per-run file handler so later runs in the same process
-        # (--test-count > 1) don't keep appending to this run's jepsen.log.
-        if log_handler is not None:
-            _detach_file_log(log_handler)
+    with obs.capture(store.path if store else None):
+        try:
+            return await _run_test_inner(test, store)
+        finally:
+            # Detach per-run file handler so later runs in the same process
+            # (--test-count > 1) don't keep appending to this run's
+            # jepsen.log.
+            if log_handler is not None:
+                _detach_file_log(log_handler)
 
 
 async def _run_test_inner(test: dict, store) -> dict:
+    tracer = obs.get_tracer()
     log.info("=== %s: setting up %d nodes", test.get("name"),
              len(test["nodes"]))
     t0 = time.monotonic()
-    await _setup_nodes(test)
+    with tracer.span("setup", nodes=len(test["nodes"]),
+                     workload=str(test.get("workload", ""))):
+        await _setup_nodes(test)
 
-    # Client/nemesis data-plane setup (reference Client.setup!, set.clj:15-16)
-    client_proto: Optional[Client] = test.get("client")
-    if client_proto is not None:
-        c = await client_proto.open(test, test["nodes"][0])
-        await c.setup(test)
-        await c.close(test)
-    nemesis: Optional[Nemesis] = test.get("nemesis")
-    if nemesis is not None:
-        await nemesis.setup(test)
+        # Client/nemesis data-plane setup (reference Client.setup!,
+        # set.clj:15-16)
+        client_proto: Optional[Client] = test.get("client")
+        if client_proto is not None:
+            c = await client_proto.open(test, test["nodes"][0])
+            await c.setup(test)
+            await c.close(test)
+        nemesis: Optional[Nemesis] = test.get("nemesis")
+        if nemesis is not None:
+            await nemesis.setup(test)
 
     log.info("=== running workload")
     recorder = HistoryRecorder()
     try:
-        history = await interpret_generators(test, recorder)
+        with tracer.span("run",
+                         concurrency=int(test.get("concurrency", 10))) as sp:
+            history = await interpret_generators(test, recorder)
+            sp.set(history_entries=len(history))
     finally:
-        if nemesis is not None:
-            await nemesis.teardown(test)
-        if client_proto is not None:
-            c = await client_proto.open(test, test["nodes"][0])
-            await c.teardown(test)
-            await c.close(test)
-        await _teardown_nodes(test, store.path if store else None)
+        with tracer.span("teardown"):
+            if nemesis is not None:
+                await nemesis.teardown(test)
+            if client_proto is not None:
+                c = await client_proto.open(test, test["nodes"][0])
+                await c.teardown(test)
+                await c.close(test)
+            await _teardown_nodes(test, store.path if store else None)
 
     run_s = time.monotonic() - t0
     log.info("=== run complete: %d history entries in %.1fs; checking",
@@ -246,14 +281,18 @@ async def _run_test_inner(test: dict, store) -> dict:
 
     checker = test.get("checker")
     opts = {"store_dir": str(store.path)} if store else {}
-    result = (checker.check(test, history, opts)
-              if checker is not None else {"valid": True})
+    with tracer.span("check") as sp, \
+            obs.maybe_jax_trace(store.path if store else None):
+        result = (checker.check(test, history, opts)
+                  if checker is not None else {"valid": True})
+        sp.set(valid=str(result.get("valid")))
     result.setdefault("op_count",
                       sum(1 for o in history if o.type == INVOKE))
     result["run_seconds"] = run_s
 
     if store is not None:
-        store.write_run(test, history, result)
+        with tracer.span("store"):
+            store.write_run(test, history, result)
         log.info("=== stored run at %s", store.path)
     log.info("=== valid: %s", result.get("valid"))
     return result

@@ -2,8 +2,10 @@
 
 The reference serves its store with ring/jetty + a directory browser
 (src/jepsen/etcdemo.clj:198; deps jepsen.etcdemo.iml:82-99). Same capability
-on http.server: an index of runs with verdicts, and static file serving of
-each run dir (charts, timelines, logs, history)."""
+on http.server: an index of runs with verdicts, static file serving of
+each run dir (charts, timelines, logs, history), and a per-run telemetry
+page (/telemetry/<run>) rendering the span tree + metric table the
+harness records in telemetry.jsonl / metrics.json (obs/)."""
 
 from __future__ import annotations
 
@@ -12,6 +14,7 @@ import urllib.parse
 from functools import partial
 from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
 
+from ..obs import METRICS_FILE, TELEMETRY_FILE, read_jsonl, read_metrics
 from ..store import Store
 
 
@@ -60,36 +63,181 @@ def _index_html(store: Store) -> str:
             summary = ""
         color = {True: "#2a9d43", False: "#d43a2a"}.get(valid, "#e9a820")
         href = urllib.parse.quote(f"/files/{rel}/")
+        tele = ""
+        if (run.path / TELEMETRY_FILE).exists():
+            thref = urllib.parse.quote(f"/telemetry/{rel}")
+            tele = f"<a href='{thref}'>telemetry</a>"
         rows.append(
             f"<tr><td><a href='{href}'>"
             f"{html.escape(str(rel))}</a></td>"
             f"<td style='color:{color};font-weight:bold'>{valid}</td>"
-            f"<td style='color:#666'>{html.escape(summary)}</td></tr>")
+            f"<td style='color:#666'>{html.escape(summary)}</td>"
+            f"<td>{tele}</td></tr>")
     return (
         "<!doctype html><html><head><meta charset='utf-8'>"
         "<title>jepsen-tpu store</title>"
         "<style>body{font-family:sans-serif}td{padding:4px 12px}</style>"
         "</head><body><h2>test runs</h2>"
-        f"<table><tr><th>run</th><th>valid</th><th>detail</th></tr>"
+        f"<table><tr><th>run</th><th>valid</th><th>detail</th>"
+        f"<th>obs</th></tr>"
         f"{''.join(rows)}</table>"
         "</body></html>")
 
 
+# -- telemetry page --------------------------------------------------------
+
+def _fmt_ms(ns: int) -> str:
+    return f"{ns / 1e6:,.1f}"
+
+
+def _fmt_attrs(attrs: dict) -> str:
+    if not attrs:
+        return ""
+    return html.escape(", ".join(f"{k}={v}" for k, v in attrs.items()))
+
+
+def _span_tree_html(records: list[dict]) -> str:
+    """Nested list of spans (parent links -> tree), each with duration
+    and attrs; events render under their enclosing span. Spans keep
+    completion order within one parent — close enough to timeline order
+    for phase-level reading, and robust to concurrent workers."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    events = [r for r in records if r.get("kind") == "event"]
+    children: dict = {}
+    for s in spans:
+        children.setdefault(s.get("parent"), []).append(s)
+    ev_by_span: dict = {}
+    for e in events:
+        ev_by_span.setdefault(e.get("span"), []).append(e)
+    for group in (children, ev_by_span):
+        for v in group.values():
+            v.sort(key=lambda r: r.get("t0_ns", r.get("t_ns", 0)))
+
+    def render(span_id) -> str:
+        out = []
+        for e in ev_by_span.get(span_id, []):
+            out.append(
+                f"<li class='ev'>⚡ {html.escape(str(e['name']))}"
+                f" <span class='t'>@{_fmt_ms(e.get('t_ns', 0))} ms</span>"
+                f" <span class='a'>{_fmt_attrs(e.get('attrs') or {})}"
+                f"</span></li>")
+        for s in children.get(span_id, []):
+            dur = s.get("t1_ns", 0) - s.get("t0_ns", 0)
+            err = " class='err'" if s.get("status") == "error" else ""
+            out.append(
+                f"<li><span{err}><b>{html.escape(str(s['name']))}</b></span>"
+                f" <span class='t'>{_fmt_ms(dur)} ms</span>"
+                f" <span class='a'>{_fmt_attrs(s.get('attrs') or {})}"
+                f"</span><ul>{render(s['id'])}</ul></li>")
+        return "".join(out)
+
+    # Roots: spans with no recorded parent (parent None or missing — a
+    # dropped/unclosed parent must not hide its finished children).
+    known = {s["id"] for s in spans}
+    roots = [s for s in spans
+             if s.get("parent") is None or s.get("parent") not in known]
+    children[None] = sorted(roots, key=lambda s: s.get("t0_ns", 0))
+    return f"<ul class='tree'>{render(None)}</ul>"
+
+
+def _metrics_table_html(metrics: dict) -> str:
+    rows = []
+    for name, rec in sorted(metrics.items()):
+        kind = rec.get("type", "?")
+        if kind == "counter":
+            val = f"{rec.get('value', 0):,.6g}"
+        elif kind == "gauge":
+            val = (f"last {rec.get('last')} / min {rec.get('min')} / "
+                   f"max {rec.get('max')} (n={rec.get('n', 0)})")
+        else:
+            val = (f"n {rec.get('count', 0)}, sum {rec.get('sum', 0):.6g}, "
+                   f"min {rec.get('min')}, max {rec.get('max')}, "
+                   f"avg {round(rec['avg'], 6) if rec.get('avg') is not None else None}")
+        rows.append(f"<tr><td><code>{html.escape(name)}</code></td>"
+                    f"<td>{kind}</td><td>{html.escape(val)}</td></tr>")
+    return (f"<table><tr><th>metric</th><th>type</th><th>value</th></tr>"
+            f"{''.join(rows)}</table>")
+
+
+def _telemetry_html(store: Store, rel: str) -> str | None:
+    """Render <store>/<rel>'s telemetry artifacts; None -> 404 (missing
+    run, no artifacts, or a path escaping the store root)."""
+    root = store.root.resolve()
+    run_dir = (root / rel).resolve()
+    if root not in run_dir.parents or not run_dir.is_dir():
+        return None
+    tele = run_dir / TELEMETRY_FILE
+    metr = run_dir / METRICS_FILE
+    if not tele.exists() and not metr.exists():
+        return None
+    parts = [
+        "<!doctype html><html><head><meta charset='utf-8'>",
+        f"<title>telemetry — {html.escape(rel)}</title>",
+        "<style>body{font-family:sans-serif;margin:2em}"
+        "td{padding:2px 10px;border-bottom:1px solid #eee}"
+        "ul.tree,ul.tree ul{list-style:none;border-left:1px solid #ccc;"
+        "padding-left:1.2em;margin:2px 0}"
+        ".t{color:#2a6db0}.a{color:#888;font-size:90%}"
+        ".err{color:#d43a2a}.ev{color:#555}</style></head><body>",
+        f"<h2>telemetry — {html.escape(rel)}</h2>",
+        f"<p><a href='/'>index</a> · "
+        f"<a href='{urllib.parse.quote(f'/files/{rel}/')}'>run files</a></p>",
+    ]
+    if tele.exists():
+        records = read_jsonl(tele)
+        meta = next((r for r in records if r.get("kind") == "meta"), {})
+        n_spans = sum(1 for r in records if r.get("kind") == "span")
+        n_events = sum(1 for r in records if r.get("kind") == "event")
+        parts.append(
+            f"<h3>span tree</h3><p class='a'>{n_spans} spans, "
+            f"{n_events} events; started {html.escape(str(meta.get('wall_start', '?')))}"
+            f"{'; DROPPED ' + str(meta['dropped']) + ' records' if meta.get('dropped') else ''}"
+            f"</p>")
+        parts.append(_span_tree_html(records))
+    if metr.exists():
+        try:
+            parts.append("<h3>metrics</h3>")
+            parts.append(_metrics_table_html(read_metrics(metr)))
+        except Exception as e:   # a torn metrics.json must not 500 the page
+            parts.append(f"<p class='err'>metrics.json unreadable: "
+                         f"{html.escape(str(e))}</p>")
+    parts.append("</body></html>")
+    return "".join(parts)
+
+
 class StoreHandler(SimpleHTTPRequestHandler):
-    """/ -> run index; /files/... -> static serving rooted at the store."""
+    """/ -> run index; /telemetry/<run> -> span tree + metric table;
+    /files/... -> static serving rooted at the store."""
 
     def __init__(self, *args, store_root: str = "store", **kw):
         self.store = Store(store_root)
         super().__init__(*args, directory=str(store_root), **kw)
 
+    def _send_html(self, body: str, status: int = 200) -> None:
+        payload = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
     def do_GET(self):
         if self.path in ("/", "/index.html"):
-            body = _index_html(self.store).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._send_html(_index_html(self.store))
+            return
+        if self.path.startswith("/telemetry/"):
+            rel = urllib.parse.unquote(
+                self.path[len("/telemetry/"):]).strip("/")
+            try:
+                body = _telemetry_html(self.store, rel)
+            except Exception as e:   # never 500 on a torn artifact
+                body = (f"<!doctype html><p>telemetry unreadable: "
+                        f"{html.escape(str(e))}</p>")
+            if body is None:
+                self._send_html("<!doctype html><p>no telemetry for "
+                                f"{html.escape(rel)}</p>", status=404)
+            else:
+                self._send_html(body)
             return
         if self.path.startswith("/files/"):
             self.path = self.path[len("/files"):]

@@ -19,8 +19,11 @@ from jepsen_etcd_demo_tpu.web.server import make_handler
 
 
 def _run_cli(tmp_path, *extra, workload="register", time_limit="1.5"):
+    # --recovery-wait 0.2: the fake store heals instantly, so the
+    # reference-default 10 s quiet window is pure test wall clock.
     return main(["test", "-w", workload, "--fake",
                  "--time-limit", time_limit, "--rate", "150",
+                 "--recovery-wait", "0.2",
                  "--store", str(tmp_path / "store"), "--seed", "11",
                  *extra])
 
@@ -55,6 +58,9 @@ class TestParser:
         assert a.rate == 10.0             # :180
         assert a.ops_per_key == 100       # :184
         assert a.nodes == "n1,n2,n3,n4,n5"  # noop-test defaults [dep]
+        # The post-heal quiet window keeps the reference's 10 s default;
+        # tests shrink it explicitly (the fake heals instantly).
+        assert a.recovery_wait == 10.0
 
     def test_cli_honors_jax_platforms_env(self):
         """cli/main.py _honor_platform_env: env JAX_PLATFORMS must pick
@@ -164,6 +170,44 @@ class TestAnalyze:
 
 
 class TestWebServer:
+    def test_telemetry_page_renders_spans_and_metrics(self, tmp_path):
+        """The per-run telemetry page (obs/ artifacts): the index links
+        it, the page renders the phase span tree and the metric table,
+        and missing/escaping paths 404."""
+        import urllib.error
+
+        assert _run_cli(tmp_path, time_limit="1.0") == 0
+        store_root = str(tmp_path / "store")
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                    make_handler(store_root))
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        port = httpd.server_address[1]
+        try:
+            idx = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/").read().decode()
+            assert "/telemetry/" in idx
+            rel = Store(store_root).runs()[0].path.relative_to(
+                Store(store_root).root)
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/telemetry/"
+                f"{urllib.parse.quote(str(rel))}").read().decode()
+            # Span tree: the run phases render nested.
+            for phase in ("setup", "run", "check", "store"):
+                assert f"<b>{phase}</b>" in page
+            # Metric table: the well-known phase keys render.
+            assert "wgl.compile_s" in page
+            assert "wgl.execute_s" in page
+            assert "encode.encode_s" in page
+            assert "runner.op_latency_s" in page
+            # No telemetry / path escape -> 404, not a traceback.
+            for bad in ("no/such/run", "..%2F..%2Fetc"):
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/telemetry/{bad}")
+                assert e.value.code == 404
+        finally:
+            httpd.shutdown()
+
     def test_index_and_static_serving(self, tmp_path, capsys):
         assert _run_cli(tmp_path, time_limit="1.0") == 0
         store_root = str(tmp_path / "store")
@@ -206,7 +250,7 @@ def test_analyze_autodetects_workload_and_model(tmp_path, capsys):
     cas-register)."""
     store = str(tmp_path / "store")
     assert main(["test", "-w", "queue", "--fake", "--no-nemesis",
-                 "--time-limit", "1.0", "--rate", "150",
+                 "--time-limit", "1.0", "--recovery-wait", "0.2", "--rate", "150",
                  "--store", store, "--seed", "41"]) == 0
     run_dir = str((tmp_path / "store" / "latest").resolve())
     assert main(["analyze", run_dir]) == 0
@@ -223,10 +267,10 @@ def test_corpus_replay_batches_all_runs(tmp_path, capsys):
 
     store = str(tmp_path / "store")
     assert main(["test", "-w", "register", "--fake", "--no-nemesis",
-                 "--time-limit", "1.2", "--rate", "150",
+                 "--time-limit", "1.2", "--recovery-wait", "0.2", "--rate", "150",
                  "--store", store, "--seed", "21"]) == 0
     assert main(["test", "-w", "register", "--fake", "--no-nemesis",
-                 "--time-limit", "1.2", "--rate", "150",
+                 "--time-limit", "1.2", "--recovery-wait", "0.2", "--rate", "150",
                  "--store", store, "--seed", "22"]) == 0
     rc = main(["corpus", store])
     out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
@@ -234,7 +278,7 @@ def test_corpus_replay_batches_all_runs(tmp_path, capsys):
     assert out["runs"] == 2 and out["keys"] >= 2
 
     assert main(["test", "-w", "register", "--fake", "--no-nemesis",
-                 "--time-limit", "1.2", "--rate", "150",
+                 "--time-limit", "1.2", "--recovery-wait", "0.2", "--rate", "150",
                  "--store", store, "--seed", "23",
                  "--stale-read-prob", "0.8"]) == 1
     rc = main(["corpus", store])
@@ -251,10 +295,10 @@ def test_corpus_replay_routes_models_by_workload(tmp_path, capsys):
 
     store = str(tmp_path / "store")
     assert main(["test", "-w", "register", "--fake", "--no-nemesis",
-                 "--time-limit", "1.0", "--rate", "150",
+                 "--time-limit", "1.0", "--recovery-wait", "0.2", "--rate", "150",
                  "--store", store, "--seed", "31"]) == 0
     assert main(["test", "-w", "queue", "--fake", "--no-nemesis",
-                 "--time-limit", "1.0", "--rate", "150",
+                 "--time-limit", "1.0", "--recovery-wait", "0.2", "--rate", "150",
                  "--store", store, "--seed", "32"]) == 0
     rc = main(["corpus", store])
     out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
@@ -269,14 +313,14 @@ def test_corpus_replay_routes_models_by_workload(tmp_path, capsys):
 
     # Whole-history workloads join the corpus too (one tensor per run).
     assert main(["test", "-w", "mutex", "--fake", "--no-nemesis",
-                 "--time-limit", "1.0", "--rate", "150",
+                 "--time-limit", "1.0", "--recovery-wait", "0.2", "--rate", "150",
                  "--store", store, "--seed", "34"]) == 0
     rc = main(["corpus", store])
     out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0 and out["valid"] is True and out["runs"] == 3
 
     assert main(["test", "-w", "queue", "--fake", "--no-nemesis",
-                 "--time-limit", "1.0", "--rate", "150",
+                 "--time-limit", "1.0", "--recovery-wait", "0.2", "--rate", "150",
                  "--store", store, "--seed", "33",
                  "--reorder-prob", "0.7"]) == 1
     rc = main(["corpus", store])
@@ -309,7 +353,7 @@ def test_index_shows_whole_history_failure_detail(tmp_path):
     — there are no per-key results for these workloads."""
     store = str(tmp_path / "store")
     assert main(["test", "-w", "mutex", "--fake", "--no-nemesis",
-                 "--time-limit", "1.0", "--rate", "150",
+                 "--time-limit", "1.0", "--recovery-wait", "0.2", "--rate", "150",
                  "--store", store, "--seed", "63",
                  "--lost-write-prob", "0.5"]) == 1
     httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(store))

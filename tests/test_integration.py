@@ -516,17 +516,21 @@ def test_kill_nemesis_against_spawned_etcd(tmp_path):
     ever fired e2e against the in-process fake): the kill nemesis stops
     the spawned minietcd mid-run (in-flight ops degrade to :info;
     refused connections in the dead window are determinate :fail),
-    the :stop op re-runs EtcdDB.setup (reinstall + restart), acked
-    writes survive the kill (etcd-default <name>.etcd data dir under
-    the install dir), and the whole history still checks linearizable."""
+    the :stop op calls db.start — a RESTART against the surviving
+    install and data dir, jepsen's db/kill! restart leg, no reinstall —
+    acked writes survive the kill (etcd-default <name>.etcd data dir
+    under the install dir), and the whole history still checks
+    linearizable."""
     # 32 s main phase against the 5 s/5 s nemesis cycle: kill@5, stop
-    # fires @10, the restart (reinstall + start + 3 s settle over the
-    # shim) completes ~16-17 on a quiet box — and the next kill comes 5 s
-    # after the stop op COMPLETES, so the post-restart served window is
-    # ~5 s regardless of restart duration. The limit only needs to
-    # outlast restart-end plus a slice of that window; 32 s gives a
-    # loaded box (restart slipping to ~25) margin a 17 s limit measured
-    # not to have (restart completing AT the limit, zero ops after).
+    # fires @10, the restart (db.start: daemon spawn + 3 s settle over
+    # the shim — no reinstall leg since KillNemesis switched to
+    # db.start) completes ~14-15 on a quiet box — and the next kill
+    # comes 5 s after the stop op COMPLETES, so the post-restart served
+    # window is ~5 s regardless of restart duration. The limit only
+    # needs to outlast restart-end plus a slice of that window; 32 s
+    # gives a loaded box (restart slipping to ~25) generous margin a
+    # 17 s limit measured not to have (restart completing AT the limit,
+    # zero ops after).
     verdict, run_dir, hist, etcd_dir, _ = _spawned_etcd_cli_run(
         tmp_path,
         ["--nemesis", "kill", "--time-limit", "32", "--rate", "20"],
