@@ -407,6 +407,76 @@ def bench_gset_corpus():
             "table_cells": m["table_cells"]}
 
 
+def build_mixed_corpus(n_hist: int = 256, ops_range=(20, 300),
+                       seed: int = 0x5EDC):
+    """Mixed-length register corpus for the bucketed-scheduler lane: the
+    length spread is the whole point (a uniform corpus has nothing to
+    bucket)."""
+    from jepsen_etcd_demo_tpu.ops.encode import encode_register_history
+    from jepsen_etcd_demo_tpu.utils.fuzz import gen_register_history
+
+    rng = random.Random(seed)
+    lo, hi = ops_range
+    return [encode_register_history(
+        gen_register_history(rng, n_ops=rng.randrange(lo, hi),
+                             n_procs=N_PROCS, p_info=0.002), k_slots=32)
+        for _ in range(n_hist)]
+
+
+def bench_sched_corpus(model, n_hist: int = 256, ops_range=(20, 300)) -> dict:
+    """Corpus-throughput lane (ISSUE 2 tentpole): a mixed-length corpus
+    through the bucketed scheduler (sched/engine.py), cold then warm.
+
+    Reports events/s, the MEASURED padding-waste ratio (padded/real
+    steps across the scheduled launches) next to the counterfactual
+    pad-to-max ratio the old single-launch path would have paid, the
+    scheduler kernel-LRU hit rate on the warm pass, and the warm pass's
+    kernel-phase breakdown — whose compile_s must be 0 when every bucket
+    shape was already compiled (the acceptance check
+    tests/test_bench_smoke.py pins on a tiny corpus). Runs under its own
+    telemetry captures (nested captures shadow the bench-wide one), so
+    the lane's numbers are self-contained."""
+    from jepsen_etcd_demo_tpu import obs, sched
+    from jepsen_etcd_demo_tpu.ops import wgl3
+    from jepsen_etcd_demo_tpu.ops.encode import EV_RETURN
+
+    encs = build_mixed_corpus(n_hist, ops_range)
+    with obs.capture() as cold_cap:
+        t0 = time.perf_counter()
+        results, kernel, stats = sched.check_corpus(encs, model)
+        cold_s = time.perf_counter() - t0
+    assert all(r["valid"] is True for r in results), \
+        "sched corpus must be valid by construction"
+    with obs.capture() as warm_cap:
+        t0 = time.perf_counter()
+        results2, kernel, _stats2 = sched.check_corpus(encs, model)
+        warm_s = time.perf_counter() - t0
+    assert results2 == results, "sched corpus must be deterministic"
+
+    events = int(sum(e.n_events for e in encs))
+    rets = [int((e.events[: e.n_events, 0] == EV_RETURN).sum())
+            for e in encs]
+    real = sum(rets)
+    pad_to_max = (len(rets) * wgl3.step_bucket(max(rets)) / real
+                  if real else 0.0)
+    warm_sched = obs.sched_stats(warm_cap.metrics)
+    return {
+        "histories": n_hist,
+        "events": events,
+        "cold_s": round(cold_s, 4),
+        "warm_s": round(warm_s, 4),
+        "events_per_sec": round(events / warm_s, 1),
+        "kernel": kernel,
+        "launches": stats["launches"],
+        "buckets": stats["buckets"],
+        "padding_waste": stats["padding_waste"],
+        "padding_waste_pad_to_max": round(pad_to_max, 4),
+        "cache_hit_rate": warm_sched["cache_hit_rate"],
+        "kernel_phases": obs.kernel_phases(warm_cap.metrics),
+        "kernel_phases_cold": obs.kernel_phases(cold_cap.metrics),
+    }
+
+
 def bench_invalid_lane(model) -> dict:
     """Mixed-validity certification of the COMPILED pallas kernels
     (VERDICT r3 item 2: every prior bench lane was valid-by-construction,
@@ -639,7 +709,8 @@ def bench_100k(model) -> dict:
     return d
 
 
-def _backend_alive(timeout_s: float = 240.0) -> tuple[bool, str]:
+def _backend_alive(timeout_s: float = 240.0,
+                   platforms: str | None = None) -> tuple[bool, str]:
     """Probe the default JAX backend in a SUBPROCESS with a hard timeout:
     a wedged remote-TPU tunnel hangs backend init indefinitely and
     un-interruptibly from within the process (observed live: a mid-round
@@ -662,10 +733,13 @@ def _backend_alive(timeout_s: float = 240.0) -> tuple[bool, str]:
             "import numpy, jax, jax.numpy as jnp; "
             "numpy.asarray(jax.jit(lambda a: a + 1)(jnp.zeros(4))); "
             "print('BACKEND_OK')")
+    env = dict(os.environ)
+    if platforms is not None:
+        env["JAX_PLATFORMS"] = platforms
     try:
         out = subprocess.run([sys.executable, "-c", code],
                              capture_output=True, text=True,
-                             timeout=timeout_s)
+                             env=env, timeout=timeout_s)
     except subprocess.TimeoutExpired:
         return False, (f"trivial jit round trip exceeded {timeout_s:.0f}s "
                        f"— remote TPU tunnel down/wedged?")
@@ -681,17 +755,39 @@ def main():
     from jepsen_etcd_demo_tpu import obs
 
     ok, reason = _backend_alive()
+    degraded = False
     if not ok:
-        print(json.dumps({
-            "metric": "wgl_check_throughput", "value": 0,
-            "unit": "history-events/sec", "vs_baseline": 0,
-            # The breakdown contract is "zeros permitted, never absent":
-            # an unreachable backend reports all-zero phases, so trend
-            # tooling never branches on a missing key.
-            "kernel_phases": obs.kernel_phases(None),
-            "error": f"JAX backend unusable ({reason}); bench aborted "
-                     f"instead of hanging"}))
-        return 1
+        # Degraded-mode fallback (VERDICT r5): a dead TPU tunnel used to
+        # zero the whole round's record (rc 1, value 0). Re-probe on the
+        # CPU backend and, when IT is healthy, rerun the CPU-provable
+        # lanes there — a full record tagged degraded/cpu instead of a
+        # blank. Only when even CPU can't complete a trivial jit does
+        # the bench abort with the all-zero error line.
+        cpu_ok, cpu_reason = _backend_alive(platforms="cpu")
+        if not cpu_ok:
+            print(json.dumps({
+                "metric": "wgl_check_throughput", "value": 0,
+                "unit": "history-events/sec", "vs_baseline": 0,
+                # The breakdown contract is "zeros permitted, never
+                # absent": an unreachable backend reports all-zero
+                # phases, so trend tooling never branches on a missing
+                # key.
+                "kernel_phases": obs.kernel_phases(None),
+                "padding_waste": 0.0,
+                "cache_hit_rate": 0.0,
+                "degraded": False,
+                "error": f"JAX backend unusable ({reason}); CPU fallback "
+                         f"also unusable ({cpu_reason}); bench aborted "
+                         f"instead of hanging"}))
+            return 1
+        print(f"# default backend unusable ({reason}); degraded rerun on "
+              f"JAX_PLATFORMS=cpu", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        degraded = True
+
+    from jepsen_etcd_demo_tpu.cli.main import _honor_platform_env
+
+    _honor_platform_env()
 
     import jax
 
@@ -722,6 +818,10 @@ def main():
                  for n in LONG_OPS]
         gset = bench_gset_corpus()
         invalid_lane = bench_invalid_lane(model)
+        # The lane opens its own nested captures (cold/warm kernel-phase
+        # attribution), which shadow this one — its numbers land in the
+        # top-level padding_waste / cache_hit_rate fields instead.
+        sched_lane = bench_sched_corpus(model)
         # Inside the capture: the 100k lane's compile/execute/encode
         # seconds must land in the same kernel_phases breakdown as every
         # other lane when it actually runs.
@@ -753,6 +853,7 @@ def main():
              for k, v in d.items()} for d in longs],
         "gset_corpus": gset,
         "invalid_lane": invalid_lane,
+        "corpus_sched": sched_lane,
     }
     if "roofline" in corpus:
         detail["roofline"] = corpus["roofline"]
@@ -777,8 +878,16 @@ def main():
         # config high-water mark — doc/telemetry.md maps each field to
         # its underlying metric key.
         "kernel_phases": obs.kernel_phases(cap.metrics),
+        # The scheduler lane's contract fields (doc/perf.md): measured
+        # padded/real step ratio across its bucketed launches and the
+        # kernel-LRU hit rate of its warm pass.
+        "padding_waste": sched_lane["padding_waste"],
+        "cache_hit_rate": sched_lane["cache_hit_rate"],
+        "degraded": degraded,
+        "backend": "cpu" if degraded else jax.default_backend(),
         "detail": detail,
     }))
+    return 0
 
 
 if __name__ == "__main__":

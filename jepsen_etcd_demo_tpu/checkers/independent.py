@@ -154,14 +154,17 @@ def _batched_linearizable_traced(lin: Linearizable,
     max_value = max(e.max_value for e in event_encs.values())
 
     # Dense path: one table geometry serves the whole batch — mask width =
-    # the largest key's real concurrency.
+    # the largest key's real concurrency. Launches go through the corpus
+    # scheduler (sched/engine.py): per-key histories land in padded-length
+    # buckets instead of all padding to the longest key, so a run with one
+    # long-lived key no longer taxes every other key's launch.
     tight = max(wgl3.tight_k_slots(e) for e in event_encs.values())
     cfg3 = wgl3.dense_config(lin.model, tight, max_value)
     if cfg3 is not None:
-        from ..ops import wgl3_pallas
+        from .. import sched
 
         keys = list(event_encs)
-        batch, _kernel = wgl3_pallas.check_batch_encoded_auto(
+        batch, _kernel, _stats = sched.check_corpus(
             [event_encs[k] for k in keys], lin.model)
         return {
             k: {

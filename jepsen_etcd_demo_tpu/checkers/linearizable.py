@@ -66,14 +66,26 @@ class Linearizable(Checker):
         return self._encode_translated(self.model.prepare_history(history))
 
     def _encode_translated(self, history: Sequence[Op]) -> EncodedHistory:
+        # Encoded-tensor cache (store/encode_cache.py): replays of an
+        # unchanged history skip the pair/encode pass entirely. Inactive
+        # unless the CLI (analyze/corpus) switched it on; the key covers
+        # exactly the encoder's input, so a hit is bit-identical.
+        from ..store import encode_cache
+
+        cached = encode_cache.lookup(history, self.model.name, self.k_slots)
+        if cached is not None:
+            return cached
         k = self.k_slots
         while True:
             try:
-                return encode_history(history, self.model, k_slots=k)
+                enc = encode_history(history, self.model, k_slots=k)
+                break
             except SlotOverflow:
                 if k >= 4096:
                     raise
                 k *= 2
+        encode_cache.store(history, self.model.name, self.k_slots, enc)
+        return enc
 
     # -- checking ---------------------------------------------------------
     def check(self, test: dict, history: Sequence[Op],

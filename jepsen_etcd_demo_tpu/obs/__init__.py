@@ -59,6 +59,13 @@ METRICS_FILE = "metrics.json"
 # The bench/e2e contract keys: pre-registered at zero on every capture.
 PHASE_COUNTERS = ("wgl.compile_s", "wgl.execute_s", "encode.encode_s")
 PHASE_GAUGE = "wgl.frontier_peak"
+# Corpus-scheduler accounting (sched/): padded-vs-real step counters
+# behind the bench's padding_waste field and the kernel-LRU hit/miss
+# counters behind cache_hit_rate — pre-registered so the artifacts carry
+# zeros, never absences, even for runs that never launch a batch.
+SCHED_COUNTERS = ("sched.steps_real", "sched.steps_padded",
+                  "sched.cache_hits", "sched.cache_misses",
+                  "encode.cache_hits", "encode.cache_misses")
 
 _NULL_TRACER = Tracer(enabled=False)
 _NULL_METRICS = MetricsRegistry(enabled=False)
@@ -75,7 +82,7 @@ class Capture:
         self.tracer = Tracer(enabled=enabled)
         self.metrics = MetricsRegistry(enabled=enabled)
         if enabled:
-            for name in PHASE_COUNTERS:
+            for name in PHASE_COUNTERS + SCHED_COUNTERS:
                 self.metrics.counter(name)
             self.metrics.gauge(PHASE_GAUGE)
 
@@ -215,6 +222,33 @@ def kernel_phases(metrics: Optional[MetricsRegistry] = None) -> dict:
     fp = snap.get(PHASE_GAUGE)
     if fp and fp.get("max") is not None:
         out["frontier_peak"] = int(fp["max"])
+    return out
+
+
+def sched_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
+    """The corpus scheduler's bench contract fields, from a registry
+    snapshot: padding_waste (padded/real steps over every scheduled
+    launch in the capture) and cache_hit_rate (kernel-LRU hits over
+    lookups). Zeros when no registry / no launches — like
+    kernel_phases, the contract is "zeros permitted, never absent"."""
+    out = {"padding_waste": 0.0, "cache_hit_rate": 0.0}
+    if metrics is None or not metrics.enabled:
+        return out
+    snap = metrics.snapshot()
+
+    def counter_value(key: str) -> float:
+        rec = snap.get(key)
+        return rec["value"] if rec \
+            and rec.get("type") == "counter" else 0.0
+
+    real = counter_value("sched.steps_real")
+    padded = counter_value("sched.steps_padded")
+    if real:
+        out["padding_waste"] = round(padded / real, 4)
+    hits = counter_value("sched.cache_hits")
+    lookups = hits + counter_value("sched.cache_misses")
+    if lookups:
+        out["cache_hit_rate"] = round(hits / lookups, 4)
     return out
 
 

@@ -65,6 +65,12 @@ EV_INVOKE, EV_RETURN, EV_PAD = 0, 1, 2
 
 EVENT_WIDTH = 6  # (kind, slot, f, a1, a2, rv)
 
+# Bump on ANY change to the encoder's input->tensor mapping (pairing,
+# slot assignment, field layout, value encoding): the content-addressed
+# encoded-tensor cache (store/encode_cache.py) keys on it, so a stale
+# persisted encoding can never survive an encoder fix.
+ENCODING_VERSION = 1
+
 
 class EncodeError(ValueError):
     pass
@@ -110,6 +116,26 @@ class EncodedHistory:
         ev[: self.events.shape[0]] = self.events
         return EncodedHistory(ev, self.n_events, self.n_ops, self.k_slots,
                               self.max_pending, self.max_value)
+
+    def to_arrays(self) -> dict[str, np.ndarray]:
+        """npz-ready dict (trimmed to real events) — ONE serialization
+        shape shared by the store's history-tensor artifacts and the
+        encoded-tensor cache, so the two cannot drift."""
+        return {"events": np.asarray(self.events[: self.n_events]),
+                "n_ops": np.asarray(self.n_ops),
+                "k_slots": np.asarray(self.k_slots),
+                "max_pending": np.asarray(self.max_pending),
+                "max_value": np.asarray(self.max_value)}
+
+    @classmethod
+    def from_arrays(cls, z) -> "EncodedHistory":
+        """Inverse of to_arrays over any mapping of arrays (an open
+        np.load handle included)."""
+        events = np.asarray(z["events"], dtype=np.int32)
+        return cls(events=events, n_events=int(events.shape[0]),
+                   n_ops=int(z["n_ops"]), k_slots=int(z["k_slots"]),
+                   max_pending=int(z["max_pending"]),
+                   max_value=int(z["max_value"]))
 
 
 def _encode_value(v: Any) -> int:

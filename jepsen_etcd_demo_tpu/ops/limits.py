@@ -84,6 +84,33 @@ class KernelLimits:
     # kernel-side at G=16 on v5e, plateau past 16). 0 or 1 disables
     # grouping; batches smaller than the group stay per-history.
     pallas_group: int = 16
+    # [arch] Floor of the step-axis length buckets the corpus scheduler
+    # (sched/engine.py) and the scan-length bucketing (wgl3.step_bucket)
+    # pad to. {2^k, 1.5*2^k} buckets bound per-bucket padding waste to
+    # <1.5x and distinct jit compilations per kernel to the bucket count;
+    # a lower floor trades a few extra compilations for tighter padding
+    # on short-history corpora. 32 chosen from the step-padding gauge
+    # (PR 1): tutorial-scale fuzz corpora (10-120 ops) measured >2x
+    # padded/real under the old 64 floor, <1.6x at 32.
+    step_bucket_floor: int = 32
+    # [arch] Floor of the batch-axis buckets the scheduler pads launches
+    # to (with all-pad histories, targets=-1 — stripped from results).
+    batch_bucket_floor: int = 8
+    # [arch] In-flight chunks of the double-buffered resumable sort sweep
+    # (ops/wgl2.py check_steps_resumable): chunk N+1 dispatches before
+    # chunk N's overflow flag is fetched, hiding the per-chunk host<->
+    # device round trip. 1 restores the fully synchronous loop; deeper
+    # pipelines only buy anything on high-latency (tunneled) backends.
+    sched_pipeline_depth: int = 2
+    # [worker] Death-poll interval (in chunks) of the pipelined dense
+    # long sweep (wgl3.check_steps3_long without a time budget): the
+    # early-exit fetch costs a host round trip per poll, so the pipeline
+    # only syncs every N chunks; dead chunks in between are near-free
+    # (empty closures).
+    sched_poll_chunks: int = 8
+    # [arch] Entry capacity of the scheduler's in-process kernel LRU
+    # (sched/compile_cache.py, keyed by (kernel, model, bucket shape)).
+    kernel_cache_entries: int = 256
 
 
 def _from_env() -> KernelLimits:

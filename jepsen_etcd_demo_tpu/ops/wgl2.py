@@ -34,6 +34,7 @@ import numpy as np
 from ..models.base import Model
 from ..obs import instrument_kernel, record_check_result
 from .encode import EncodedHistory, ReturnSteps, encode_return_steps
+from .limits import limits
 
 
 @dataclass(frozen=True)
@@ -411,8 +412,24 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
     re-running the search. Zero cost until death: the pre-chunk carry is
     just a retained device reference, fetched only when the search dies.
     Checkpoints are exact by construction: a chunk's output is only
-    accepted when it ran without overflow."""
+    accepted when it ran without overflow.
+
+    The chunk loop is DOUBLE-BUFFERED (sched/pipeline.py InflightWindow,
+    depth limits().sched_pipeline_depth): chunk N+1 is dispatched — its
+    carry chained device-side off chunk N's (still in-flight) output —
+    before chunk N's overflow flag is fetched, so the per-chunk status
+    round trip hides under the next chunk's execution. Speculation is
+    discarded, never trusted: when a resolved chunk overflowed, every
+    later in-flight chunk (computed from the overflowed carry) is
+    dropped and the loop re-runs from the pre-chunk checkpoint at the
+    escalated capacity, exactly like the synchronous loop did. The carry
+    is NOT donated here: the pre-chunk buffer must survive as the
+    escalation/death checkpoint. The budget check happens at each
+    resolution, so overshoot grows from one chunk to at most the
+    pipeline depth."""
     import time as _time
+
+    from ..sched.pipeline import InflightWindow
 
     if model is None:
         from ..models import CASRegister
@@ -425,38 +442,65 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
     carry = _init_carry2(model, cfg)
     escalations = 0
     death_ckpt = None
-    for c0 in range(0, padded.targets.shape[0], chunk):
-        pre_chunk = carry if keep_death_checkpoint else None
+
+    def budget_check(c0: int) -> None:
+        if (time_budget_s is not None
+                and _time.monotonic() - t0 > time_budget_s):
+            raise SearchBudgetExceeded(
+                f"WGL search exceeded its {time_budget_s:.0f}s time "
+                f"budget at return step {c0} (f_cap={f_cap}); the "
+                f"frontier is growing combinatorially")
+
+    def dispatch(c0: int, pre: _Carry2) -> _Carry2:
         sl = slice(c0, c0 + chunk)
         idxs = jnp.arange(c0, c0 + chunk, dtype=jnp.int32)
-        while True:
-            if (time_budget_s is not None
-                    and _time.monotonic() - t0 > time_budget_s):
-                raise SearchBudgetExceeded(
-                    f"WGL search exceeded its {time_budget_s:.0f}s time "
-                    f"budget at return step {c0} (f_cap={f_cap}); the "
-                    f"frontier is growing combinatorially")
-            out = cached_chunk2(model, cfg)(
-                carry, tabs[sl], act[sl], tgt[sl], idxs)
-            if not bool(out.overflow):
-                carry = out
-                break
-            # Overflow: escalate capacity, resume from the checkpoint.
-            f_cap *= 4
-            escalations += 1
-            if f_cap > f_cap_max:
-                raise MemoryError(
-                    f"WGL frontier exceeds f_cap_max={f_cap_max} at return "
-                    f"step {c0}; history needs the dense sweep — chunked "
-                    f"(ops/wgl3.py) or lattice-sharded "
-                    f"(parallel/lattice.py)")
-            cfg = config_for(rs, model, f_cap)
-            carry = _migrate_carry(carry, f_cap)
+        return cached_chunk2(model, cfg)(
+            pre, tabs[sl], act[sl], tgt[sl], idxs)
+
+    chunk_starts = list(range(0, padded.targets.shape[0], chunk))
+    window = InflightWindow(limits().sched_pipeline_depth)
+    pos = 0
+    while pos < len(chunk_starts) or window:
+        while pos < len(chunk_starts) and not window.full():
+            c0 = chunk_starts[pos]
+            out = dispatch(c0, carry)
+            window.push((c0, carry, out))
+            carry = out
+            pos += 1
+        c0, pre, out = window.pop()
+        budget_check(c0)
+        if bool(out.overflow):
+            # Every later in-flight chunk chained off this overflowed
+            # carry: discard the speculation, escalate, resume from the
+            # pre-chunk checkpoint, and refill the pipeline from here.
+            window.clear()
+            while True:
+                f_cap *= 4
+                escalations += 1
+                if f_cap > f_cap_max:
+                    raise MemoryError(
+                        f"WGL frontier exceeds f_cap_max={f_cap_max} at "
+                        f"return step {c0}; history needs the dense "
+                        f"sweep — chunked (ops/wgl3.py) or "
+                        f"lattice-sharded (parallel/lattice.py)")
+                cfg = config_for(rs, model, f_cap)
+                pre = _migrate_carry(pre, f_cap)
+                budget_check(c0)
+                out = dispatch(c0, pre)
+                if not bool(out.overflow):
+                    break
+            carry = out
+            pos = c0 // chunk + 1
         if bool(out.dead):
+            # The first resolved dead chunk (earlier chunks resolved
+            # clean). Later in-flight chunks are death-sticky no-ops —
+            # drop them; `out` carries the exact final verdict fields.
             if keep_death_checkpoint:
-                death_ckpt = (np.asarray(pre_chunk.states),
-                              np.asarray(pre_chunk.masks),
-                              np.asarray(pre_chunk.valid), c0)
+                death_ckpt = (np.asarray(pre.states),
+                              np.asarray(pre.masks),
+                              np.asarray(pre.valid), c0)
+            window.clear()
+            carry = out
             break
     res = {
         "survived": not bool(carry.dead),

@@ -272,7 +272,11 @@ def make_checker3(model: Model, cfg: DenseConfig):
 def _chunk_fn(model: Model, cfg: DenseConfig):
     """jitted (carry, tabs[C,K,4], act[C,K], tgts[C], idx0) ->
     (carry', configs-partial f32 scalar) — the partial sums accumulate
-    device-side across chunks and are fetched once at the end."""
+    device-side across chunks and are fetched once at the end. The carry
+    is DONATED: every caller threads it linearly (chunk N's output is
+    chunk N+1's input and nothing else reads the old buffer), so XLA can
+    alias the table in place instead of allocating a fresh one per
+    chunk."""
     step, transitions = make_step_fn3(model, cfg)
 
     def run(carry, tabs, act, tgts, idx0):
@@ -281,7 +285,7 @@ def _chunk_fn(model: Model, cfg: DenseConfig):
         carry, ns = jax.lax.scan(step, carry, (trans, tgts, idxs))
         return carry, jnp.sum(ns.astype(jnp.float32))
 
-    return jax.jit(run)
+    return jax.jit(run, donate_argnums=(0,))
 
 
 def default_scan_chunk(cfg: DenseConfig) -> int:
@@ -317,8 +321,20 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
     per step is proportional to the cell count). `time_budget_s` bounds
     wall time between chunks; expiry returns the honest tri-state
     "unknown" with overflow=True (same contract as the sort ladder,
-    ops/wgl2.py)."""
+    ops/wgl2.py).
+
+    Without a budget the chunk loop is PIPELINED (sched/pipeline.py):
+    chunk N+1's slices transfer while chunk N executes (async dispatch —
+    the carry chains device-side, with the frontier buffer donated), and
+    the death-poll fetch happens only every limits().sched_poll_chunks
+    chunks instead of per chunk — dead chunks in between are near-free
+    (the closure exits immediately on an empty table) and death-sticky
+    carries keep dead_step/max_frontier exact, so the result is
+    bit-identical to the per-chunk loop. The budgeted path stays
+    synchronous per chunk: the budget check must see device time."""
     import time as _time
+
+    from ..sched.pipeline import double_buffer
 
     t0 = _time.monotonic()
     if chunk is None:
@@ -329,25 +345,44 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
     rs = rs.padded_to(n_pad)
     carry = _init_carry3(model, cfg)
     cfgs_dev = None
-    for c in range(n_pad // chunk):
-        if (time_budget_s is not None
-                and _time.monotonic() - t0 > time_budget_s):
-            return {"valid": "unknown", "survived": False, "overflow": True,
-                    "dead_step": -1, "max_frontier": -1,
-                    "configs_explored": -1, "kernel": "exhausted",
-                    "error": f"dense-chunked sweep exceeded its "
-                             f"{time_budget_s:.0f}s time budget at return "
-                             f"step {c * chunk}"}
-        sl = slice(c * chunk, (c + 1) * chunk)
-        carry, part = run(carry, jnp.asarray(rs.slot_tabs[sl]),
-                          jnp.asarray(rs.slot_active[sl]),
-                          jnp.asarray(rs.targets[sl]),
-                          jnp.int32(c * chunk))
-        cfgs_dev = part if cfgs_dev is None else cfgs_dev + part
-        # Early exit on death: one 1-byte fetch per chunk (~0.1 s on a
-        # tunneled backend) vs minutes of dead chunks on wide tables.
-        if bool(np.asarray(carry.dead)):
-            break
+    if time_budget_s is None:
+        poll = max(1, limits().sched_poll_chunks)
+
+        def stage(c):
+            sl = slice(c * chunk, (c + 1) * chunk)
+            return (jnp.asarray(rs.slot_tabs[sl]),
+                    jnp.asarray(rs.slot_active[sl]),
+                    jnp.asarray(rs.targets[sl]),
+                    jnp.int32(c * chunk))
+
+        done = 0
+        for staged in double_buffer(range(n_pad // chunk), stage):
+            carry, part = run(carry, *staged)
+            cfgs_dev = part if cfgs_dev is None else cfgs_dev + part
+            done += 1
+            if done % poll == 0 and bool(np.asarray(carry.dead)):
+                break
+    else:
+        for c in range(n_pad // chunk):
+            if _time.monotonic() - t0 > time_budget_s:
+                return {"valid": "unknown", "survived": False,
+                        "overflow": True, "dead_step": -1,
+                        "max_frontier": -1, "configs_explored": -1,
+                        "kernel": "exhausted",
+                        "error": f"dense-chunked sweep exceeded its "
+                                 f"{time_budget_s:.0f}s time budget at "
+                                 f"return step {c * chunk}"}
+            sl = slice(c * chunk, (c + 1) * chunk)
+            carry, part = run(carry, jnp.asarray(rs.slot_tabs[sl]),
+                              jnp.asarray(rs.slot_active[sl]),
+                              jnp.asarray(rs.targets[sl]),
+                              jnp.int32(c * chunk))
+            cfgs_dev = part if cfgs_dev is None else cfgs_dev + part
+            # Early exit on death: one 1-byte fetch per chunk (~0.1 s on
+            # a tunneled backend) vs minutes of dead chunks on wide
+            # tables.
+            if bool(np.asarray(carry.dead)):
+                break
     from .wgl import verdict
 
     # One packed fetch at the end (chunks chain device-side).
@@ -477,11 +512,15 @@ def tight_k_slots(enc: EncodedHistory) -> int:
     return max(6, (enc.max_pending + 1) // 2 * 2)
 
 
-def step_bucket(n_steps: int, floor: int = 64) -> int:
+def step_bucket(n_steps: int, floor: int | None = None) -> int:
     """Pad scan lengths to {2^k, 1.5*2^k} buckets: bounded recompiles
     across a corpus of varying history lengths, ≤33% padded steps (pads are
     cheap — the closure while_loop exits immediately on a pad step — but
-    the scan still walks them)."""
+    the scan still walks them). The default floor is the tunable
+    limits().step_bucket_floor — the same boundary set the corpus
+    scheduler (sched/engine.py) groups launches by."""
+    if floor is None:
+        floor = limits().step_bucket_floor
     r = floor
     while r < n_steps:
         if r + r // 2 >= n_steps:
@@ -581,12 +620,21 @@ def _record_padding(steps, r_cap: int) -> None:
     """Telemetry (obs/): per-launch step-bucket padding waste. Pads are
     cheap (the closure exits immediately; the fused kernel never even
     executes them) but the scan still walks them in the XLA path — the
-    gauge makes the waste visible per launch instead of folklore."""
+    gauges make the waste visible per launch instead of folklore. Two
+    views per launch: the padded percentage (step_padding_pct) and the
+    padded/real RATIO (step_padding_ratio — the number the scheduler's
+    <2x bucket-waste bound is stated in), plus running real/padded step
+    counters so consumers can aggregate an exact corpus-wide ratio
+    instead of averaging per-launch gauges."""
     real = int(sum(s.n_steps for s in steps))
     total = len(steps) * int(r_cap)
     if total:
-        get_metrics().gauge("wgl.step_padding_pct").set(
-            100.0 * (1.0 - real / total))
+        m = get_metrics()
+        m.gauge("wgl.step_padding_pct").set(100.0 * (1.0 - real / total))
+        m.counter("wgl.steps_real").add(real)
+        m.counter("wgl.steps_padded").add(total)
+        if real:
+            m.gauge("wgl.step_padding_ratio").set(total / real)
 
 
 def stack_steps3(steps, r_cap: int):

@@ -439,7 +439,12 @@ def local_pallas_launcher_resumable(model: Model, cfg: DenseConfig,
 
         # obs/ compile/execute attribution: lru_cache gives one wrapper
         # (and so one first-call flag) per compiled window shape R.
-        return instrument_kernel("wgl3-pallas-resumable", jax.jit(run))
+        # mt/Tin are DONATED: the host loop threads them linearly
+        # (window N's outputs are window N+1's inputs and nothing else
+        # reads the old buffers), so the table aliases in place across
+        # the whole chain.
+        return instrument_kernel("wgl3-pallas-resumable",
+                                 jax.jit(run, donate_argnums=(1, 4)))
 
     return launch
 
@@ -1268,10 +1273,7 @@ def check_batch_encoded_auto(encs: Sequence[EncodedHistory],
                     "oracle-small-history")
         except OracleBudgetExceeded:
             pass
-    dense_idx, general_idx = [], []
-    for i, e in enumerate(encs):
-        ok = dense_config(model, wgl3.tight_k_slots(e), e.max_value)
-        (dense_idx if ok is not None else general_idx).append(i)
+    dense_idx, general_idx = partition_dense(encs, model)
 
     results: list = [None] * len(encs)
     kernels: set[str] = set()
@@ -1293,19 +1295,10 @@ def check_batch_encoded_auto(encs: Sequence[EncodedHistory],
                 # launch-sized windows with the search state carried
                 # between launches (check_steps3_long_pallas — the 100k-op
                 # lane); elsewhere the XLA scan streams chunk by chunk.
-                fused = use_pallas(cfg)
-                name = ("wgl3-dense-pallas-chunked" if fused
-                        else "wgl3-dense-chunked")
                 for i, s in zip(dense_idx, steps):
-                    if fused:
-                        one = check_steps3_long_pallas(s, model, cfg)
-                    else:
-                        one = wgl3.check_steps3_long(s, model, cfg)
-                    one["op_count"] = s.n_ops
-                    one["table_cells"] = cfg.n_states * cfg.n_masks
-                    one.setdefault("kernel", name)
+                    one = run_long_dense(s, model, cfg)
                     results[i] = one
-                kernels.add(name)
+                    kernels.add(one["kernel"])
             elif jax.device_count() > 1 and len(sub) > 1:
                 # Multi-device: shard the batch axis over all devices —
                 # the PRODUCTION multi-chip path (corpus / independent
@@ -1329,17 +1322,10 @@ def check_batch_encoded_auto(encs: Sequence[EncodedHistory],
     if general_idx:
         overflowed, too_long, top = _batch_general(encs, general_idx, model,
                                                    results, kernels)
-        for i in too_long:
-            one = check_encoded_general(encs[i], model)
-            results[i] = one
-            kernels.add(one["kernel"])
-        for i in overflowed:
-            # The batched tiers PROVED capacities up to `top` overflow for
-            # these: start the ladder past every dead rung.
-            one = check_encoded_general(encs[i], model,
-                                        f_cap=LADDER_SEED_FACTOR * top)
-            results[i] = one
-            kernels.add(one["kernel"])
+        # The batched tiers PROVED capacities up to `top` overflow for
+        # these: start the ladder past every dead rung.
+        ladder_tail(encs, model, results, kernels, too_long,
+                    [(i, LADDER_SEED_FACTOR * top) for i in overflowed])
     return results, (kernels.pop() if len(kernels) == 1 else "mixed")
 
 
@@ -1404,6 +1390,58 @@ def _oracle_result(enc: EncodedHistory, model: Model,
 # by check_batch_encoded_auto and the independent checker's f_cap_floor
 # threading (checkers/independent.py) so the seeding policy has one copy.
 LADDER_SEED_FACTOR = 4
+
+
+# -- routing policy shared with the corpus scheduler (sched/engine.py) -----
+# The scheduler changes HOW dense batches are padded and launched, never
+# WHICH kernel checks what: partition criteria, the long-history sweep
+# dispatch, and the general-lane ladder tail live here, in exactly one
+# copy, so the two batched entry points cannot drift.
+
+def partition_dense(encs: Sequence[EncodedHistory], model: Model
+                    ) -> tuple[list[int], list[int]]:
+    """Per-history dense feasibility split: (dense_idx, general_idx)."""
+    from . import wgl3
+
+    dense_idx, general_idx = [], []
+    for i, e in enumerate(encs):
+        ok = dense_config(model, wgl3.tight_k_slots(e), e.max_value)
+        (dense_idx if ok is not None else general_idx).append(i)
+    return dense_idx, general_idx
+
+
+def run_long_dense(rs, model: Model, cfg: DenseConfig) -> dict:
+    """One dense-feasible history whose step count exceeds a scan
+    program: the host-chunked sweep (fused on a live TPU), result
+    normalized to the batched schema (op_count/table_cells/kernel)."""
+    from . import wgl3
+
+    fused = use_pallas(cfg)
+    if fused:
+        one = check_steps3_long_pallas(rs, model, cfg)
+    else:
+        one = wgl3.check_steps3_long(rs, model, cfg)
+    one["op_count"] = rs.n_ops
+    one["table_cells"] = cfg.n_states * cfg.n_masks
+    one.setdefault("kernel", "wgl3-dense-pallas-chunked" if fused
+                   else "wgl3-dense-chunked")
+    return one
+
+
+def ladder_tail(encs, model: Model, results: list, kernels: set,
+                too_long: Sequence[int],
+                overflow_seeds: Sequence[tuple[int, int]]) -> None:
+    """The general lane's per-history tail after the batched sort tiers:
+    too-long histories ladder from scratch; tier-proven overflows ladder
+    seeded past every capacity the tiers showed dead."""
+    for i in too_long:
+        one = check_encoded_general(encs[i], model)
+        results[i] = one
+        kernels.add(one["kernel"])
+    for i, seed in overflow_seeds:
+        one = check_encoded_general(encs[i], model, f_cap=seed)
+        results[i] = one
+        kernels.add(one["kernel"])
 
 
 # Batched-tier capacities for the non-dense pass. Start small: sort cost

@@ -238,12 +238,22 @@ def check_steps_sharded(model: Model, cfg: DenseConfig, steps,
                         ) -> tuple[list[dict], str]:
     """Device-side half of the sharded batch check, for callers that
     already ran wgl3.batch_steps3: pad the [B] axis to the mesh, launch
-    once, strip pads. Returns (per-history results, kernel_name)."""
+    once, strip pads. Returns (per-history results, kernel_name).
+
+    The [B] axis pads to a {2^k, 1.5*2^k} BUCKET (then the sharding
+    multiple), not just the multiple: ragged corpora of nearby sizes
+    share one compiled shape instead of recompiling per batch size —
+    the batch-axis twin of the scheduler's step-length buckets
+    (sched/engine.py). Pad histories are all-pad scans (targets=-1,
+    zero work) and are stripped before assembly."""
     if mesh is None:
         mesh = batch_mesh()
     mult = batch_multiple(model, cfg, mesh, n_steps=r_cap,
                           batch=len(steps))
-    arrays, b = pad_batch_arrays(wgl3.stack_steps3(steps, r_cap), mult)
+    b_bucket = wgl3.step_bucket(len(steps),
+                                floor=limits().batch_bucket_floor)
+    target = (b_bucket + mult - 1) // mult * mult
+    arrays, b = pad_batch_arrays(wgl3.stack_steps3(steps, r_cap), target)
     check, name = sharded_packed_batch_checker(
         model, cfg, mesh, n_steps=r_cap, batch=arrays[2].shape[0])
     out = wgl3.unpack_np(np.asarray(check(*(jnp.asarray(a)

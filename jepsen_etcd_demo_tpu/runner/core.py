@@ -281,6 +281,13 @@ async def _run_test_inner(test: dict, store) -> dict:
 
     checker = test.get("checker")
     opts = {"store_dir": str(store.path)} if store else {}
+    # Check phase compiles WGL kernels: point jax's persistent compile
+    # cache under the store first (sched/compile_cache.py; idempotent —
+    # a CLI-level enable wins), so embedding callers of run_test get the
+    # cross-process compile reuse too, not only `jepsen-tpu test`.
+    from ..sched import enable_persistent_cache
+
+    enable_persistent_cache(test.get("store_root"))
     with tracer.span("check") as sp, \
             obs.maybe_jax_trace(store.path if store else None):
         result = (checker.check(test, history, opts)

@@ -1,0 +1,279 @@
+"""Length-bucketed corpus scheduler — the batched-checking throughput engine.
+
+The batched entry points used to pad EVERY history in a corpus to the
+longest member's step count (wgl3.batch_steps3: one shared r_cap), so a
+corpus of mostly-short histories paid the long tail's padding on every
+lane, and every distinct corpus shape compiled its own kernel. This
+module is the scheduler PR 1's step-padding gauge argued for:
+
+  * histories are grouped into {2^k, 1.5*2^k} PADDED-LENGTH BUCKETS of
+    their return-step counts (floor tunable via
+    limits().step_bucket_floor), bounding per-bucket padding waste to
+    <1.5x and capping distinct jit compilations per kernel to the bucket
+    count;
+  * the batch axis is bucketed too (all-pad histories, stripped from
+    results), so corpora of varying size reuse the same compiled shapes;
+  * launches are dispatched ASYNC and fetched at drain: while the device
+    runs bucket N, the host stacks/transfers bucket N+1 (the corpus-level
+    face of the double-buffered chunk pipelining in ops/wgl2+wgl3);
+  * resolved checker callables live in the sched kernel LRU
+    (compile_cache.py) keyed by (kernel, model, bucket-shape), with
+    hit/miss accounting behind the bench's cache_hit_rate field.
+
+Verdicts are bit-identical to the unbatched path: bucket pads are
+all-pad scan steps (targets = -1) that the kernels skip by construction,
+and batch pads are all-pad histories stripped before assembly
+(tests/test_sched.py pins equivalence on golden + fuzz corpora).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from .. import obs
+from ..ops.limits import limits
+from .compile_cache import kernel_cache
+
+
+def assign_step_buckets(step_counts: Sequence[int]) -> list[int]:
+    """Padded-length bucket per entry — a pure function of the counts and
+    the active limits profile, so bucket assignment is deterministic and
+    order-independent (same count -> same bucket wherever it sits in the
+    corpus)."""
+    from ..ops import wgl3
+
+    floor = limits().step_bucket_floor
+    return [wgl3.step_bucket(int(n), floor=floor) for n in step_counts]
+
+
+def _batch_bucket(n: int, cap: int, multiple: int) -> int:
+    """Batch-axis bucket: {2^k, 1.5*2^k} growth from the batch floor,
+    capped by the launch-size cap, then rounded up to the sharding
+    multiple (device count x pallas group where the grouped kernel will
+    run)."""
+    from ..ops import wgl3
+
+    b = min(wgl3.step_bucket(n, floor=limits().batch_bucket_floor), cap)
+    b = max(b, n)
+    return (b + multiple - 1) // multiple * multiple
+
+
+def _pad_rs(k_slots: int):
+    """An all-pad (0-step) ReturnSteps history for batch-axis padding:
+    every step a pad, trivially valid, zero search work."""
+    from ..ops.encode import ReturnSteps
+
+    return ReturnSteps(
+        slot_tabs=np.zeros((0, k_slots, 4), np.int32),
+        slot_active=np.zeros((0, k_slots), bool),
+        targets=np.zeros((0,), np.int32),
+        n_steps=0, n_ops=0, k_slots=k_slots, max_pending=0, max_value=0)
+
+
+def _dense_bucket_launcher(model, cfg, b: int, r: int):
+    """Resolved packed checker for one (batch, step) bucket shape, from
+    the sched kernel LRU: run(tabs, act, tgt) -> DEVICE i32[b, 5]
+    (wgl3.PACKED_FIELDS). Returns (run, kernel_name)."""
+    import jax
+
+    mkey = model.cache_key()
+    if jax.device_count() > 1 and b > 1:
+        key = ("sched-dense-sharded", mkey, cfg, b, r)
+
+        def build():
+            from ..parallel.dense import (batch_mesh,
+                                          sharded_packed_batch_checker)
+
+            mesh = batch_mesh()
+            return sharded_packed_batch_checker(model, cfg, mesh,
+                                                n_steps=r, batch=b)
+
+        return kernel_cache().get(key, build)
+    key = ("sched-dense", mkey, cfg, b, r)
+
+    def build():
+        from ..ops.wgl3_pallas import packed_batch_checker
+
+        return packed_batch_checker(model, cfg, n_steps=r, batch=b)
+
+    return kernel_cache().get(key, build)
+
+
+def _launch_multiple(model, cfg, b: int, r: int) -> int:
+    """The [B]-axis multiple a launch of this shape must pad to."""
+    import jax
+
+    if jax.device_count() > 1 and b > 1:
+        from ..parallel.dense import batch_mesh, batch_multiple
+
+        return batch_multiple(model, cfg, batch_mesh(), n_steps=r, batch=b)
+    return 1
+
+
+class _Stats:
+    def __init__(self):
+        self.steps_real = 0
+        self.steps_padded = 0
+        self.launches = 0
+        self.buckets: dict[int, int] = {}
+
+    def record_launch(self, real: int, b: int, r: int) -> None:
+        padded = b * r
+        self.steps_real += real
+        self.steps_padded += padded
+        self.launches += 1
+        self.buckets[r] = self.buckets.get(r, 0) + 1
+        m = obs.get_metrics()
+        m.counter("sched.steps_real").add(real)
+        m.counter("sched.steps_padded").add(padded)
+        m.counter("sched.launches").add(1)
+        if real:
+            m.gauge("sched.padding_waste_ratio").set(padded / real)
+
+    def to_dict(self) -> dict:
+        out = {
+            "launches": self.launches,
+            "buckets": sorted(self.buckets.items()),
+            "steps_real": self.steps_real,
+            "steps_padded": self.steps_padded,
+            "padding_waste": (round(self.steps_padded / self.steps_real, 4)
+                              if self.steps_real else 0.0),
+        }
+        return out
+
+
+def check_corpus(encs: Sequence, model=None, f_cap: int = 256
+                 ) -> tuple[list[dict], str, dict]:
+    """Check a corpus of encoded histories through the bucketed scheduler;
+    returns (per-history results aligned with `encs`, kernel_name —
+    "mixed" when histories split across backends, stats dict).
+
+    Routing policy is SHARED with ops/wgl3_pallas.check_batch_encoded_auto
+    (partition_dense / run_long_dense / ladder_tail live there, in one
+    copy) — the scheduler only changes HOW the dense majority is padded
+    and launched, never which kernel checks what."""
+    from ..ops import wgl3, wgl3_pallas
+    from ..ops.encode import encode_return_steps, reslot_events
+
+    if model is None:
+        from ..models import CASRegister
+
+        model = CASRegister()
+    stats = _Stats()
+    if len(encs) <= 1:
+        # Single histories keep the auto router's full treatment (the
+        # oracle latency route included) — nothing to bucket.
+        results, kernel = wgl3_pallas.check_batch_encoded_auto(encs, model)
+        return results, kernel, stats.to_dict()
+
+    with obs.get_tracer().span("sched.check_corpus",
+                               histories=len(encs)) as sp:
+        results: list[Any] = [None] * len(encs)
+        kernels: set[str] = set()
+
+        dense_idx, general_idx = wgl3_pallas.partition_dense(encs, model)
+        cfg = None
+        if dense_idx:
+            k = max(wgl3.tight_k_slots(encs[i]) for i in dense_idx)
+            cfg = wgl3.dense_config(
+                model, k, max(encs[i].max_value for i in dense_idx))
+            if cfg is None:
+                # Individually feasible but not under one shared geometry:
+                # ladder each (rare extreme — same policy as the auto
+                # router).
+                general_idx = sorted(general_idx + dense_idx)
+                dense_idx = []
+
+        if dense_idx:
+            lim = limits()
+            steps_of: dict[int, Any] = {}
+            long_idx, short_idx = [], []
+            for i in dense_idx:
+                e = encs[i]
+                rs = encode_return_steps(
+                    reslot_events(e, k) if e.k_slots != k else e)
+                steps_of[i] = rs
+                (long_idx if rs.n_steps > lim.long_scan_max
+                 else short_idx).append(i)
+
+            # Long histories: host-chunked (now pipelined) sweeps, one at
+            # a time — arrays are never stacked.
+            for i in long_idx:
+                one = wgl3_pallas.run_long_dense(steps_of[i], model, cfg)
+                results[i] = one
+                kernels.add(one["kernel"])
+
+            # The bucketed batched lanes: group by padded step length,
+            # dispatch every launch async, fetch once at drain.
+            buckets: dict[int, list[int]] = {}
+            for i, r in zip(short_idx,
+                            assign_step_buckets(
+                                [steps_of[i].n_steps for i in short_idx])):
+                buckets.setdefault(r, []).append(i)
+            pending = []   # (idxs, part_steps, device_out)
+            for r in sorted(buckets):
+                idxs = buckets[r]
+                # Launch-size cap: stacked bytes for one launch stay
+                # inside the tested-good element budget.
+                per_hist = max(1, r * (cfg.k_slots * 5 + 1))
+                chunk = max(1, lim.stack_element_budget // per_hist)
+                for c0 in range(0, len(idxs), chunk):
+                    part = idxs[c0:c0 + chunk]
+                    part_steps = [steps_of[i] for i in part]
+                    mult = _launch_multiple(model, cfg, len(part), r)
+                    b = _batch_bucket(len(part), chunk, mult)
+                    run, name = _dense_bucket_launcher(model, cfg, b, r)
+                    padded = part_steps + [_pad_rs(k)] * (b - len(part))
+                    arrays = wgl3.stack_steps3(padded, r)
+                    pending.append((part, part_steps, run(*arrays)))
+                    stats.record_launch(
+                        sum(s.n_steps for s in part_steps), b, r)
+                    kernels.add(name)
+            for part, part_steps, dev in pending:
+                out = wgl3.unpack_np(np.asarray(dev)[:len(part)])
+                for i, one in zip(part, wgl3.assemble_batch_results(
+                        out, part_steps, cfg)):
+                    results[i] = one
+
+        if general_idx:
+            _check_general(encs, general_idx, model, results, kernels,
+                           f_cap)
+
+        sp.set(launches=stats.launches,
+               buckets=len(stats.buckets))
+        kernel = kernels.pop() if len(kernels) == 1 else (
+            "mixed" if kernels else "none")
+        return results, kernel, stats.to_dict()
+
+
+def _check_general(encs, general_idx, model, results, kernels,
+                   f_cap: int) -> None:
+    """The non-dense partition (wide pending sets / huge values): the
+    batched sort-kernel tiers, grouped by return-count bucket so a
+    corpus's short sort histories don't pad to its longest, then the
+    per-history exact ladder for whatever the tiers couldn't settle —
+    the same tail policy as check_batch_encoded_auto."""
+    from ..ops import wgl3_pallas
+    from ..ops.encode import EV_RETURN
+
+    def return_count(e) -> int:
+        ev = np.asarray(e.events[: e.n_events])
+        return int((ev[:, 0] == EV_RETURN).sum()) if e.n_events else 0
+
+    groups: dict[int, list[int]] = {}
+    for i, r in zip(general_idx,
+                    assign_step_buckets(
+                        [return_count(encs[i]) for i in general_idx])):
+        groups.setdefault(r, []).append(i)
+    overflow_seeds: list[tuple[int, int]] = []   # (idx, seed f_cap)
+    too_long_all: list[int] = []
+    for r in sorted(groups):
+        overflowed, too_long, top = wgl3_pallas._batch_general(
+            encs, groups[r], model, results, kernels, f_cap=f_cap)
+        overflow_seeds.extend(
+            (i, wgl3_pallas.LADDER_SEED_FACTOR * top) for i in overflowed)
+        too_long_all.extend(too_long)
+    wgl3_pallas.ladder_tail(encs, model, results, kernels, too_long_all,
+                            overflow_seeds)

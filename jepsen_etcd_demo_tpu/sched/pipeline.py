@@ -1,0 +1,77 @@
+"""Double-buffered host->device chunk pipelining primitives.
+
+The chunked sweeps (ops/wgl2.py check_steps_resumable, ops/wgl3.py
+check_steps3_long) used to serialize host prep, H2D transfer, device
+execution, and the per-chunk status fetch: the device sat idle while the
+host sliced/padded/transferred the next chunk, and the host sat idle
+while the device ran. These helpers overlap them:
+
+  * `double_buffer` stages (transfers) chunk N+1 while the caller is
+    still consuming chunk N — jax transfers are async, so the H2D enqueue
+    returns immediately and the copy proceeds while the device executes
+    the previous chunk's program.
+  * `InflightWindow` bounds speculative dispatch for loops that must
+    fetch a per-chunk flag (the sort sweep's overflow check): chunk N+1
+    is already dispatched when chunk N's flag is fetched, so the fetch
+    round trip hides under real work instead of stalling the device.
+
+Neither helper knows anything about the search; they move buffers and
+order operations only, so verdicts are bit-identical to the synchronous
+loops by construction.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+S = TypeVar("S")
+
+
+def double_buffer(items: Iterable[T], stage: Callable[[T], S]
+                  ) -> Iterator[S]:
+    """Yield stage(item) for each item, always staging one item AHEAD of
+    the one being yielded: when the caller dispatches work on chunk N,
+    chunk N+1's transfer is already enqueued. `stage` is typically a
+    jnp.asarray/device_put wrapper (async H2D)."""
+    prev: S | None = None
+    have_prev = False
+    for x in items:
+        cur = stage(x)
+        if have_prev:
+            yield prev
+        prev = cur
+        have_prev = True
+    if have_prev:
+        yield prev
+
+
+class InflightWindow:
+    """Bounded queue of dispatched-but-unresolved chunks.
+
+    push() after dispatching a chunk; full() says when the caller must
+    resolve (fetch) the oldest entry before dispatching more; pop()
+    returns it. depth=1 degenerates to the fully synchronous loop."""
+
+    def __init__(self, depth: int):
+        self.depth = max(1, int(depth))
+        self._q: deque = deque()
+
+    def push(self, entry) -> None:
+        self._q.append(entry)
+
+    def full(self) -> bool:
+        return len(self._q) >= self.depth
+
+    def pop(self):
+        return self._q.popleft()
+
+    def clear(self) -> None:
+        self._q.clear()
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def __bool__(self) -> bool:
+        return bool(self._q)

@@ -1,0 +1,129 @@
+"""Content-addressed encoded-tensor cache (ISSUE 2 satellite).
+
+`analyze` / `corpus` replays re-encode the same stored histories on every
+invocation; for a big store the host encode dominates the warm path the
+compile cache just made cheap. This cache persists the encoder's OUTPUT —
+the padded int32 event tensor — keyed by a sha256 over the encoder's
+INPUT (the translated op sequence's (type, f, value, process) fields,
+the model name, and the requested slot width), so an unchanged history
+loads its tensor instead of re-pairing/re-encoding.
+
+The cache is OFF unless activated (the CLI activates it for `analyze` /
+`corpus`, with `--no-encode-cache` as the escape hatch); library callers
+pay one module-global read. Entries are plain npz files
+(EncodedHistory.to_arrays) written atomically, safe under concurrent
+replays. A hash is a pure function of the encoder's observable input, so
+a cache hit is bit-identical to a fresh encode; corrupt/unreadable
+entries fall through to a re-encode, never an error.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..obs import get_metrics
+from ..ops.encode import ENCODING_VERSION, EncodedHistory
+
+CACHE_DIRNAME = ".encode-cache"
+
+_active_root: Optional[Path] = None
+_refresh: bool = False
+
+
+def activate(root: str | os.PathLike | None,
+             refresh: bool = False) -> tuple[Optional[Path], bool]:
+    """Point the cache at `root` (created lazily); None deactivates.
+    `refresh=True` bypasses lookups but still writes entries — the
+    `--reencode` contract: re-encode everything from source AND replace
+    whatever the cache held. Returns the previous (root, refresh) so
+    callers can restore them."""
+    global _active_root, _refresh
+    prev = (_active_root, _refresh)
+    _active_root = Path(root) if root is not None else None
+    _refresh = bool(refresh)
+    return prev
+
+
+def active_root() -> Optional[Path]:
+    return _active_root
+
+
+@contextmanager
+def activated(root: str | os.PathLike | None,
+              refresh: bool = False) -> Iterator[None]:
+    prev_root, prev_refresh = activate(root, refresh)
+    try:
+        yield
+    finally:
+        activate(prev_root, prev_refresh)
+
+
+def history_fingerprint(history: Sequence, model_name: str,
+                        k_slots: int) -> str:
+    """sha256 over exactly the fields the encoder consumes (encode.py
+    pair_history: type, f, value, process — time/index never reach the
+    tensors), plus the codec (model), requested slot width, and the
+    encoder version (an encoder fix invalidates every entry)."""
+    h = hashlib.sha256()
+    h.update(f"v{ENCODING_VERSION}|{model_name}|{k_slots}".encode())
+    for op in history:
+        h.update(
+            f"\n{op.type}|{op.f}|{op.value!r}|{op.process!r}".encode())
+    return h.hexdigest()
+
+
+def _entry_path(fingerprint: str) -> Optional[Path]:
+    if _active_root is None:
+        return None
+    return _active_root / f"{fingerprint}.npz"
+
+
+def lookup(history: Sequence, model_name: str,
+           k_slots: int) -> Optional[EncodedHistory]:
+    """Cached EncodedHistory for this (history, model, k_slots), or None
+    (cache inactive, refresh mode, miss, or unreadable entry)."""
+    if _refresh:
+        return None
+    path = _entry_path(history_fingerprint(history, model_name, k_slots))
+    if path is None:
+        return None
+    m = get_metrics()
+    try:
+        with np.load(path) as z:
+            enc = EncodedHistory.from_arrays(z)
+    except Exception:   # missing or torn entry: re-encode, never fail
+        m.counter("encode.cache_misses").add(1)
+        return None
+    m.counter("encode.cache_hits").add(1)
+    return enc
+
+
+def store(history: Sequence, model_name: str, k_slots: int,
+          enc: EncodedHistory) -> None:
+    """Persist an encoding under its input fingerprint (atomic replace:
+    concurrent replays of the same store race benignly)."""
+    path = _entry_path(history_fingerprint(history, model_name, k_slots))
+    if path is None:
+        return
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".npz")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez_compressed(f, **enc.to_arrays())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except OSError:
+        pass   # the cache is an optimization, never a failure mode

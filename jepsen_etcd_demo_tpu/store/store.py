@@ -136,11 +136,7 @@ def read_encoded_tensors(store_dir, model_name: str):
                     return []
                 name = path.stem
                 key = name[len("history-"):] if "-" in name else None
-                out.append((key, EncodedHistory(
-                    events=z["events"], n_events=int(z["events"].shape[0]),
-                    n_ops=int(z["n_ops"]), k_slots=int(z["k_slots"]),
-                    max_pending=int(z["max_pending"]),
-                    max_value=int(z["max_value"]))))
+                out.append((key, EncodedHistory.from_arrays(z)))
         except Exception:
             return []
     return out
@@ -159,7 +155,6 @@ def write_encoded_tensor(store_dir, key, enc, model_name: str) -> None:
     name = "history" if key is None else f"history-{key}"
     if (Path(store_dir) / f"{name}.npz").exists():
         return
+    arrays = enc.to_arrays()
     RunDir(store_dir).write_history_tensor(
-        name, np.asarray(enc.events[: enc.n_events]),
-        k_slots=enc.k_slots, n_ops=enc.n_ops, max_pending=enc.max_pending,
-        max_value=enc.max_value, model=model_name)
+        name, arrays.pop("events"), model=model_name, **arrays)

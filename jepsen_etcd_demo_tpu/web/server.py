@@ -48,6 +48,30 @@ def _run_summary(results: dict) -> str:
     return "; ".join(str(b) for b in bits[:4])
 
 
+def _check_perf_columns(run) -> tuple[str, str]:
+    """(throughput, padding-waste) columns for the run index, from the
+    run's metrics.json (obs/): check throughput = encoded history events
+    over the kernels' compile+execute wall, padding waste = the last
+    launch's padded/real step ratio (wgl3._record_padding). Blank when
+    the run has no telemetry or never launched a kernel."""
+    try:
+        metrics = read_metrics(run.path / METRICS_FILE)
+    except Exception:
+        return "", ""
+
+    def counter(name: str) -> float:
+        rec = metrics.get(name) or {}
+        return float(rec.get("value", 0.0)) \
+            if rec.get("type") == "counter" else 0.0
+
+    events = counter("encode.event_bytes") / 24.0   # 6 int32 per event
+    kernel_s = counter("wgl.compile_s") + counter("wgl.execute_s")
+    eps = f"{events / kernel_s:,.0f}/s" if events and kernel_s else ""
+    ratio = (metrics.get("wgl.step_padding_ratio") or {}).get("last")
+    waste = f"{ratio:.2f}x" if isinstance(ratio, (int, float)) else ""
+    return eps, waste
+
+
 def _index_html(store: Store) -> str:
     rows = []
     for run in reversed(store.runs()):
@@ -67,11 +91,14 @@ def _index_html(store: Store) -> str:
         if (run.path / TELEMETRY_FILE).exists():
             thref = urllib.parse.quote(f"/telemetry/{rel}")
             tele = f"<a href='{thref}'>telemetry</a>"
+        eps, waste = _check_perf_columns(run)
         rows.append(
             f"<tr><td><a href='{href}'>"
             f"{html.escape(str(rel))}</a></td>"
             f"<td style='color:{color};font-weight:bold'>{valid}</td>"
             f"<td style='color:#666'>{html.escape(summary)}</td>"
+            f"<td>{html.escape(eps)}</td>"
+            f"<td>{html.escape(waste)}</td>"
             f"<td>{tele}</td></tr>")
     return (
         "<!doctype html><html><head><meta charset='utf-8'>"
@@ -79,6 +106,7 @@ def _index_html(store: Store) -> str:
         "<style>body{font-family:sans-serif}td{padding:4px 12px}</style>"
         "</head><body><h2>test runs</h2>"
         f"<table><tr><th>run</th><th>valid</th><th>detail</th>"
+        f"<th>check eps</th><th>pad waste</th>"
         f"<th>obs</th></tr>"
         f"{''.join(rows)}</table>"
         "</body></html>")
