@@ -130,6 +130,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "(FIFO violation)")
     t.add_argument("--duplicate-delivery-prob", type=float, default=0.0,
                    help="[fake] queue dequeues deliver without removing")
+    _add_sweep_mode_flag(t)
 
     a = sub.add_parser("analyze", help="re-check a stored history")
     a.add_argument("run_dir", help="store/<name>/<ts> directory")
@@ -143,6 +144,7 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--no-encode-cache", action="store_true",
                    help="disable the content-addressed encoded-tensor "
                         "cache (re-encode from history.jsonl every time)")
+    _add_sweep_mode_flag(a)
 
     c = sub.add_parser(
         "corpus",
@@ -170,12 +172,40 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--local-devices", type=int, default=None,
                    help="simulate with N virtual CPU devices per process "
                         "(CI / one-machine dryrun)")
+    _add_sweep_mode_flag(c)
 
     s = sub.add_parser("serve", help="serve the results store over http")
     s.add_argument("--port", type=int, default=8080)
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--store", default="store")
     return p
+
+
+# --sweep-mode values -> limits().sparse_mode (ops/limits.py): the
+# sparse active-tile engine's dense/sparse routing for the dense lattice
+# kernels (ops/wgl3_sparse.py; doc/perf.md "Sparse sweeps").
+SWEEP_MODES = {"auto": 0, "dense": 1, "sparse": 2}
+
+
+def _add_sweep_mode_flag(parser) -> None:
+    parser.add_argument(
+        "--sweep-mode", default=None, choices=sorted(SWEEP_MODES),
+        help="dense-lattice sweep engine: auto = sparse active-tile "
+             "sweeps on eligible geometries with the density-threshold "
+             "crossover (default), dense = sparse engine off, sparse = "
+             "prefer sparse rounds regardless of density (the bench/"
+             "debug lane). Verdicts are bit-identical in every mode.")
+
+
+def _apply_sweep_mode(args) -> None:
+    mode = getattr(args, "sweep_mode", None)
+    if mode is None:
+        return
+    from dataclasses import replace
+
+    from ..ops.limits import limits, set_limits
+
+    set_limits(replace(limits(), sparse_mode=SWEEP_MODES[mode]))
 
 
 def _read_nodes(args) -> list[str]:
@@ -214,6 +244,7 @@ def _test_opts(args) -> dict:
 
 def cmd_test(args) -> int:
     enable_compilation_cache(args.store)
+    _apply_sweep_mode(args)
     rc = 0
     for i in range(args.test_count):
         opts = _test_opts(args)
@@ -237,6 +268,7 @@ def cmd_analyze(args) -> int:
     from ..checkers.perf import PerfChecker
 
     enable_compilation_cache()
+    _apply_sweep_mode(args)
     run = RunDir(args.run_dir)
     history = run.read_history()
     try:
@@ -335,6 +367,7 @@ def cmd_corpus(args) -> int:
     from ..store.store import Store
 
     enable_compilation_cache(args.store_root)
+    _apply_sweep_mode(args)
     # --reencode means "re-encode from source" — it must bypass cache
     # LOOKUPS too (an encoder fix is its stated purpose), while still
     # refreshing the entries for later replays.
@@ -422,7 +455,8 @@ def _cmd_corpus_checked(args, multislice: bool) -> int:
         return 0
     t0 = time.perf_counter()
     invalid, kernels, n_keys = [], set(), 0
-    sched_stats = {"launches": 0, "steps_real": 0, "steps_padded": 0}
+    sched_stats = {"launches": 0, "steps_real": 0, "steps_padded": 0,
+                   "sweep_steps_sparse": 0, "sweep_steps_dense": 0}
     for model_name, entries in sorted(by_model.items()):
         model = Linearizable(model=model_name).model
         if multislice:
@@ -436,7 +470,8 @@ def _cmd_corpus_checked(args, multislice: bool) -> int:
         else:
             results, kernel, stats = sched.check_corpus(
                 [e[2] for e in entries], model)
-            for f in ("launches", "steps_real", "steps_padded"):
+            for f in ("launches", "steps_real", "steps_padded",
+                      "sweep_steps_sparse", "sweep_steps_dense"):
                 sched_stats[f] += stats.get(f, 0)
         kernels.add(kernel)
         n_keys += len(entries)
@@ -460,6 +495,10 @@ def _cmd_corpus_checked(args, multislice: bool) -> int:
             if sched_stats["steps_real"] else 0.0)
         out["cache_hit_rate"] = round(
             sched.kernel_cache().stats()["hit_rate"], 4)
+        # Sparse-sweep exposure (doc/perf.md "Sparse sweeps"): how many
+        # long-sweep steps the corpus pass ran in each mode.
+        out["sweep_steps_sparse"] = sched_stats["sweep_steps_sparse"]
+        out["sweep_steps_dense"] = sched_stats["sweep_steps_dense"]
     if multislice:
         import jax
 

@@ -66,6 +66,14 @@ PHASE_GAUGE = "wgl.frontier_peak"
 SCHED_COUNTERS = ("sched.steps_real", "sched.steps_padded",
                   "sched.cache_hits", "sched.cache_misses",
                   "encode.cache_hits", "encode.cache_misses")
+# Sparse active-tile sweep engine (ops/wgl3_sparse.py) accounting:
+# per-mode step counters plus the live-tile occupancy gauge — pre-
+# registered so every dense-kernel run's metrics.json carries them
+# (zeros permitted, never absent; the web UI renders both).
+SWEEP_COUNTERS = ("wgl.sweep_steps_sparse", "wgl.sweep_steps_dense",
+                  "wgl.sweep_checks_sparse", "wgl.sweep_checks_dense",
+                  "wgl.sweep_checks_mixed")
+SWEEP_GAUGE = "wgl.live_tile_ratio"
 
 _NULL_TRACER = Tracer(enabled=False)
 _NULL_METRICS = MetricsRegistry(enabled=False)
@@ -82,9 +90,10 @@ class Capture:
         self.tracer = Tracer(enabled=enabled)
         self.metrics = MetricsRegistry(enabled=enabled)
         if enabled:
-            for name in PHASE_COUNTERS + SCHED_COUNTERS:
+            for name in PHASE_COUNTERS + SCHED_COUNTERS + SWEEP_COUNTERS:
                 self.metrics.counter(name)
             self.metrics.gauge(PHASE_GAUGE)
+            self.metrics.gauge(SWEEP_GAUGE)
 
     def write(self) -> None:
         if not self.enabled or self.out_dir is None:
@@ -199,6 +208,32 @@ def record_check_result(res: dict) -> None:
         cfgs = 0.0
     if cfgs > 0:
         m.counter("wgl.configs_explored").add(cfgs)
+    # Sparse-sweep telemetry (ops/wgl3_sparse.py): live-tile occupancy
+    # of the converged tables and which sweep mode the steps ran under.
+    # Batched launches report the occupancy column but always sweep
+    # dense; the long sweeps report exact per-mode step counts.
+    try:
+        ratio = float(res.get("live_tile_ratio"))
+    except (TypeError, ValueError):
+        ratio = -1.0
+    if ratio >= 0:
+        m.gauge(SWEEP_GAUGE).set(ratio)
+    sweep = res.get("sweep")
+    if isinstance(sweep, dict):
+        mode = sweep.get("mode")
+        if mode in ("sparse", "dense", "mixed"):
+            m.counter(f"wgl.sweep_checks_{mode}").add(1)
+        for key in ("steps_sparse", "steps_dense"):
+            try:
+                v = int(sweep.get(key, 0))
+            except (TypeError, ValueError):
+                v = 0
+            if v > 0:
+                m.counter(f"wgl.sweep_{key}").add(v)
+    elif ratio >= 0:
+        # A dense batched launch: no sweep record, but the measured
+        # occupancy proves it ran the dense kernels.
+        m.counter("wgl.sweep_checks_dense").add(1)
 
 
 def kernel_phases(metrics: Optional[MetricsRegistry] = None) -> dict:
@@ -249,6 +284,33 @@ def sched_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
     lookups = hits + counter_value("sched.cache_misses")
     if lookups:
         out["cache_hit_rate"] = round(hits / lookups, 4)
+    return out
+
+
+def sweep_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
+    """The sparse-sweep engine's bench/web contract fields, from a
+    registry snapshot: the live-tile-ratio gauge (last/min/max) and the
+    per-mode step/check counters. Zeros when no registry / no dense runs
+    — the contract is "zeros permitted, never absent"."""
+    out = {"live_tile_ratio": 0.0, "steps_sparse": 0, "steps_dense": 0,
+           "checks_sparse": 0, "checks_dense": 0, "checks_mixed": 0}
+    if metrics is None or not metrics.enabled:
+        return out
+    snap = metrics.snapshot()
+
+    def counter_value(key: str) -> int:
+        rec = snap.get(key)
+        return int(rec["value"]) if rec \
+            and rec.get("type") == "counter" else 0
+
+    out["steps_sparse"] = counter_value("wgl.sweep_steps_sparse")
+    out["steps_dense"] = counter_value("wgl.sweep_steps_dense")
+    out["checks_sparse"] = counter_value("wgl.sweep_checks_sparse")
+    out["checks_dense"] = counter_value("wgl.sweep_checks_dense")
+    out["checks_mixed"] = counter_value("wgl.sweep_checks_mixed")
+    g = snap.get(SWEEP_GAUGE)
+    if g and g.get("last") is not None:
+        out["live_tile_ratio"] = round(float(g["last"]), 4)
     return out
 
 

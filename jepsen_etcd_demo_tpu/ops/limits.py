@@ -111,6 +111,44 @@ class KernelLimits:
     # [arch] Entry capacity of the scheduler's in-process kernel LRU
     # (sched/compile_cache.py, keyed by (kernel, model, bucket shape)).
     kernel_cache_entries: int = 256
+    # [arch] Words of the packed table per occupancy tile of the sparse
+    # active-tile sweep engine (ops/wgl3_sparse.py). Power of two; one
+    # tile is TILE*32 configs per state row. 8 words (256 configs/state)
+    # keeps the occupancy bitmap tiny (W/8 bits) while a gathered tile
+    # is still a meaningful vector width.
+    sparse_tile_words: int = 8
+    # [arch] Live-tile density (percent of tiles occupied) above which a
+    # closure round runs the DENSE sweep instead of gather->expand->
+    # scatter — the direction-optimizing switch (Beamer et al., SC'12):
+    # past ~1/4 occupancy the gather/scatter overhead exceeds the work
+    # skipped. Applies per round, so a frontier that fills up mid-step
+    # crosses over mid-sweep (and back) with no host involvement.
+    sparse_density_threshold_pct: int = 25
+    # [arch] Static capacity (in tiles) of the sparse engine's gather
+    # work list. XLA shapes are static, so the gathered frontier is
+    # padded to this many tiles; a round whose live-tile count exceeds
+    # it falls back to the dense sweep for that round (never drops
+    # configs). Per-round sparse cost is O(cap * tile_words), so the
+    # cap bounds worst-case sparse work regardless of K.
+    sparse_worklist_cap: int = 512
+    # [arch] Minimum tile count (W / sparse_tile_words) before the
+    # sparse engine engages in AUTO mode: below the crossover the dense
+    # sweep's straight-line vector code beats the gather/nonzero/scatter
+    # overhead even at <1% occupancy. MEASURED on the CPU backend
+    # (bench.py sparse lane, long register history, warm): K=16 0.62x,
+    # K=18 0.78x, K=20 2.33x sparse-vs-dense — so the default engages at
+    # K >= 19 (2048 tiles at the default 8-word tile). A TPU's VPU
+    # widens the dense side's advantage, so raising this on real
+    # hardware is the conservative direction; sparse_mode=2 forces the
+    # engine on regardless for measurement.
+    sparse_min_tiles: int = 2048
+    # [arch] Sweep-mode override for the dense lattice kernels:
+    # 0 = auto (sparse engine on eligible geometries, per-round density
+    # switch), 1 = dense-only (sparse engine off), 2 = prefer-sparse
+    # (density threshold ignored; the work-list capacity still forces
+    # dense rounds on overflow — configs are never dropped). 2 is the
+    # bench/test lane for exercising the sparse path deterministically.
+    sparse_mode: int = 0
 
 
 def _from_env() -> KernelLimits:

@@ -448,8 +448,15 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
                 and _time.monotonic() - t0 > time_budget_s):
             raise SearchBudgetExceeded(
                 f"WGL search exceeded its {time_budget_s:.0f}s time "
-                f"budget at return step {c0} (f_cap={f_cap}); the "
-                f"frontier is growing combinatorially")
+                f"budget at return step {c0} (chunk boundary "
+                f"{c0 // chunk} of {len(chunk_starts)}, chunk={chunk}; "
+                f"f_cap={f_cap} of f_cap_max={f_cap_max}, "
+                f"escalations={escalations}); the frontier is growing "
+                f"combinatorially. Raise the budget (--check-budget-s / "
+                f"the caller's time_budget_s; 0 = unbounded) to search "
+                f"longer, or raise limits().sort_row_budget "
+                f"(JEPSEN_TPU_LIMIT_SORT_ROW_BUDGET) on a roomier "
+                f"backend so capacity escalations go further per chunk")
 
     def dispatch(c0: int, pre: _Carry2) -> _Carry2:
         sl = slice(c0, c0 + chunk)
@@ -480,7 +487,13 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
                 if f_cap > f_cap_max:
                     raise MemoryError(
                         f"WGL frontier exceeds f_cap_max={f_cap_max} at "
-                        f"return step {c0}; history needs the dense "
+                        f"return step {c0} (chunk boundary {c0 // chunk} "
+                        f"of {len(chunk_starts)}, chunk={chunk}; "
+                        f"escalations={escalations}). Raise "
+                        f"limits().sort_row_budget "
+                        f"(JEPSEN_TPU_LIMIT_SORT_ROW_BUDGET, currently "
+                        f"{limits().sort_row_budget}) to permit a larger "
+                        f"f_cap_max, or let the router take the dense "
                         f"sweep — chunked (ops/wgl3.py) or "
                         f"lattice-sharded (parallel/lattice.py)")
                 cfg = config_for(rs, model, f_cap)

@@ -115,8 +115,20 @@ _LO_MASK = tuple(
     for j in range(5))
 
 
-def make_step_fn3(model: Model, cfg: DenseConfig):
-    """Scan body over the bit-packed table.
+class _TableOps(NamedTuple):
+    """The bit-algebra building blocks of the dense lattice sweep, shared
+    by the dense step fn (make_step_fn3), the sparse active-tile engine
+    (ops/wgl3_sparse.py), and the lattice-sharded form (parallel/
+    lattice.py builds its own shard-local variants of the same ops)."""
+    allowed_mask: Any       # t -> u32[W] (mask-bit-t-CLEAR positions)
+    or_reduce: Any          # ([S,S'] trans, u32[S,...]) -> u32[S,...]
+    transitions: Any        # (slot_tab[K,4], slot_active[K]) -> [K,S,S']
+    dense_sweep: Any        # (T, allowed, trans) -> T — one G-S round
+    prune: Any              # (T, t, allowed) -> pruned table
+
+
+def table_ops(model: Model, cfg: DenseConfig) -> _TableOps:
+    """Build the per-geometry table operations (see _TableOps).
 
     The mask axis is packed 32 configs/word: masks' low 5 bits index bits
     inside a uint32, the high K-5 bits index words. Every set operation
@@ -168,6 +180,71 @@ def make_step_fn3(model: Model, cfg: DenseConfig):
         return (ok[:, :, None]
                 & (nxt_row[:, :, None] == s_ids[None, None, :]))
 
+    def dense_sweep(T, allowed, trans):
+        """One Gauss-Seidel sweep: fire each slot once, updating T in
+        place so same-round chains propagate. Static python loop — K is
+        small and each j needs its own static bit/word addressing."""
+        for j in range(K):
+            src = T & allowed[None, :]
+            if j < 5:
+                fired = or_reduce(trans[j], src & _LO_MASK[j])
+                T = T | (fired << np.uint32(1 << j))
+            else:
+                lo_w, hi = 1 << (j - 5), W >> (j - 4)
+                Tr = T.reshape(S, hi, 2, lo_w)
+                srcj = src.reshape(S, hi, 2, lo_w)[:, :, 0, :]
+                fired = or_reduce(trans[j], srcj)
+                T = jnp.stack([Tr[:, :, 0, :], Tr[:, :, 1, :] | fired],
+                              axis=2).reshape(S, W)
+        return T
+
+    def prune(T, t, allowed):
+        """Keep configs that linearized the target, re-addressed with its
+        bit cleared. t<5: in-word shift down; t>=5: word gather."""
+        shift = jnp.where(t < 5, jnp.uint32(1) << jnp.minimum(
+            t.astype(jnp.uint32), jnp.uint32(4)), jnp.uint32(0))
+        wsel = jnp.where(t < 5, w_idx,
+                         w_idx | (jnp.int32(1) << jnp.maximum(t - 5, 0)))
+        return (T[:, wsel] >> shift) & allowed[None, :]
+
+    return _TableOps(allowed_mask=allowed_mask, or_reduce=or_reduce,
+                     transitions=transitions, dense_sweep=dense_sweep,
+                     prune=prune)
+
+
+def live_tile_geometry(cfg: DenseConfig,
+                       words: int | None = None) -> tuple[int, int]:
+    """(tile_words, n_tiles) of the occupancy tiling for this geometry:
+    limits().sparse_tile_words clamped (and rounded down to a power of
+    two) against the table width — `words` overrides the width for
+    SHARDED tables (per-device word count). THE single copy of the
+    tiling policy: the sparse engine (ops/wgl3_sparse.sparse_plan), the
+    lattice shard tiling (parallel/lattice.py), and the live-tile-ratio
+    telemetry all derive from here, so the gauge's denominator and the
+    sweep's actual work unit cannot disagree."""
+    w = words if words is not None else (1 << (cfg.k_slots - 5))
+    tile = max(1, min(limits().sparse_tile_words, w))
+    if tile & (tile - 1):
+        tile = 1 << (tile.bit_length() - 1)
+    return tile, w // tile
+
+
+def make_step_fn3(model: Model, cfg: DenseConfig):
+    """Scan body over the bit-packed table (see table_ops for the bit
+    algebra). Each step additionally emits the converged table's live-
+    TILE count (occupancy over live_tile_geometry tiles) — the telemetry
+    behind the wgl.live_tile_ratio gauge and the sparse engine's density
+    signal (ops/wgl3_sparse.py); one O(S*W) reduce per step, ~1/K of a
+    single sweep's cost."""
+    ops = table_ops(model, cfg)
+    allowed_mask, transitions = ops.allowed_mask, ops.transitions
+    tile, n_tiles = live_tile_geometry(cfg)
+
+    def live_tiles(T):
+        any_w = jnp.any(T != jnp.uint32(0), axis=0)
+        return jnp.sum(jnp.any(any_w.reshape(n_tiles, tile), axis=1),
+                       dtype=jnp.int32)
+
     def step(carry: _Carry3, xs):
         trans, target, idx = xs
         is_pad = target < 0
@@ -179,21 +256,7 @@ def make_step_fn3(model: Model, cfg: DenseConfig):
 
         def body(st):
             T, n_prev, _changed, rounds = st
-            # Gauss-Seidel sweep: fire each slot once, updating T in place
-            # so same-round chains propagate. Static python loop — K is
-            # small and each j needs its own static bit/word addressing.
-            for j in range(K):
-                src = T & allowed[None, :]
-                if j < 5:
-                    fired = or_reduce(trans[j], src & _LO_MASK[j])
-                    T = T | (fired << np.uint32(1 << j))
-                else:
-                    lo_w, hi = 1 << (j - 5), W >> (j - 4)
-                    Tr = T.reshape(S, hi, 2, lo_w)
-                    srcj = src.reshape(S, hi, 2, lo_w)[:, :, 0, :]
-                    fired = or_reduce(trans[j], srcj)
-                    T = jnp.stack([Tr[:, :, 0, :], Tr[:, :, 1, :] | fired],
-                                  axis=2).reshape(S, W)
+            T = ops.dense_sweep(T, allowed, trans)
             n_now = jnp.sum(jax.lax.population_count(T), dtype=jnp.int32)
             return T, n_now, n_now > n_prev, rounds + 1
 
@@ -204,13 +267,8 @@ def make_step_fn3(model: Model, cfg: DenseConfig):
         T, n, _c, _r = jax.lax.while_loop(
             cond, body, (carry.table, n0, ~is_pad, jnp.int32(0)))
 
-        # Prune: keep configs that linearized the target, re-addressed with
-        # its bit cleared. t<5: in-word shift down; t>=5: word gather.
-        shift = jnp.where(t < 5, jnp.uint32(1) << jnp.minimum(
-            t.astype(jnp.uint32), jnp.uint32(4)), jnp.uint32(0))
-        wsel = jnp.where(t < 5, w_idx,
-                         w_idx | (jnp.int32(1) << jnp.maximum(t - 5, 0)))
-        pruned = (T[:, wsel] >> shift) & allowed[None, :]
+        live = live_tiles(T)
+        pruned = ops.prune(T, t, allowed)
         T_new = jnp.where(is_pad, T, pruned)
         alive = jnp.any(T_new != 0)
         died = ~is_pad & ~carry.dead & ~alive
@@ -220,11 +278,13 @@ def make_step_fn3(model: Model, cfg: DenseConfig):
             table=T_new, dead=dead,
             dead_step=jnp.where(died & (carry.dead_step < 0), idx,
                                 carry.dead_step),
-            max_frontier=jnp.maximum(carry.max_frontier, n)), jnp.where(
-                is_pad, 0, n)  # pads do no search work: keep the
-        #                        configs-explored metric padding-invariant
-        #                        (scan buckets here, chunk alignment in the
-        #                        pallas kernel — both must agree exactly)
+            max_frontier=jnp.maximum(carry.max_frontier, n)), (
+                jnp.where(is_pad, 0, n),
+                jnp.where(is_pad, 0, live))
+        #       pads do no search work: keep the configs-explored and
+        #       live-tile metrics padding-invariant (scan buckets here,
+        #       chunk alignment in the pallas kernel — both must agree
+        #       exactly)
 
     return step, transitions
 
@@ -239,13 +299,25 @@ def _init_carry3(model: Model, cfg: DenseConfig) -> _Carry3:
 
 def _check_one_fn(model: Model, cfg: DenseConfig):
     step, transitions = make_step_fn3(model, cfg)
+    _, n_tiles = live_tile_geometry(cfg)
 
     def check(slot_tabs, slot_active, targets):
         carry = _init_carry3(model, cfg)
         idxs = jnp.arange(targets.shape[0], dtype=jnp.int32)
         trans_all = jax.vmap(transitions)(slot_tabs, slot_active)
-        final, ns = jax.lax.scan(
+        final, (ns, lives) = jax.lax.scan(
             step, carry, (trans_all, targets, idxs))
+        real = jnp.sum((targets >= 0).astype(jnp.int32))
+        # Mean live-tile occupancy over real steps, in per-mille (i32 so
+        # it packs with the verdict fields): the telemetry behind the
+        # wgl.live_tile_ratio gauge and the sparse engine's motivation —
+        # -1 when the history had no real steps.
+        live_pm = jnp.where(
+            real > 0,
+            (jnp.sum(lives.astype(jnp.float32)) * 1000.0
+             / (jnp.maximum(real, 1).astype(jnp.float32) * n_tiles)
+             ).astype(jnp.int32),
+            jnp.int32(-1))
         return {
             "survived": ~final.dead,
             # The dense table is the whole config space: exact by
@@ -259,6 +331,7 @@ def _check_one_fn(model: Model, cfg: DenseConfig):
             # over wall time). f32 accumulator: x64 is disabled under jit
             # and a throughput metric tolerates rounding past 2^24.
             "configs_explored": jnp.sum(ns.astype(jnp.float32)),
+            "live_tile_pm": live_pm,
         }
 
     return check
@@ -282,8 +355,13 @@ def _chunk_fn(model: Model, cfg: DenseConfig):
     def run(carry, tabs, act, tgts, idx0):
         trans = jax.vmap(transitions)(tabs, act)
         idxs = idx0 + jnp.arange(tgts.shape[0], dtype=jnp.int32)
-        carry, ns = jax.lax.scan(step, carry, (trans, tgts, idxs))
-        return carry, jnp.sum(ns.astype(jnp.float32))
+        carry, (ns, lives) = jax.lax.scan(step, carry, (trans, tgts, idxs))
+        # Partial sums accumulate device-side across chunks, fetched once
+        # at the end: [configs_explored, live-tile sum, real steps].
+        return carry, jnp.stack([
+            jnp.sum(ns.astype(jnp.float32)),
+            jnp.sum(lives.astype(jnp.float32)),
+            jnp.sum((tgts >= 0).astype(jnp.float32))])
 
     return jax.jit(run, donate_argnums=(0,))
 
@@ -309,12 +387,47 @@ def _cached_chunk_run(model: Model, cfg: DenseConfig, chunk: int):
     return _CACHE[key]
 
 
+def sweep_summary(cfg: DenseConfig, live_sum: float, real_steps: int,
+                  sparse_steps: int = 0,
+                  tiling: tuple[int, int] | None = None) -> dict:
+    """The per-run sweep-mode/occupancy record the long sweeps attach to
+    their result dicts (and record_check_result folds into the metrics
+    registry): which sweep mode the steps ran under and the mean live-
+    tile ratio of the converged tables. One copy shared by the dense and
+    sparse long sweeps (and the lattice-sharded form, which passes its
+    own (tile_words, global tile count) `tiling`) so the bench's
+    `sparse` lane and the telemetry artifact cannot drift apart."""
+    tile, n_tiles = tiling if tiling is not None else live_tile_geometry(cfg)
+    real = max(0, int(real_steps))
+    sparse = min(max(0, int(sparse_steps)), real)
+    dense = real - sparse
+    if real == 0 or sparse == 0:
+        mode = "dense"
+    elif dense == 0:
+        mode = "sparse"
+    else:
+        mode = "mixed"
+    ratio = (float(live_sum) / (real * n_tiles)) if real else 0.0
+    return {"mode": mode,
+            "live_tile_ratio": round(min(max(ratio, 0.0), 1.0), 4),
+            "steps_sparse": sparse, "steps_dense": dense,
+            "tiles": n_tiles, "tile_words": tile}
+
+
 def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
                       chunk: int | None = None,
                       time_budget_s: float | None = None) -> dict:
     """Single-history dense check for histories whose step count exceeds
     one scan program: pad to a chunk multiple, loop chunks host-side.
     Bit-identical to check_steps3 (same step fn; pads contribute nothing).
+
+    Geometries with enough occupancy tiles route to the sparse
+    active-tile engine (ops/wgl3_sparse.py — limits().sparse_mode gates
+    it): same chunked host loop, but each closure round gathers only the
+    LIVE tiles of the table and falls back to a dense sweep past the
+    density threshold, so per-step cost tracks the live frontier instead
+    of 2^K. Verdicts are bit-identical either way (the sparse round
+    reaches the same closure fixpoint).
 
     Chunk size scales inversely with table width so one chunk's wall time
     stays far under the axon worker's program-kill threshold (sweep cost
@@ -335,7 +448,12 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
     import time as _time
 
     from ..sched.pipeline import double_buffer
+    from .wgl3_sparse import check_steps3_long_sparse, sparse_plan
 
+    plan = sparse_plan(cfg)
+    if plan is not None:
+        return check_steps3_long_sparse(rs, model, cfg, plan, chunk=chunk,
+                                        time_budget_s=time_budget_s)
     t0 = _time.monotonic()
     if chunk is None:
         chunk = default_scan_chunk(cfg)
@@ -385,10 +503,12 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
                 break
     from .wgl import verdict
 
+    if cfgs_dev is None:
+        cfgs_dev = jnp.zeros((3,), jnp.float32)
     # One packed fetch at the end (chunks chain device-side).
-    packed = np.asarray(jnp.stack([
-        jnp.where(carry.dead, 0, 1),
-        carry.dead_step, carry.max_frontier,
+    packed = np.asarray(jnp.concatenate([
+        jnp.stack([jnp.where(carry.dead, 0, 1),
+                   carry.dead_step, carry.max_frontier]),
         jnp.clip(cfgs_dev, 0, 2**31 - 1).astype(jnp.int32)]))
     out = {
         "survived": bool(packed[0]),
@@ -397,6 +517,9 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
         "max_frontier": int(packed[2]),
         "configs_explored": int(packed[3]),
     }
+    out["sweep"] = sweep_summary(cfg, live_sum=float(packed[4]),
+                                 real_steps=int(packed[5]))
+    out["live_tile_ratio"] = out["sweep"]["live_tile_ratio"]
     out["valid"] = verdict(out)
     record_check_result(out)
     return out
@@ -458,22 +581,32 @@ def make_batch_checker3(model: Model, cfg: DenseConfig):
 
 PACKED_FIELDS = ("survived", "overflow", "dead_step", "max_frontier",
                  "configs_explored")
+# The XLA checkers append a 6th telemetry column: mean live-tile
+# occupancy in per-mille (live_tile_pm; -1 = not measured). The pallas
+# kernels keep the 5-column layout — unpack_np accepts both widths, so
+# the two backends' fetch contract stays one packed i32 tensor.
+PACKED_FIELDS_XLA = PACKED_FIELDS + ("live_tile_pm",)
 
 
 def _pack_result(out: dict) -> jax.Array:
     cfgs = jnp.clip(out["configs_explored"], 0, 2**31 - 1).astype(jnp.int32)
     return jnp.stack([out["survived"].astype(jnp.int32),
                       out["overflow"].astype(jnp.int32),
-                      out["dead_step"], out["max_frontier"], cfgs], axis=-1)
+                      out["dead_step"], out["max_frontier"], cfgs,
+                      out["live_tile_pm"]], axis=-1)
 
 
 def unpack_np(arr) -> dict:
-    """np i32[..., 5] (one fetch) -> result dict of np arrays/scalars."""
+    """np i32[..., 5|6] (one fetch) -> result dict of np arrays/scalars.
+    The 6th column (live_tile_pm), when present, is the XLA checkers'
+    occupancy telemetry; pallas launches emit 5 columns and report -1."""
     arr = np.asarray(arr)
     get_metrics().counter("wgl.d2h_bytes").add(int(arr.nbytes))
+    pm = (arr[..., 5] if arr.shape[-1] > 5
+          else np.full(arr.shape[:-1], -1, np.int32))
     return {"survived": arr[..., 0] != 0, "overflow": arr[..., 1] != 0,
             "dead_step": arr[..., 2], "max_frontier": arr[..., 3],
-            "configs_explored": arr[..., 4]}
+            "configs_explored": arr[..., 4], "live_tile_pm": pm}
 
 
 _CACHE: dict[tuple, Any] = {}
@@ -555,6 +688,7 @@ def check_steps3(rs: ReturnSteps, model: Model | None = None,
     out["valid"] = verdict(out)
     out["configs_explored"] = int(out["configs_explored"])
     out["max_frontier"] = int(out["max_frontier"])
+    attach_live_ratio(out)
     record_check_result(out)
     return out
 
@@ -658,6 +792,19 @@ def batch_arrays3(encs: Sequence[EncodedHistory], model: Model,
     return cfg, stack_steps3(steps, r_cap), steps
 
 
+def attach_live_ratio(out: dict) -> None:
+    """Fold the packed live_tile_pm telemetry column into the friendly
+    live_tile_ratio float (dropped when the launch didn't measure it —
+    pallas emits -1)."""
+    pm = out.pop("live_tile_pm", -1)
+    try:
+        pm = int(pm)
+    except (TypeError, ValueError):
+        pm = -1
+    if pm >= 0:
+        out["live_tile_ratio"] = min(pm / 1000.0, 1.0)
+
+
 def assemble_batch_results(out: dict, steps, cfg: DenseConfig) -> list[dict]:
     """Unpacked [B]-array results -> one result dict per history
     (v2-compatible schema + valid). Shared by the XLA and pallas batch
@@ -671,6 +818,7 @@ def assemble_batch_results(out: dict, steps, cfg: DenseConfig) -> list[dict]:
         one["op_count"] = s.n_ops
         one["configs_explored"] = int(one["configs_explored"])
         one["table_cells"] = cfg.n_states * cfg.n_masks
+        attach_live_ratio(one)
         record_check_result(one)
         results.append(one)
     return results

@@ -458,24 +458,26 @@ def check_steps3_long_pallas(rs, model: Model, cfg: DenseConfig,
     metadata + global step offset) carried between launches as operands.
     Same verdict/metrics contract as wgl3.check_steps3_long, with the
     kernel-side i32 configs accumulator (exact where the XLA path's f32
-    partial sums are approximate past 2^24)."""
+    partial sums are approximate past 2^24).
+
+    Under limits().sparse_mode == 2 (prefer-sparse, an explicit opt-in)
+    eligible geometries take the sparse work-list kernel instead
+    (check_steps3_long_pallas_sparse) — bit-identical verdicts, plus the
+    sweep telemetry record."""
     import time as _time
 
     from . import wgl3
     from .wgl import verdict
 
-    t0 = _time.monotonic()
     lim = limits()
-    # Largest step bucket that fits the per-launch SMEM prefetch ceiling
-    # (step_bucket values only, so every launch reuses ONE compiled
-    # shape; a sub-64 cap skips bucketing entirely). Window pads never
-    # execute — the kernel bounds its trip with the prefetched length.
-    window = lim.max_r_pallas
-    if window >= 64:
-        b = 64
-        while wgl3.step_bucket(b + 1) <= lim.max_r_pallas:
-            b = wgl3.step_bucket(b + 1)
-        window = b
+    if lim.sparse_mode == 2 and pallas_sparse_blocks(cfg):
+        return check_steps3_long_pallas_sparse(
+            rs, model, cfg, time_budget_s=time_budget_s,
+            interpret=interpret)
+    t0 = _time.monotonic()
+    # Window pads never execute — the kernel bounds its trip with the
+    # prefetched length.
+    window = _long_window(lim)
     launch = _cached_resumable_launcher(model, cfg, interpret)
     prep = _cached_prep(model, cfg)
     Sp = max(8, (cfg.n_states + 7) // 8 * 8)
@@ -535,6 +537,436 @@ def check_steps3_long_pallas(rs, model: Model, cfg: DenseConfig,
         "max_frontier": int(out_np[3]),
         "configs_explored": cfgs,
     }
+    res["valid"] = verdict(res)
+    record_check_result(res)
+    return res
+
+
+def _long_window(lim) -> int:
+    """Window length of the host-chained resumable sweeps: the largest
+    step BUCKET that fits one launch's SMEM prefetch ceiling
+    (lim.max_r_pallas), so every window reuses ONE compiled shape; a
+    sub-64 cap skips bucketing entirely. One copy shared by the dense
+    and sparse long sweeps so they window — and cache compiled window
+    shapes — identically."""
+    from . import wgl3
+
+    window = lim.max_r_pallas
+    if window >= 64:
+        b = 64
+        while wgl3.step_bucket(b + 1) <= lim.max_r_pallas:
+            b = wgl3.step_bucket(b + 1)
+        window = b
+    return window
+
+
+# -- sparse work-list kernel (opt-in: limits().sparse_mode == 2) -----------
+
+SPARSE_BLOCK_LANES = 128   # one VPU lane-tile of packed words per block
+
+
+def pallas_sparse_blocks(cfg: DenseConfig) -> int:
+    """Work-list block count of the sparse pallas kernel for this
+    geometry, or 0 when it cannot engage: the table must span at least
+    two 128-lane blocks (K >= 13) inside the pallas envelope, and the
+    sweep cap must be converging (same constraint as the dense paired
+    sweeps). NOTE the envelope means sparsity buys less here than in the
+    XLA engine (K <= max_k_pallas caps the table at a handful of lane
+    tiles, and per-block scalar control costs ~the block's own vector
+    work — the r4 tuning notes' overhead analysis); the kernel is
+    therefore OPT-IN via sparse_mode=2, and K > max_k_pallas geometries
+    take the XLA/lattice sparse engine, which is where the 2^K waste
+    actually lives."""
+    if cfg.k_slots > limits().max_k_pallas:
+        return 0
+    if cfg.max_rounds and cfg.max_rounds < cfg.k_slots:
+        return 0
+    w = 1 << (cfg.k_slots - 5)
+    nb = w // SPARSE_BLOCK_LANES
+    return nb if nb >= 2 else 0
+
+
+def _kernel_body_sparse_resumable(cfg: DenseConfig, nb: int,
+                                  thresh_blocks: int):
+    """Resumable per-history kernel with the sparse active-block sweep:
+    each closure round builds an SMEM WORK LIST of live 128-lane blocks
+    (one pass of per-block any-nonzero scalar probes), then sweeps only
+    the listed blocks — in-word and in-block mask bits expand locally
+    with Gauss-Seidel chaining, block-index bits read-modify-write the
+    destination block of the table carry directly (the fori over the
+    work list is sequential, so the RMW is race-free). Rounds whose live
+    count crosses `thresh_blocks` run the dense closure instead (the
+    direction-optimizing switch; the list always has capacity for all
+    `nb` blocks, so overflow cannot occur here). Same fixpoint, same
+    metadata contract as _kernel_body(resume=True) widened to 8 slots:
+    [dead, dead_step, maxf, cfgs, offset, live_sum, sparse_steps,
+    real_steps]."""
+    K, S, off = cfg.k_slots, cfg.n_states, cfg.state_offset
+    W = 1 << (K - 5)
+    Sp = max(8, (S + 7) // 8 * 8)
+    BLK = SPARSE_BLOCK_LANES
+    bbits = BLK.bit_length() - 1          # 7: lane bits inside a block
+    assert nb * BLK == W and nb >= 2
+    # No init_row/bind() here: this kernel is resume-only — the table
+    # always enters through the Tin operand (the host seeds window 0).
+
+    def _lane():
+        return jax.lax.broadcasted_iota(jnp.int32, (1, BLK), 1)
+
+    def _lane_full():
+        return jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
+
+    def allowed_full(t):
+        full = jnp.uint32(0xFFFFFFFF)
+        inword = jnp.uint32(_LO_MASK[4])
+        for b in range(3, -1, -1):
+            inword = jnp.where(t == b, jnp.uint32(_LO_MASK[b]), inword)
+        word_ok = ((_lane_full() >> jnp.maximum(t - 5, 0)) & 1) == 0
+        return jnp.where(t < 5, jnp.broadcast_to(inword, (1, W)),
+                         jnp.where(word_ok, full, jnp.uint32(0)))
+
+    def allowed_block(b, t):
+        """u32[1, BLK]: the allowed mask restricted to block b (global
+        word index = b * BLK + lane)."""
+        full = jnp.uint32(0xFFFFFFFF)
+        inword = jnp.uint32(_LO_MASK[4])
+        for k in range(3, -1, -1):
+            inword = jnp.where(t == k, jnp.uint32(_LO_MASK[k]), inword)
+        lane_g = b * BLK + _lane()
+        word_ok = ((lane_g >> jnp.maximum(t - 5, 0)) & 1) == 0
+        return jnp.where(t < 5, jnp.broadcast_to(inword, (1, BLK)),
+                         jnp.where(word_ok, full, jnp.uint32(0)))
+
+    def fire_slot(cm, j, src):
+        """OR-reduce over source states for slot j, any width: the
+        colmask column broadcast + arithmetic-select formulation of the
+        dense closure (r4 tuning notes)."""
+        colb = jnp.broadcast_to(cm[:, j:j + 1], (Sp, src.shape[-1]))
+        fired = jnp.zeros_like(src)
+        for s in range(S):
+            selm = (jnp.uint32(0)
+                    - ((colb >> jnp.uint32(s)) & jnp.uint32(1)))
+            fired = fired | (selm & src[s:s + 1, :])
+        return fired
+
+    def dense_closure(T, cm, allowed):
+        """One full-width Gauss-Seidel sweep — the dense fallback round
+        (same algebra as _kernel_body's closure)."""
+        for j in range(K):
+            src = T & allowed
+            fired = fire_slot(cm, j, src)
+            if j < 5:
+                T = T | ((fired & jnp.uint32(_LO_MASK[j]))
+                         << jnp.uint32(1 << j))
+            else:
+                d = 1 << (j - 5)
+                tgt = ((_lane_full() >> (j - 5)) & 1) == 1
+                T = T | jnp.where(tgt, pltpu.roll(fired, d, axis=1),
+                                  jnp.uint32(0))
+        return T
+
+    def body(ln_ref, mt_ref, tg_ref, cm_ref, Tin_ref, out_ref, Tout_ref,
+             T_s, meta_s, wl_s):
+        b0 = pl.program_id(0)
+        c = pl.program_id(1)
+        NC = pl.num_programs(1)
+        RC = cm_ref.shape[1]
+
+        @pl.when(c == 0)
+        def _init():
+            T_s[:, :] = Tin_ref[0]
+            for i, slot in enumerate((0, 1, 2, 3, 5, 6, 7)):
+                meta_s[i] = mt_ref[b0, slot]
+
+        trip = jnp.clip(ln_ref[b0] - c * RC, 0, RC)
+        off0 = mt_ref[b0, 4]
+
+        def count_live(T):
+            def probe(bi, cnt):
+                blk = jax.lax.dynamic_slice(T, (0, bi * BLK), (Sp, BLK))
+                return cnt + jnp.any(blk != 0).astype(jnp.int32)
+            return jax.lax.fori_loop(0, nb, probe, jnp.int32(0))
+
+        def build_worklist(T):
+            def probe(bi, cnt):
+                blk = jax.lax.dynamic_slice(T, (0, bi * BLK), (Sp, BLK))
+                liveb = jnp.any(blk != 0)
+
+                @pl.when(liveb)
+                def _():
+                    wl_s[cnt] = bi
+                return cnt + liveb.astype(jnp.int32)
+            return jax.lax.fori_loop(0, nb, probe, jnp.int32(0))
+
+        def step(i, carry):
+            (T, dead, dead_step, maxf, cfgs, live_sum, sp_steps,
+             real_steps) = carry
+            r = off0 + c * RC + i
+            t = jnp.maximum(tg_ref[b0, c * RC + i], 0)
+            allowed = allowed_full(t)
+            cm = cm_ref[0, i]                                # u32[Sp, 128]
+
+            def sparse_sweep(T):
+                def do_blk(wi, T):
+                    bi = wl_s[wi]
+                    blk = jax.lax.dynamic_slice(T, (0, bi * BLK),
+                                                (Sp, BLK))
+                    ab = allowed_block(bi, t)
+                    newblk = blk
+                    src = blk & ab
+                    for j in range(min(K, 5 + bbits)):
+                        fired = fire_slot(cm, j, src)
+                        if j < 5:
+                            newblk = newblk | (
+                                (fired & jnp.uint32(_LO_MASK[j]))
+                                << jnp.uint32(1 << j))
+                        else:
+                            d = 1 << (j - 5)
+                            tgt = ((_lane() >> (j - 5)) & 1) == 1
+                            newblk = newblk | jnp.where(
+                                tgt, pltpu.roll(fired, d, axis=1),
+                                jnp.uint32(0))
+                        src = newblk & ab   # Gauss-Seidel inside the block
+                    T = jax.lax.dynamic_update_slice(T, newblk,
+                                                     (0, bi * BLK))
+                    for j in range(5 + bbits, K):
+                        # Block-index bit: RMW the destination block.
+                        bb = j - 5 - bbits
+                        fired = fire_slot(cm, j, src)
+                        fired = jnp.where(((bi >> bb) & 1) == 0, fired,
+                                          jnp.uint32(0))
+                        dest = bi | (1 << bb)
+                        dblk = jax.lax.dynamic_slice(T, (0, dest * BLK),
+                                                     (Sp, BLK))
+                        T = jax.lax.dynamic_update_slice(
+                            T, dblk | fired, (0, dest * BLK))
+                    return T
+                live = build_worklist(T)
+                return jax.lax.fori_loop(0, live, do_blk, T)
+
+            def wbody(st):
+                Tw, _ch, rounds, sp_rounds = st
+                live = count_live(Tw)
+                take = live <= thresh_blocks
+                Tn = jax.lax.cond(take, sparse_sweep,
+                                  lambda T: dense_closure(T, cm, allowed),
+                                  Tw)
+                return (Tn, jnp.any(Tn != Tw), rounds + 1,
+                        sp_rounds + take.astype(jnp.int32))
+
+            def wcond(st):
+                return st[1] & (st[2] < cfg.rounds)
+
+            T, _ch, rounds, sp_rounds = jax.lax.while_loop(
+                wcond, wbody, (T, jnp.bool_(True), jnp.int32(0),
+                               jnp.int32(0)))
+            n = jnp.sum(jax.lax.population_count(T), dtype=jnp.int32)
+            live_fin = count_live(T)
+
+            # Prune: full-width switch, same as the dense kernel.
+            def br(j):
+                def f(_):
+                    if j < 5:
+                        return (T >> jnp.uint32(1 << j)) & allowed
+                    d = 1 << (j - 5)
+                    return pltpu.roll(T, W - d, axis=1) & allowed
+                return f
+            pruned = jax.lax.switch(t, [br(j) for j in range(K)], None)
+            alive = jnp.any(pruned != 0)
+            died = ~dead & ~alive
+            dead = dead | died
+            T_new = jnp.where(dead, jnp.zeros_like(pruned), pruned)
+            return (T_new, dead,
+                    jnp.where(died & (dead_step < 0), r, dead_step),
+                    jnp.maximum(maxf, n), cfgs + n,
+                    live_sum + live_fin,
+                    sp_steps + (sp_rounds == rounds).astype(jnp.int32),
+                    real_steps + 1)
+
+        init = (T_s[:, :], meta_s[0] != 0, meta_s[1], meta_s[2], meta_s[3],
+                meta_s[4], meta_s[5], meta_s[6])
+        (T, dead, dead_step, maxf, cfgs, live_sum, sp_steps,
+         real_steps) = jax.lax.fori_loop(0, trip, step, init)
+        T_s[:, :] = T
+        meta_s[0] = dead.astype(jnp.int32)
+        meta_s[1] = dead_step
+        meta_s[2] = maxf
+        meta_s[3] = cfgs
+        meta_s[4] = live_sum
+        meta_s[5] = sp_steps
+        meta_s[6] = real_steps
+
+        @pl.when(c == NC - 1)
+        def _emit():
+            out_ref[0] = jnp.where(dead, 0, 1).astype(jnp.int32)
+            out_ref[1] = jnp.int32(0)   # overflow: impossible (dense table)
+            out_ref[2] = dead_step
+            out_ref[3] = maxf
+            out_ref[4] = cfgs
+            out_ref[5] = live_sum
+            out_ref[6] = sp_steps
+            out_ref[7] = real_steps
+            Tout_ref[0] = T_s[:, :]
+
+    return body
+
+
+def local_pallas_launcher_sparse_resumable(model: Model, cfg: DenseConfig,
+                                           interpret: bool = False):
+    """launch(R) for the SPARSE resumable kernel: jitted (ln i32[1],
+    mt i32[1,8], tg i32[1,R], cm u32[1,R,Sp,128], Tin u32[1,Sp,W], end)
+    -> (out i32[8], Tout, mt_next i32[1,8]) — the 8-slot twin of
+    local_pallas_launcher_resumable, carrying the sweep telemetry
+    (live-block sum, sparse-step count, real steps) through the window
+    chain alongside the verdict metadata."""
+    nb = pallas_sparse_blocks(cfg)
+    if not nb:
+        raise ValueError(f"sparse pallas kernel infeasible for "
+                         f"k_slots={cfg.k_slots}")
+    _require_converging_cap(cfg)
+    lim = limits()
+    thresh = (nb if lim.sparse_mode == 2
+              else max(1, nb * lim.sparse_density_threshold_pct // 100))
+    Sp = max(8, (cfg.n_states + 7) // 8 * 8)
+    W = 1 << (cfg.k_slots - 5)
+    kernel = _kernel_body_sparse_resumable(cfg, nb, thresh)
+
+    import functools
+
+    @functools.lru_cache(maxsize=None)
+    def launch(R: int):
+        RC = min(R, limits().pallas_step_chunk)
+        NC = (R + RC - 1) // RC
+        R_pad = NC * RC
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(1, NC),
+            in_specs=[
+                pl.BlockSpec((1, RC, Sp, 128),
+                             lambda b, c, ln, mt, tg: (b, c, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, Sp, W),
+                             lambda b, c, ln, mt, tg: (b, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((8,), lambda b, c, ln, mt, tg: (0,),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, Sp, W),
+                             lambda b, c, ln, mt, tg: (b, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((Sp, W), jnp.uint32),   # table carry
+                pltpu.SMEM((7,), jnp.int32),        # metadata carry
+                pltpu.SMEM((nb,), jnp.int32),       # the block work list
+            ],
+        )
+
+        def run(ln, mt, tg, cm, Tin, end):
+            if R_pad != R:
+                tg = jnp.pad(tg, ((0, 0), (0, R_pad - R)),
+                             constant_values=-1)
+                cm = jnp.pad(cm, ((0, 0), (0, R_pad - R), (0, 0), (0, 0)))
+            out, Tout = pl.pallas_call(
+                kernel,
+                grid_spec=grid_spec,
+                out_shape=[jax.ShapeDtypeStruct((8,), jnp.int32),
+                           jax.ShapeDtypeStruct((1, Sp, W), jnp.uint32)],
+                interpret=interpret,
+            )(ln, mt, tg, cm, Tin)
+            mt_next = jnp.stack([1 - out[0], out[2], out[3], out[4], end,
+                                 out[5], out[6], out[7]])[None]
+            return out, Tout, mt_next
+
+        return instrument_kernel("wgl3-pallas-sparse-resumable",
+                                 jax.jit(run, donate_argnums=(1, 4)))
+
+    return launch
+
+
+def _cached_sparse_resumable_launcher(model: Model, cfg: DenseConfig,
+                                      interpret: bool = False):
+    lim = limits()
+    key = ("pallas-sparse-resumable", model.cache_key(), cfg, interpret,
+           lim.sparse_mode, lim.sparse_density_threshold_pct)
+    if key not in _CACHE:
+        _CACHE[key] = local_pallas_launcher_sparse_resumable(
+            model, cfg, interpret)
+    return _CACHE[key]
+
+
+def check_steps3_long_pallas_sparse(rs, model: Model, cfg: DenseConfig,
+                                    time_budget_s: float | None = None,
+                                    interpret: bool = False) -> dict:
+    """Host-chained SPARSE fused-kernel sweep: the work-list kernel's
+    twin of check_steps3_long_pallas (same windowing, same budget
+    contract, bit-identical verdicts), plus the sweep-mode/live-block
+    telemetry record. Opt-in — the production router only takes it under
+    limits().sparse_mode == 2 (see pallas_sparse_blocks)."""
+    import time as _time
+
+    from . import wgl3
+    from .wgl import verdict
+
+    nb = pallas_sparse_blocks(cfg)
+    if not nb:
+        raise ValueError(f"sparse pallas kernel infeasible for "
+                         f"k_slots={cfg.k_slots}")
+    t0 = _time.monotonic()
+    lim = limits()
+    window = _long_window(lim)
+    launch = _cached_sparse_resumable_launcher(model, cfg, interpret)
+    prep = _cached_prep(model, cfg)
+    Sp = max(8, (cfg.n_states + 7) // 8 * 8)
+    W = 1 << (cfg.k_slots - 5)
+    Tin = np.zeros((1, Sp, W), np.uint32)
+    Tin[0, int(model.init_state()) + cfg.state_offset, 0] = 1
+    Tin = jnp.asarray(Tin)
+    meta = jnp.asarray(np.array([[0, -1, 1, 0, 0, 0, 0, 0]], np.int32))
+    n = rs.n_steps
+    if n == 0:
+        return {"survived": True, "overflow": False, "dead_step": -1,
+                "max_frontier": 1, "configs_explored": 0, "valid": True}
+    out = None
+    for w0 in range(0, n, window):
+        if (time_budget_s is not None
+                and _time.monotonic() - t0 > time_budget_s):
+            return {"valid": "unknown", "survived": False, "overflow": True,
+                    "dead_step": -1, "max_frontier": -1,
+                    "configs_explored": -1, "kernel": "exhausted",
+                    "error": f"sparse pallas long sweep exceeded its "
+                             f"{time_budget_s:.0f}s time budget at return "
+                             f"step {w0}"}
+        wn = min(window, n - w0)
+        sl = slice(w0, w0 + wn)
+        pad = ((0, window - wn),)
+        tg = np.pad(rs.targets[sl], pad, constant_values=-1)[None]
+        tabs = np.pad(rs.slot_tabs[sl], pad + ((0, 0), (0, 0)))[None]
+        act = np.pad(rs.slot_active[sl], pad + ((0, 0),))[None]
+        cm, tgd, ln = prep(jnp.asarray(tabs), jnp.asarray(act),
+                           jnp.asarray(tg))
+        out, Tin, meta = launch(window)(
+            ln, meta, tgd, cm, Tin, jnp.asarray(w0 + wn, jnp.int32))
+        if time_budget_s is not None:
+            np.asarray(out)
+    out_np = np.asarray(out)
+    cfgs = int(out_np[4])
+    if cfgs < 0:
+        cfgs = 2**31 - 1
+    res = {
+        "survived": bool(out_np[0]),
+        "overflow": False,
+        "dead_step": int(out_np[2]),
+        "max_frontier": int(out_np[3]),
+        "configs_explored": cfgs,
+        "kernel": "wgl3-dense-pallas-sparse-chunked",
+    }
+    res["sweep"] = wgl3.sweep_summary(
+        cfg, live_sum=float(max(0, int(out_np[5]))),
+        real_steps=int(out_np[7]), sparse_steps=int(out_np[6]),
+        tiling=(SPARSE_BLOCK_LANES, nb))
+    res["live_tile_ratio"] = res["sweep"]["live_tile_ratio"]
     res["valid"] = verdict(res)
     record_check_result(res)
     return res
@@ -1159,7 +1591,10 @@ def check_encoded_general(enc: EncodedHistory, model: Model,
         out["f_cap"] = cfg_sweep.n_states * cfg_sweep.n_masks
         out["escalations"] = 0
         if out.get("valid") != "unknown":
-            out["kernel"] = name
+            # The sweep stamps its own kernel when the sparse engine ran
+            # (wgl3-dense-sparse-chunked / wgl3-dense-lattice-sparse);
+            # fall back to the route's name otherwise.
+            out["kernel"] = out.get("kernel", name)
         return out
 
     try:

@@ -1,0 +1,375 @@
+"""Sparse active-tile sweep engine for the dense WGL lattice kernels.
+
+The dense subset-lattice kernel (ops/wgl3.py) pays O(K * S * 2^K) word-ops
+per return step regardless of how few configs are LIVE — and past K ~ 17
+"the live frontier is invariably tiny relative to the lattice" (the
+dense_config docstring's own admission; it is why the cell budget routes
+wide geometries away from the dense sweep at all). This module removes
+that waste the way direction-optimizing BFS removes it from graph
+traversal (Beamer, Asanović & Patterson, SC'12): sweep only where the
+frontier IS, and switch back to the dense formulation when the frontier
+gets dense enough that skipping stops paying.
+
+Mechanics, per closure round (the sweep inside each return step's
+fixpoint loop):
+
+  * the packed table u32[S, W] is viewed as W / TILE occupancy TILES of
+    TILE contiguous words (TILE = limits().sparse_tile_words); a tile is
+    live when any of its words is nonzero in any state row;
+  * a static-capacity work list (limits().sparse_worklist_cap) gathers
+    the LIVE tiles' indices (jnp.nonzero with a static size — XLA shapes
+    stay static) and the sweep runs gather -> expand -> scatter:
+      - slot j < 5:              in-word shift — local to the gathered tile
+      - 5 <= j-5 < log2(TILE):   word-axis reshape — local to the tile
+      - j-5 >= log2(TILE):       the mask bit lives in the TILE index —
+                                 fired configs scatter-OR into tile
+                                 (t | 1 << bit), a per-slot scatter with
+                                 provably unique destinations;
+  * when the live-tile count crosses the density threshold
+    (limits().sparse_density_threshold_pct) or overflows the work list,
+    THAT ROUND runs the ordinary dense sweep instead — the
+    direction-optimizing switch. Work-list overflow therefore never
+    drops configs; it only costs the dense round the engine would have
+    run anyway.
+
+Why verdicts are bit-identical to the dense sweep: the closure is a
+monotone OR-fixpoint, and one sparse round is a superset of one Jacobi
+round of the full table (every config at firing-distance 1 from the
+current table has its source in a live tile, and every such firing is
+computed — locally with in-round chaining, across tiles via the
+scatter). K Jacobi rounds provably converge (each firing sets a distinct
+slot bit), the round cap is cfg.rounds >= K (sparse_plan refuses
+truncating caps), and the fixpoint is unique — so the converged table,
+and with it every verdict field (survived / overflow / dead_step /
+max_frontier / configs_explored), is exactly the dense kernel's.
+Differential tests pin this on the golden + fuzz corpora
+(tests/test_sparse_sweep.py).
+
+Cost: a sparse round is O(K * S * cap * TILE) plus an O(S * W) occupancy
+reduce — per-step cost tracks the LIVE frontier, which is what lets long
+sparse histories scale past K ~ 20 (the lattice-sharded twin in
+parallel/lattice.py shards the same engine over devices).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.base import Model
+from ..obs import instrument_kernel, record_check_result
+from .encode import ReturnSteps
+from .limits import limits
+from .wgl3 import (DenseConfig, _Carry3, _LO_MASK, _init_carry3,
+                   default_scan_chunk, live_tile_geometry, sweep_summary,
+                   table_ops)
+
+_CACHE: dict[tuple, Any] = {}
+
+
+@dataclass(frozen=True)
+class SparsePlan:
+    """The static shape of one geometry's sparse sweep: tile size, tile
+    count, gather capacity, and the effective live-tile threshold above
+    which a round runs dense. Hashable — part of the jit cache key."""
+    tile_words: int     # TILE: packed words per occupancy tile (pow2)
+    n_tiles: int        # W / TILE
+    cap: int            # static work-list capacity (tiles gathered)
+    thresh_tiles: int   # live-tile count above which the round is dense
+
+
+def sparse_plan(cfg: DenseConfig, words: int | None = None
+                ) -> SparsePlan | None:
+    """The sparse plan for this geometry under the active limits — or
+    None when the engine must stay off: sparse_mode=1 (dense-only), a
+    truncating max_rounds (the hybrid's round ORDER differs from the
+    Gauss-Seidel sweep, so a sub-convergence cap could diverge), or too
+    few tiles to be worth the per-round occupancy + gather overhead.
+
+    `words` overrides the table width for SHARDED tables (parallel/
+    lattice.py passes its per-device word count so each shard's work
+    list is sized to the shard)."""
+    lim = limits()
+    if lim.sparse_mode == 1:
+        return None
+    if cfg.max_rounds and cfg.max_rounds < cfg.k_slots:
+        return None
+    tile, n_tiles = live_tile_geometry(cfg, words=words)
+    if n_tiles < 2:
+        return None     # structurally too narrow to tile at all
+    if lim.sparse_mode != 2 and n_tiles < lim.sparse_min_tiles:
+        # AUTO mode engages only past the measured static crossover
+        # (see the sparse_min_tiles rationale in ops/limits.py);
+        # prefer-sparse (2) is the explicit measurement override.
+        return None
+    cap = max(1, min(lim.sparse_worklist_cap, n_tiles))
+    if lim.sparse_mode == 2:
+        thresh = n_tiles
+    else:
+        thresh = max(1, n_tiles * lim.sparse_density_threshold_pct // 100)
+    return SparsePlan(tile_words=tile, n_tiles=n_tiles, cap=cap,
+                      thresh_tiles=min(thresh, cap))
+
+
+def make_sparse_sweep(model: Model, cfg: DenseConfig, plan: SparsePlan):
+    """(T, allowed, trans, occ_t, live) -> T': one gather->expand->
+    scatter round over the live tiles.
+
+    LOCKSTEP NOTE: parallel/lattice.py `sweep_sparse` is this sweep's
+    shard-local mirror (same gather, same in-word/in-tile/tile-bit
+    branches and pad masking, plus a device-bit branch that scatters to
+    shard width and ppermutes). The two cannot share code without
+    threading the shard closure's or_reduce/axis context through here,
+    so any fix to the bit algebra or the valid/src_ok masking MUST be
+    applied to both — tests/test_sparse_sweep.py's lattice cases are the
+    drift tripwire."""
+    ops = table_ops(model, cfg)
+    K, S = cfg.k_slots, cfg.n_states
+    W = 1 << (K - 5)
+    TILE, NT, CAP = plan.tile_words, plan.n_tiles, plan.cap
+    assert NT * TILE == W
+    tbits = TILE.bit_length() - 1
+    tile_off = jnp.arange(TILE, dtype=jnp.int32)
+    cap_ids = jnp.arange(CAP, dtype=jnp.int32)
+
+    def sweep(T, allowed, trans, occ_t, live):
+        # Static-capacity gather of the live tiles. Pad entries index
+        # tile 0 and are zeroed via `valid`, so their scatter adds are
+        # zeros (harmless under the unique-destination adds below).
+        idx = jnp.nonzero(occ_t, size=CAP, fill_value=0)[0]
+        valid = cap_ids < live
+        cols = idx[:, None] * TILE + tile_off[None, :]        # [CAP, TILE]
+        flat = cols.reshape(-1)
+        G = jnp.where(valid[None, :, None], T[:, cols], jnp.uint32(0))
+        aG = allowed[cols][None]                              # [1,CAP,TILE]
+        crossT = T
+        for j in range(K):
+            src = G & aG
+            if j < 5:
+                fired = ops.or_reduce(trans[j], src & _LO_MASK[j])
+                G = G | (fired << np.uint32(1 << j))
+            elif j - 5 < tbits:
+                # Mask bit j lives in the tile's own word bits: the same
+                # [hi, 2, lo] exposure as the dense sweep, per tile.
+                lo_w, hi = 1 << (j - 5), TILE >> (j - 4)
+                Gr = G.reshape(S, CAP, hi, 2, lo_w)
+                srcj = src.reshape(S, CAP, hi, 2, lo_w)[:, :, :, 0, :]
+                fired = ops.or_reduce(trans[j], srcj)
+                G = jnp.stack([Gr[:, :, :, 0, :], Gr[:, :, :, 1, :] | fired],
+                              axis=3).reshape(S, CAP, TILE)
+            else:
+                # Mask bit j lives in the TILE index: fired configs move
+                # from tile t (bit clear) to tile t | 1<<b. Destinations
+                # are unique across live source tiles (they differ in
+                # their other bits), so a scatter-ADD into a zero buffer
+                # is exactly a scatter-OR; cross fires land in the full
+                # table, where the NEXT round's work list picks the
+                # newly-live tiles up (Jacobi across tiles — the round
+                # bound below still holds).
+                b = j - 5 - tbits
+                src_ok = ((idx >> b) & 1) == 0
+                fired = ops.or_reduce(trans[j], src)
+                fired = jnp.where((valid & src_ok)[None, :, None], fired,
+                                  jnp.uint32(0))
+                dcols = ((idx | (1 << b))[:, None] * TILE
+                         + tile_off[None, :]).reshape(-1)
+                crossT = crossT | jnp.zeros_like(T).at[:, dcols].add(
+                    fired.reshape(S, CAP * TILE))
+        Gv = jnp.where(valid[None, :, None], G, jnp.uint32(0))
+        localT = jnp.zeros_like(T).at[:, flat].add(
+            Gv.reshape(S, CAP * TILE))
+        return crossT | localT
+
+    return sweep
+
+
+def make_step_fn3_sparse(model: Model, cfg: DenseConfig, plan: SparsePlan):
+    """Scan body mirroring wgl3.make_step_fn3 with the closure round
+    replaced by the density-switched sparse/dense hybrid. Per-step scan
+    outputs: (configs live after convergence, live tiles after
+    convergence, every-round-ran-sparse flag) — pads emit zeros."""
+    ops = table_ops(model, cfg)
+    sweep = make_sparse_sweep(model, cfg, plan)
+    TILE, NT = plan.tile_words, plan.n_tiles
+    thresh = plan.thresh_tiles
+    transitions = ops.transitions
+
+    def occupancy(T):
+        any_w = jnp.any(T != jnp.uint32(0), axis=0)
+        occ_t = jnp.any(any_w.reshape(NT, TILE), axis=1)
+        return occ_t, jnp.sum(occ_t, dtype=jnp.int32)
+
+    def step(carry, xs):
+        trans, target, idx = xs
+        is_pad = target < 0
+        t = jnp.maximum(target, 0)
+        allowed = ops.allowed_mask(t)
+
+        def body(st):
+            T, n_prev, _changed, rounds, sp_rounds = st
+            occ_t, live = occupancy(T)
+            # The direction-optimizing switch, PER ROUND: a frontier
+            # that fills up mid-closure crosses to dense (and back) with
+            # no host involvement; a work-list overflow (live > cap) is
+            # just a dense round — configs are never dropped.
+            use_sparse = live <= thresh
+            T = jax.lax.cond(
+                use_sparse,
+                lambda T: sweep(T, allowed, trans, occ_t, live),
+                lambda T: ops.dense_sweep(T, allowed, trans),
+                T)
+            n_now = jnp.sum(jax.lax.population_count(T), dtype=jnp.int32)
+            return (T, n_now, n_now > n_prev, rounds + 1,
+                    sp_rounds + use_sparse.astype(jnp.int32))
+
+        def cond(st):
+            return st[2] & (st[3] < cfg.rounds)
+
+        n0 = jnp.sum(jax.lax.population_count(carry.table),
+                     dtype=jnp.int32)
+        T, n, _c, rounds, sp_rounds = jax.lax.while_loop(
+            cond, body, (carry.table, n0, ~is_pad, jnp.int32(0),
+                         jnp.int32(0)))
+        _occ, live_fin = occupancy(T)
+        pruned = ops.prune(T, t, allowed)
+        T_new = jnp.where(is_pad, T, pruned)
+        alive = jnp.any(T_new != 0)
+        died = ~is_pad & ~carry.dead & ~alive
+        dead = carry.dead | died
+        T_new = jnp.where(dead, jnp.zeros_like(T_new), T_new)
+        sparse_all = (~is_pad) & (rounds > 0) & (sp_rounds == rounds)
+        return _Carry3(
+            table=T_new, dead=dead,
+            dead_step=jnp.where(died & (carry.dead_step < 0), idx,
+                                carry.dead_step),
+            max_frontier=jnp.maximum(carry.max_frontier, n)), (
+                jnp.where(is_pad, 0, n),
+                jnp.where(is_pad, 0, live_fin),
+                sparse_all.astype(jnp.int32))
+
+    return step, transitions
+
+
+def _chunk_fn_sparse(model: Model, cfg: DenseConfig, plan: SparsePlan):
+    """Sparse twin of wgl3._chunk_fn: jitted (carry, tabs, act, tgts,
+    idx0) -> (carry', f32[4] partials [configs, live-tile sum, real
+    steps, sparse steps]). The carry is DONATED (threaded linearly by
+    every caller, like the dense chunk fn)."""
+    step, transitions = make_step_fn3_sparse(model, cfg, plan)
+
+    def run(carry, tabs, act, tgts, idx0):
+        trans = jax.vmap(transitions)(tabs, act)
+        idxs = idx0 + jnp.arange(tgts.shape[0], dtype=jnp.int32)
+        carry, (ns, lives, sp) = jax.lax.scan(step, carry,
+                                              (trans, tgts, idxs))
+        return carry, jnp.stack([
+            jnp.sum(ns.astype(jnp.float32)),
+            jnp.sum(lives.astype(jnp.float32)),
+            jnp.sum((tgts >= 0).astype(jnp.float32)),
+            jnp.sum(sp.astype(jnp.float32))])
+
+    return jax.jit(run, donate_argnums=(0,))
+
+
+def _cached_sparse_chunk(model: Model, cfg: DenseConfig, plan: SparsePlan,
+                         chunk: int):
+    key = ("sparse-chunk", model.cache_key(), cfg, plan, chunk)
+    if key not in _CACHE:
+        _CACHE[key] = instrument_kernel("wgl3-sparse-chunk",
+                                        _chunk_fn_sparse(model, cfg, plan))
+    return _CACHE[key]
+
+
+def check_steps3_long_sparse(rs: ReturnSteps, model: Model,
+                             cfg: DenseConfig, plan: SparsePlan,
+                             chunk: int | None = None,
+                             time_budget_s: float | None = None) -> dict:
+    """Chunked single-history sweep through the sparse engine: the same
+    host loop as wgl3.check_steps3_long (double-buffered staging,
+    periodic death polls, one packed fetch at the end; synchronous when
+    budgeted), bit-identical verdicts, plus the sweep-mode/live-tile
+    record behind the telemetry gauges and the bench's `sparse` lane."""
+    import time as _time
+
+    from ..sched.pipeline import double_buffer
+    from .wgl import verdict
+
+    t0 = _time.monotonic()
+    if chunk is None:
+        chunk = default_scan_chunk(cfg)
+    run = _cached_sparse_chunk(model, cfg, plan, chunk)
+    n = rs.n_steps
+    n_pad = (n + chunk - 1) // chunk * chunk
+    rs = rs.padded_to(n_pad)
+    carry = _init_carry3(model, cfg)
+    parts_dev = None
+    if time_budget_s is None:
+        poll = max(1, limits().sched_poll_chunks)
+
+        def stage(c):
+            sl = slice(c * chunk, (c + 1) * chunk)
+            return (jnp.asarray(rs.slot_tabs[sl]),
+                    jnp.asarray(rs.slot_active[sl]),
+                    jnp.asarray(rs.targets[sl]),
+                    jnp.int32(c * chunk))
+
+        done = 0
+        for staged in double_buffer(range(n_pad // chunk), stage):
+            carry, part = run(carry, *staged)
+            parts_dev = part if parts_dev is None else parts_dev + part
+            done += 1
+            if done % poll == 0 and bool(np.asarray(carry.dead)):
+                break
+    else:
+        for c in range(n_pad // chunk):
+            if _time.monotonic() - t0 > time_budget_s:
+                return {"valid": "unknown", "survived": False,
+                        "overflow": True, "dead_step": -1,
+                        "max_frontier": -1, "configs_explored": -1,
+                        "kernel": "exhausted",
+                        "error": f"sparse-chunked sweep exceeded its "
+                                 f"{time_budget_s:.0f}s time budget at "
+                                 f"return step {c * chunk}"}
+            sl = slice(c * chunk, (c + 1) * chunk)
+            carry, part = run(carry, jnp.asarray(rs.slot_tabs[sl]),
+                              jnp.asarray(rs.slot_active[sl]),
+                              jnp.asarray(rs.targets[sl]),
+                              jnp.int32(c * chunk))
+            parts_dev = part if parts_dev is None else parts_dev + part
+            if bool(np.asarray(carry.dead)):
+                break
+
+    if parts_dev is None:
+        parts_dev = jnp.zeros((4,), jnp.float32)
+    packed = np.asarray(jnp.concatenate([
+        jnp.stack([jnp.where(carry.dead, 0, 1),
+                   carry.dead_step, carry.max_frontier]),
+        jnp.clip(parts_dev, 0, 2**31 - 1).astype(jnp.int32)]))
+    out = {
+        "survived": bool(packed[0]),
+        "overflow": False,
+        "dead_step": int(packed[1]),
+        "max_frontier": int(packed[2]),
+        "configs_explored": int(packed[3]),
+        "kernel": "wgl3-dense-sparse-chunked",
+    }
+    out["sweep"] = sweep_summary(cfg, live_sum=float(packed[4]),
+                                 real_steps=int(packed[5]),
+                                 sparse_steps=int(packed[6]))
+    out["live_tile_ratio"] = out["sweep"]["live_tile_ratio"]
+    out["valid"] = verdict(out)
+    record_check_result(out)
+    return out
+
+
+__all__ = [
+    "SparsePlan",
+    "check_steps3_long_sparse",
+    "make_sparse_sweep",
+    "make_step_fn3_sparse",
+    "sparse_plan",
+]

@@ -59,8 +59,9 @@ def sharded_batch_checker3_packed(model: Model, cfg: DenseConfig,
                                   mesh: Mesh, axis: str = "batch"):
     """The XLA dense kernel, batch-sharded: jitted
     check(slot_tabs[B,R,K,4], slot_active[B,R,K], targets[B,R]) ->
-    DEVICE i32[B, 5] (wgl3.PACKED_FIELDS), with B partitioned over `axis`.
-    B must be a multiple of the axis size."""
+    DEVICE i32[B, 6] (wgl3.PACKED_FIELDS_XLA — the verdict fields plus
+    the live-tile occupancy telemetry column), with B partitioned over
+    `axis`. B must be a multiple of the axis size."""
     key = ("dense-sharded", model.cache_key(), cfg, _mesh_key(mesh), axis)
     if key not in _CACHE:
         fn = jax.vmap(wgl3._check_one_fn(model, cfg))

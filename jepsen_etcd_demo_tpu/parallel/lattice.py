@@ -53,6 +53,7 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 from ..models.base import Model
+from ..obs import record_check_result
 from ..ops import wgl3
 from ..ops.encode import ReturnSteps
 from ..ops.limits import limits
@@ -89,10 +90,23 @@ def lattice_dense_config(model: Model, k_slots: int, max_value: int,
     return cfg
 
 
-def _build_local_step(model: Model, cfg: DenseConfig, axis: str, d: int):
+def _build_local_step(model: Model, cfg: DenseConfig, axis: str, d: int,
+                      plan=None):
     """The per-device scan body over one shard of the table. Mirrors
     wgl3.make_step_fn3 exactly (same banking/closure/prune semantics, same
-    metrics) with the word axis split over `axis`."""
+    metrics) with the word axis split over `axis`.
+
+    With a `plan` (ops/wgl3_sparse.SparsePlan built on the SHARD width),
+    each closure round runs the sparse active-tile sweep over the shard's
+    LIVE tiles: occupancy is shard-local, but the dense/sparse decision
+    comes from the ALL-REDUCED density signal (psum of live tiles + pmax
+    of the per-shard work-list pressure), so every device takes the same
+    branch and the branch-internal ppermutes stay collective-consistent.
+    A shard whose live tiles overflow the work list forces a dense round
+    EVERYWHERE — configs are never dropped. Verdicts stay bit-identical
+    to the single-device kernel (same monotone fixpoint argument as
+    ops/wgl3_sparse.py, with the device-bit fires crossing the mesh in
+    both formulations)."""
     K, S = cfg.k_slots, cfg.n_states
     assert K >= 5 and S <= 32
     W = 1 << (K - 5)
@@ -183,6 +197,89 @@ def _build_local_step(model: Model, cfg: DenseConfig, axis: str, d: int):
             + [prune_remote(b) for b in range(dbits)],
             T, t, allowed)
 
+    # -- shard-local occupancy tiling (telemetry always; sparse when a
+    #    plan is given) — the one shared tiling policy, clamped to the
+    #    SHARD width (wgl3.live_tile_geometry).
+    lim = limits()
+    if plan is not None:
+        tile, nt_loc = plan.tile_words, plan.n_tiles
+    else:
+        tile, nt_loc = wgl3.live_tile_geometry(cfg, words=w_loc)
+    nt_glob = nt_loc * d
+    tbits = tile.bit_length() - 1
+    tile_off = jnp.arange(tile, dtype=jnp.int32)
+    if plan is not None:
+        CAP = plan.cap
+        cap_ids = jnp.arange(CAP, dtype=jnp.int32)
+        thresh_glob = (nt_glob if lim.sparse_mode == 2 else
+                       max(1, nt_glob * lim.sparse_density_threshold_pct
+                           // 100))
+
+    def occupancy(T):
+        any_w = jnp.any(T != jnp.uint32(0), axis=0)
+        occ_t = jnp.any(any_w.reshape(nt_loc, tile), axis=1)
+        return occ_t, jnp.sum(occ_t, dtype=jnp.int32)
+
+    def sweep_sparse(T, trans, allowed, occ_t, live_loc):
+        """Gather->expand->scatter over this SHARD's live tiles. Local
+        slot bits mirror ops/wgl3_sparse.make_sparse_sweep on the shard;
+        device-bit fires scatter to full shard width first, then cross
+        the mesh with the same ppermute the dense expand uses.
+
+        LOCKSTEP NOTE: keep the in-word/in-tile/tile-bit branches and
+        the valid/src_ok masking identical to make_sparse_sweep (see its
+        docstring) — fixes must land in both copies."""
+        idx = jnp.nonzero(occ_t, size=CAP, fill_value=0)[0]
+        valid = cap_ids < live_loc
+        cols = idx[:, None] * tile + tile_off[None, :]
+        flat = cols.reshape(-1)
+        G = jnp.where(valid[None, :, None], T[:, cols], jnp.uint32(0))
+        aG = allowed[cols][None]
+        crossT = T
+        for j in range(K):
+            src = G & aG
+            if j < 5:
+                fired = or_reduce(trans[j], src & _LO_MASK[j])
+                G = G | (fired << np.uint32(1 << j))
+            elif j - 5 < tbits:
+                lo_w = 1 << (j - 5)
+                hi = tile >> (j - 4)
+                Gr = G.reshape(S, CAP, hi, 2, lo_w)
+                srcj = src.reshape(S, CAP, hi, 2, lo_w)[:, :, :, 0, :]
+                fired = or_reduce(trans[j], srcj)
+                G = jnp.stack(
+                    [Gr[:, :, :, 0, :], Gr[:, :, :, 1, :] | fired],
+                    axis=3).reshape(S, CAP, tile)
+            elif j - 5 < lbits:
+                # Local tile-index bit: scatter-OR into this shard.
+                b = j - 5 - tbits
+                src_ok = ((idx >> b) & 1) == 0
+                fired = or_reduce(trans[j], src)
+                fired = jnp.where((valid & src_ok)[None, :, None], fired,
+                                  jnp.uint32(0))
+                dcols = ((idx | (1 << b))[:, None] * tile
+                         + tile_off[None, :]).reshape(-1)
+                crossT = crossT | jnp.zeros_like(T).at[:, dcols].add(
+                    fired.reshape(S, CAP * tile))
+            else:
+                # Device bit: fired configs scatter to full shard width,
+                # then cross the mesh exactly like the dense expand.
+                b = j - 5 - lbits
+                src_dev = ((dev() >> b) & 1) == 0
+                fired = or_reduce(trans[j], src)
+                fired = jnp.where(valid[None, :, None] & src_dev, fired,
+                                  jnp.uint32(0))
+                fired_full = jnp.zeros_like(T).at[:, flat].add(
+                    fired.reshape(S, CAP * tile))
+                recv = jax.lax.ppermute(
+                    fired_full, axis,
+                    perm=[(p, p | (1 << b)) for p in range(d)
+                          if not (p >> b) & 1])
+                crossT = crossT | recv
+        Gv = jnp.where(valid[None, :, None], G, jnp.uint32(0))
+        return crossT | jnp.zeros_like(T).at[:, flat].add(
+            Gv.reshape(S, CAP * tile))
+
     def step(carry, xs):
         T, dead, dead_step, maxf = carry
         trans, target, idx = xs
@@ -191,20 +288,41 @@ def _build_local_step(model: Model, cfg: DenseConfig, axis: str, d: int):
         allowed = allowed_mask(t)
 
         def body(st):
-            Tw, n_prev, _c, rounds = st
-            Tw = expand(Tw, trans, allowed)
+            Tw, n_prev, _c, rounds, sp_rounds = st
+            if plan is None:
+                Tw = expand(Tw, trans, allowed)
+                use_sparse = jnp.int32(0)
+            else:
+                occ_t, live_loc = occupancy(Tw)
+                # All-reduced density signal: every device sees the same
+                # global live count AND the worst shard's work-list
+                # pressure, so the branch — and the ppermutes inside it —
+                # is uniform across the mesh.
+                live_g = jax.lax.psum(live_loc, axis)
+                live_max = jax.lax.pmax(live_loc, axis)
+                take = (live_g <= thresh_glob) & (live_max <= CAP)
+                Tw = jax.lax.cond(
+                    take,
+                    lambda T: sweep_sparse(T, trans, allowed, occ_t,
+                                           live_loc),
+                    lambda T: expand(T, trans, allowed),
+                    Tw)
+                use_sparse = take.astype(jnp.int32)
             n_now = jax.lax.psum(
                 jnp.sum(jax.lax.population_count(Tw), dtype=jnp.int32),
                 axis)
-            return Tw, n_now, n_now > n_prev, rounds + 1
+            return (Tw, n_now, n_now > n_prev, rounds + 1,
+                    sp_rounds + use_sparse)
 
         def cond(st):
             return st[2] & (st[3] < cfg.rounds)
 
         n0 = jax.lax.psum(
             jnp.sum(jax.lax.population_count(T), dtype=jnp.int32), axis)
-        T, n, _c, _r = jax.lax.while_loop(
-            cond, body, (T, n0, ~is_pad, jnp.int32(0)))
+        T, n, _c, rounds, sp_rounds = jax.lax.while_loop(
+            cond, body, (T, n0, ~is_pad, jnp.int32(0), jnp.int32(0)))
+        _occ, live_fin = occupancy(T)
+        live_g_fin = jax.lax.psum(live_fin, axis)
 
         pruned = prune(T, t, allowed)
         T_new = jnp.where(is_pad, T, pruned)
@@ -213,28 +331,49 @@ def _build_local_step(model: Model, cfg: DenseConfig, axis: str, d: int):
         died = ~is_pad & ~dead & ~alive
         dead = dead | died
         T_new = jnp.where(dead, jnp.zeros_like(T_new), T_new)
+        sparse_all = ((~is_pad) & (rounds > 0)
+                      & (sp_rounds == rounds)).astype(jnp.int32)
         return (T_new, dead,
                 jnp.where(died & (dead_step < 0), idx, dead_step),
-                jnp.maximum(maxf, n)), jnp.where(is_pad, 0, n)
+                jnp.maximum(maxf, n)), (
+                    jnp.where(is_pad, 0, n),
+                    jnp.where(is_pad, 0, live_g_fin),
+                    jnp.where(is_pad, 0, sparse_all))
 
-    return step, w_loc
+    return step, w_loc, (tile, nt_glob)
+
+
+def lattice_sparse_plan(cfg: DenseConfig, d: int):
+    """The sparse plan for one SHARD of the lattice (None = dense): the
+    work list and tile geometry are sized on the per-device word count,
+    so each shard gathers its own live tiles."""
+    from ..ops.wgl3_sparse import sparse_plan
+
+    return sparse_plan(cfg, words=(1 << (cfg.k_slots - 5)) // d)
 
 
 def make_lattice_chunk_fn(model: Model, cfg: DenseConfig, mesh: Mesh,
-                          axis: str = "lattice"):
-    """jitted (table[S, W] sharded, dead, dead_step, maxf,
-    trans[C,K,S,S'], tgts[C], idx0) -> (table', dead', dead_step', maxf',
-    configs-partial) — the sharded twin of wgl3._chunk_fn. The table stays
-    a mesh-sharded jax.Array between host-loop chunks."""
+                          axis: str = "lattice", plan=None):
+    """(jitted chunk fn, (tile_words, global n_tiles)): the chunk fn is
+    (table[S, W] sharded, dead, dead_step, maxf, trans[C,K,S,S'],
+    tgts[C], idx0) -> (table', dead', dead_step', maxf', f32[4] partials
+    [configs, live-tile sum, real steps, sparse steps]) — the sharded
+    twin of wgl3._chunk_fn. The table stays a mesh-sharded jax.Array
+    between host-loop chunks; the tiling rides along so the caller's
+    sweep_summary denominator is EXACTLY the tiling the kernel swept."""
     d = mesh.shape[axis]
-    step, w_loc = _build_local_step(model, cfg, axis, d)
+    step, w_loc, tiling = _build_local_step(model, cfg, axis, d, plan=plan)
 
     def run(table, dead, dead_step, maxf, trans, tgts, idx0):
         idxs = idx0 + jnp.arange(tgts.shape[0], dtype=jnp.int32)
-        (table, dead, dead_step, maxf), ns = jax.lax.scan(
+        (table, dead, dead_step, maxf), (ns, lives, sp) = jax.lax.scan(
             step, (table, dead, dead_step, maxf), (trans, tgts, idxs))
-        return table, dead, dead_step, maxf, jnp.sum(
-            ns.astype(jnp.float32))
+        parts = jnp.stack([
+            jnp.sum(ns.astype(jnp.float32)),
+            jnp.sum(lives.astype(jnp.float32)),
+            jnp.sum((tgts >= 0).astype(jnp.float32)),
+            jnp.sum(sp.astype(jnp.float32))])
+        return table, dead, dead_step, maxf, parts
 
     specs = dict(
         mesh=mesh,
@@ -245,14 +384,16 @@ def make_lattice_chunk_fn(model: Model, cfg: DenseConfig, mesh: Mesh,
         sharded = shard_map(run, check_vma=False, **specs)
     except TypeError:
         sharded = shard_map(run, check_rep=False, **specs)
-    return jax.jit(sharded)
+    return jax.jit(sharded), tiling
 
 
 def cached_lattice_chunk(model: Model, cfg: DenseConfig, mesh: Mesh,
-                         axis: str = "lattice"):
-    key = ("lattice-chunk", model.cache_key(), cfg, _mesh_key(mesh), axis)
+                         axis: str = "lattice", plan=None):
+    key = ("lattice-chunk", model.cache_key(), cfg, _mesh_key(mesh), axis,
+           plan)
     if key not in _CACHE:
-        _CACHE[key] = make_lattice_chunk_fn(model, cfg, mesh, axis)
+        _CACHE[key] = make_lattice_chunk_fn(model, cfg, mesh, axis,
+                                            plan=plan)
     return _CACHE[key]
 
 
@@ -270,20 +411,26 @@ def check_steps_lattice_long(rs: ReturnSteps, model: Model,
                              time_budget_s: float | None = None) -> dict:
     """Sharded host-chunked dense sweep: the wide-geometry twin of
     wgl3.check_steps3_long. Same result schema, same honest "unknown" on
-    budget expiry; exact otherwise."""
+    budget expiry; exact otherwise. Eligible geometries run the sparse
+    active-tile engine per shard (lattice_sparse_plan; limits().
+    sparse_mode gates it) with the all-reduced density switch — this is
+    the K ~ 20 lane the sparse engine exists for, so the win compounds
+    with the device count."""
     import time as _time
 
     from ..ops.wgl import verdict
+    from ..ops.wgl3 import sweep_summary
 
     t0 = _time.monotonic()
     if mesh is None:
         mesh = lattice_mesh()
     d = int(np.prod(list(mesh.shape.values())))
+    plan = lattice_sparse_plan(cfg, d)
     if chunk is None:
         cells = cfg.n_states * cfg.n_masks // d   # per-device sweep cost
         base = limits().long_scan_chunk
         chunk = min(base, max(128, base * (1 << 15) // max(cells, 1)))
-    run = cached_lattice_chunk(model, cfg, mesh)
+    run, tiling = cached_lattice_chunk(model, cfg, mesh, plan=plan)
     trans_of = _transitions_fn(model, cfg)
     n = rs.n_steps
     n_pad = (n + chunk - 1) // chunk * chunk
@@ -316,13 +463,26 @@ def check_steps_lattice_long(rs: ReturnSteps, model: Model,
         cfgs_dev = part if cfgs_dev is None else cfgs_dev + part
         if bool(np.asarray(dead)):
             break
+    if cfgs_dev is None:
+        cfgs_dev = jnp.zeros((4,), jnp.float32)
+    parts = np.asarray(jnp.clip(cfgs_dev, 0, 2**31 - 1).astype(jnp.int32))
     out = {
         "survived": not bool(np.asarray(dead)),
         "overflow": False,
         "dead_step": int(np.asarray(dead_step)),
         "max_frontier": int(np.asarray(maxf)),
-        "configs_explored": int(np.asarray(
-            jnp.clip(cfgs_dev, 0, 2**31 - 1))),
+        "configs_explored": int(parts[0]),
+        "kernel": ("wgl3-dense-lattice-sparse" if plan is not None
+                   else "wgl3-dense-lattice-sharded"),
     }
+    # Global sweep telemetry: the live counts were psum'd device-side
+    # and `tiling` is exactly (tile_words, global tile count) the
+    # compiled step swept — no recomputation to drift.
+    out["sweep"] = sweep_summary(cfg, live_sum=float(parts[1]),
+                                 real_steps=int(parts[2]),
+                                 sparse_steps=int(parts[3]),
+                                 tiling=tiling)
+    out["live_tile_ratio"] = out["sweep"]["live_tile_ratio"]
     out["valid"] = verdict(out)
+    record_check_result(out)
     return out

@@ -146,7 +146,7 @@ def check_corpus_multislice(encs: Sequence, model, mesh=None
     fn = jax.jit(check, out_shardings={
         "survived": out_spec, "overflow": out_spec,
         "dead_step": out_spec, "max_frontier": out_spec,
-        "configs_explored": out_spec})
+        "configs_explored": out_spec, "live_tile_pm": out_spec})
     out = fn(*global_arrays)
     gathered = {k: np.asarray(multihost_utils.process_allgather(
         v, tiled=True)) for k, v in out.items()}
@@ -157,6 +157,7 @@ def check_corpus_multislice(encs: Sequence, model, mesh=None
         # int like every other backend (the dict path carries f32).
         one["configs_explored"] = int(one["configs_explored"])
         one["kernel"] = "wgl3-dense-multislice"
+        wgl3.attach_live_ratio(one)
         full_results[dense_idx[i]] = one
     kernels.add("wgl3-dense-multislice")
     return full_results, (kernels.pop() if len(kernels) == 1 else "mixed")

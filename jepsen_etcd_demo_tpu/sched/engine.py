@@ -118,6 +118,17 @@ class _Stats:
         self.steps_padded = 0
         self.launches = 0
         self.buckets: dict[int, int] = {}
+        self.sweep_steps_sparse = 0
+        self.sweep_steps_dense = 0
+
+    def record_sweep(self, result: dict) -> None:
+        """Fold a long-sweep result's sparse-engine record (ops/
+        wgl3_sparse.py) into the corpus stats — the scheduler's half of
+        the bench/CLI sweep exposure."""
+        sweep = result.get("sweep")
+        if isinstance(sweep, dict):
+            self.sweep_steps_sparse += int(sweep.get("steps_sparse", 0))
+            self.sweep_steps_dense += int(sweep.get("steps_dense", 0))
 
     def record_launch(self, real: int, b: int, r: int) -> None:
         padded = b * r
@@ -140,6 +151,8 @@ class _Stats:
             "steps_padded": self.steps_padded,
             "padding_waste": (round(self.steps_padded / self.steps_real, 4)
                               if self.steps_real else 0.0),
+            "sweep_steps_sparse": self.sweep_steps_sparse,
+            "sweep_steps_dense": self.sweep_steps_dense,
         }
         return out
 
@@ -199,11 +212,15 @@ def check_corpus(encs: Sequence, model=None, f_cap: int = 256
                  else short_idx).append(i)
 
             # Long histories: host-chunked (now pipelined) sweeps, one at
-            # a time — arrays are never stacked.
+            # a time — arrays are never stacked. Eligible geometries ride
+            # the sparse active-tile engine automatically
+            # (wgl3.check_steps3_long -> sparse_plan); the per-mode step
+            # counts land in the stats dict.
             for i in long_idx:
                 one = wgl3_pallas.run_long_dense(steps_of[i], model, cfg)
                 results[i] = one
                 kernels.add(one["kernel"])
+                stats.record_sweep(one)
 
             # The bucketed batched lanes: group by padded step length,
             # dispatch every launch async, fetch once at drain.

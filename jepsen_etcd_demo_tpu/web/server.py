@@ -48,16 +48,20 @@ def _run_summary(results: dict) -> str:
     return "; ".join(str(b) for b in bits[:4])
 
 
-def _check_perf_columns(run) -> tuple[str, str]:
-    """(throughput, padding-waste) columns for the run index, from the
-    run's metrics.json (obs/): check throughput = encoded history events
-    over the kernels' compile+execute wall, padding waste = the last
-    launch's padded/real step ratio (wgl3._record_padding). Blank when
-    the run has no telemetry or never launched a kernel."""
+def _check_perf_columns(run) -> tuple[str, str, str, str]:
+    """(throughput, padding-waste, sweep-mode, live-tile-ratio) columns
+    for the run index, from the run's metrics.json (obs/): check
+    throughput = encoded history events over the kernels'
+    compile+execute wall, padding waste = the last launch's padded/real
+    step ratio (wgl3._record_padding), sweep mode = which dense-lattice
+    sweep the run's checks took (the wgl.sweep_* counters — sparse
+    engine, ops/wgl3_sparse.py), live tiles = the wgl.live_tile_ratio
+    occupancy gauge. Blank when the run has no telemetry or never
+    launched a kernel."""
     try:
         metrics = read_metrics(run.path / METRICS_FILE)
     except Exception:
-        return "", ""
+        return "", "", "", ""
 
     def counter(name: str) -> float:
         rec = metrics.get(name) or {}
@@ -69,7 +73,20 @@ def _check_perf_columns(run) -> tuple[str, str]:
     eps = f"{events / kernel_s:,.0f}/s" if events and kernel_s else ""
     ratio = (metrics.get("wgl.step_padding_ratio") or {}).get("last")
     waste = f"{ratio:.2f}x" if isinstance(ratio, (int, float)) else ""
-    return eps, waste
+    sp = counter("wgl.sweep_steps_sparse")
+    dn = counter("wgl.sweep_steps_dense")
+    if sp and dn:
+        sweep = f"mixed ({100 * sp / (sp + dn):.0f}% sp)"
+    elif sp:
+        sweep = "sparse"
+    elif dn or counter("wgl.sweep_checks_dense") \
+            or counter("wgl.sweep_checks_mixed"):
+        sweep = "dense"
+    else:
+        sweep = ""
+    lt = (metrics.get("wgl.live_tile_ratio") or {}).get("last")
+    live = f"{lt:.1%}" if isinstance(lt, (int, float)) else ""
+    return eps, waste, sweep, live
 
 
 def _index_html(store: Store) -> str:
@@ -91,7 +108,7 @@ def _index_html(store: Store) -> str:
         if (run.path / TELEMETRY_FILE).exists():
             thref = urllib.parse.quote(f"/telemetry/{rel}")
             tele = f"<a href='{thref}'>telemetry</a>"
-        eps, waste = _check_perf_columns(run)
+        eps, waste, sweep, live = _check_perf_columns(run)
         rows.append(
             f"<tr><td><a href='{href}'>"
             f"{html.escape(str(rel))}</a></td>"
@@ -99,6 +116,8 @@ def _index_html(store: Store) -> str:
             f"<td style='color:#666'>{html.escape(summary)}</td>"
             f"<td>{html.escape(eps)}</td>"
             f"<td>{html.escape(waste)}</td>"
+            f"<td>{html.escape(sweep)}</td>"
+            f"<td>{html.escape(live)}</td>"
             f"<td>{tele}</td></tr>")
     return (
         "<!doctype html><html><head><meta charset='utf-8'>"
@@ -107,12 +126,27 @@ def _index_html(store: Store) -> str:
         "</head><body><h2>test runs</h2>"
         f"<table><tr><th>run</th><th>valid</th><th>detail</th>"
         f"<th>check eps</th><th>pad waste</th>"
+        f"<th>sweep</th><th>live tiles</th>"
         f"<th>obs</th></tr>"
         f"{''.join(rows)}</table>"
         "</body></html>")
 
 
 # -- telemetry page --------------------------------------------------------
+
+def _perf_summary_html(run_dir) -> str:
+    """Compact per-run strip on the telemetry page mirroring the index's
+    perf columns (check eps / pad waste / sweep mode / live-tile ratio);
+    empty when the run recorded none of them."""
+    class _Run:
+        path = run_dir
+
+    eps, waste, sweep, live = _check_perf_columns(_Run)
+    bits = [("check eps", eps), ("pad waste", waste), ("sweep", sweep),
+            ("live tiles", live)]
+    shown = [f"{name}: <b>{html.escape(val)}</b>"
+             for name, val in bits if val]
+    return f"<p class='a'>{' · '.join(shown)}</p>" if shown else ""
 
 def _fmt_ms(ns: int) -> str:
     return f"{ns / 1e6:,.1f}"
@@ -210,6 +244,7 @@ def _telemetry_html(store: Store, rel: str) -> str | None:
         f"<h2>telemetry — {html.escape(rel)}</h2>",
         f"<p><a href='/'>index</a> · "
         f"<a href='{urllib.parse.quote(f'/files/{rel}/')}'>run files</a></p>",
+        _perf_summary_html(run_dir),
     ]
     if tele.exists():
         records = read_jsonl(tele)

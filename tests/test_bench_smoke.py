@@ -40,13 +40,41 @@ def test_sched_corpus_lane_contract():
 
 def test_bench_error_path_reports_degraded_contract_fields(monkeypatch,
                                                            capsys):
-    """When BOTH the default and the CPU probes fail, the abort line must
-    still carry the scheduler contract fields as zeros (never absent)."""
+    """ISSUE 3 satellite (BENCH_r05 regression): when BOTH the default
+    and the CPU probes fail, the bench must exit 0 with the FULL tagged
+    record — every contract field present as zeros, degraded true,
+    backend "none", and the probe diagnosis in error/detail — instead of
+    rc 1 with a bare value-0 line."""
     monkeypatch.setattr(bench, "_backend_alive",
                         lambda *a, **k: (False, "probe stubbed"))
-    assert bench.main() == 1
+    assert bench.main() == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["value"] == 0
+    assert out["kernel_phases"] == {"compile_s": 0.0, "execute_s": 0.0,
+                                    "encode_s": 0.0, "frontier_peak": 0}
     assert out["padding_waste"] == 0.0
     assert out["cache_hit_rate"] == 0.0
-    assert out["degraded"] is False
+    assert out["sweep"]["live_tile_ratio"] == 0.0
+    assert out["sweep"]["steps_sparse"] == 0
+    assert out["degraded"] is True
+    assert out["backend"] == "none"
+    assert "probe stubbed" in out["error"]
+    assert out["detail"]["probe"]["default"] == "probe stubbed"
+
+
+def test_sparse_lane_contract():
+    """The bench's sparse lane at tiny scale: dense/sparse events-per-
+    second fields present, verdict equivalence asserted inside the lane,
+    live-tile ratio measured, sweep-mode counts consistent."""
+    model = CASRegister()
+    lane = bench.bench_sparse(model, n_ops=200, k_slots=13)
+    for key in ("dense_events_per_sec", "sparse_events_per_sec",
+                "live_tile_ratio", "sweep", "speedup_vs_dense", "kernel"):
+        assert key in lane, key
+    json.dumps(lane)
+    assert lane["kernel"] == "wgl3-dense-sparse-chunked"
+    assert 0.0 < lane["live_tile_ratio"] <= 1.0
+    sweep = lane["sweep"]
+    assert sweep["mode"] in ("sparse", "mixed")
+    assert sweep["steps_sparse"] > 0
+    assert sweep["steps_sparse"] + sweep["steps_dense"] <= lane["events"]

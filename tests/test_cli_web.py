@@ -348,6 +348,42 @@ def test_index_shows_failure_detail(tmp_path):
         httpd.shutdown()
 
 
+def test_index_and_telemetry_show_sweep_columns(tmp_path):
+    """ISSUE 3 satellite: the run index gains sweep-mode and
+    live-tile-ratio columns (next to check-eps / pad-waste), and the
+    per-run telemetry page mirrors them in its summary strip — fed from
+    the wgl.sweep_* counters and wgl.live_tile_ratio gauge in
+    metrics.json."""
+    run = tmp_path / "store" / "fake" / "20260803T000000"
+    run.mkdir(parents=True)
+    (run / "results.json").write_text(json.dumps({"valid": True}))
+    (run / "telemetry.jsonl").write_text("")
+    (run / "metrics.json").write_text(json.dumps({"metrics": {
+        "wgl.sweep_steps_sparse": {"type": "counter", "value": 120},
+        "wgl.sweep_steps_dense": {"type": "counter", "value": 40},
+        "wgl.live_tile_ratio": {"type": "gauge", "last": 0.0625,
+                                "min": 0.01, "max": 0.2, "n": 5},
+    }}))
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                make_handler(str(tmp_path / "store")))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+    try:
+        idx = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/").read().decode()
+        assert "<th>sweep</th>" in idx
+        assert "<th>live tiles</th>" in idx
+        assert "mixed (75% sp)" in idx
+        assert "6.2%" in idx
+        tele = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/telemetry/fake/20260803T000000"
+        ).read().decode()
+        assert "mixed (75% sp)" in tele
+        assert "live tiles" in tele
+    finally:
+        httpd.shutdown()
+
+
 def test_index_shows_whole_history_failure_detail(tmp_path):
     """A failed mutex (whole-history) run's index row names the failing op
     — there are no per-key results for these workloads."""

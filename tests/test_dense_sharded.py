@@ -60,8 +60,10 @@ def test_ragged_batch_pads_and_strips():
 
 
 def test_launch_is_actually_sharded():
-    """The per-device shard shape proves the partition: [B/D, 5] on each
-    of the D devices, sharding spec named over the batch axis."""
+    """The per-device shard shape proves the partition: [B/D, 6] on each
+    of the D devices (wgl3.PACKED_FIELDS_XLA: the 5 verdict fields +
+    the live-tile telemetry column), sharding spec named over the batch
+    axis."""
     encs = _corpus(16, seed=0x5A)
     mesh = pdense.batch_mesh()
     d = mesh.shape["batch"]
@@ -70,11 +72,12 @@ def test_launch_is_actually_sharded():
     arrays, _b = pdense.pad_batch_arrays(wgl3.stack_steps3(steps, r_cap), d)
     check = pdense.sharded_batch_checker3_packed(MODEL, cfg, mesh)
     out = check(*(jnp.asarray(a) for a in arrays))
-    assert out.shape == (16, 5)
+    w = len(wgl3.PACKED_FIELDS_XLA)
+    assert out.shape == (16, w)
     spec = out.sharding.spec
     assert spec[0] == "batch", spec
     shard_shapes = {s.data.shape for s in out.addressable_shards}
-    assert shard_shapes == {(16 // d, 5)}
+    assert shard_shapes == {(16 // d, w)}
 
 
 def test_auto_router_takes_sharded_path():
@@ -110,7 +113,9 @@ def test_pallas_sharded_interpret_matches_xla_sharded():
     pal = np.asarray(
         pdense.sharded_batch_checker_pallas(MODEL, cfg, mesh,
                                             interpret=True)(*jarrays))
-    np.testing.assert_array_equal(xla, pal)
+    # XLA packs the extra live-tile telemetry column; the verdict fields
+    # must agree bit for bit.
+    np.testing.assert_array_equal(xla[:, :pal.shape[1]], pal)
 
 
 def test_independent_checker_rides_sharded_batch(tmp_path):
@@ -155,7 +160,7 @@ def test_pallas_grouped_sharded_interpret_matches_xla_sharded():
         pdense.sharded_batch_checker_pallas(MODEL, cfg, mesh,
                                             interpret=True,
                                             group=2)(*jarrays))
-    np.testing.assert_array_equal(xla, pal)
+    np.testing.assert_array_equal(xla[:, :pal.shape[1]], pal)
 
 
 def test_batch_multiple_routing():

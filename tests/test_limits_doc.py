@@ -1,0 +1,34 @@
+"""Tier-1 wiring of tools/check_limits_doc.py: every KernelLimits field
+(ops/limits.py) must appear — as a backticked code span — in doc/perf.md's
+"KernelLimits reference" table, so new tuning knobs cannot land
+undocumented (ISSUE 3 satellite; PR 2's four knobs audited too)."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+TOOLS = Path(__file__).resolve().parent.parent / "tools"
+sys.path.insert(0, str(TOOLS))
+
+import check_limits_doc  # noqa: E402
+
+
+def test_every_limits_field_documented():
+    missing = check_limits_doc.missing_fields()
+    assert not missing, (
+        f"KernelLimits fields missing from doc/perf.md: {missing} — "
+        f"add them to the 'KernelLimits reference' table")
+
+
+def test_lint_detects_missing_field(tmp_path):
+    """The lint actually fails when a field is absent (guards against a
+    vacuous check)."""
+    doc = tmp_path / "perf.md"
+    text = check_limits_doc.DOC.read_text(encoding="utf-8")
+    doc.write_text(text.replace("`sparse_tile_words`", "(redacted)"))
+    assert check_limits_doc.missing_fields(doc) == ["sparse_tile_words"]
+
+
+def test_cli_entry_exits_zero():
+    assert check_limits_doc.main() == 0

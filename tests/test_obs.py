@@ -279,8 +279,11 @@ def test_bench_error_path_always_emits_kernel_phases(monkeypatch, capsys):
 
     monkeypatch.setattr(bench, "_backend_alive",
                         lambda *a, **k: (False, "probe stubbed"))
-    assert bench.main() == 1
+    # ISSUE 3: the all-probes-dead path exits 0 with the full tagged
+    # record (degraded/backend present) instead of rc 1.
+    assert bench.main() == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["value"] == 0
+    assert out["degraded"] is True and out["backend"] == "none"
     assert out["kernel_phases"] == {"compile_s": 0.0, "execute_s": 0.0,
                                     "encode_s": 0.0, "frontier_peak": 0}

@@ -94,5 +94,14 @@ def test_resumable_raises_at_capacity_ceiling():
     h = _big_value_history(rng, n_ops=60, n_procs=10, p_info=0.2)
     enc = encode_register_history(h, k_slots=32)
     rs = encode_return_steps(enc)
-    with pytest.raises(MemoryError):
+    with pytest.raises(MemoryError) as ei:
         check_steps_resumable(rs, model, f_cap=2, chunk=16, f_cap_max=4)
+    # ISSUE 3 satellite: the overflow diagnosis must name the capacity
+    # reached, the chunk boundary, and the exact limits()/env knob that
+    # raises the ceiling — an operator can act on it without reading
+    # the source.
+    msg = str(ei.value)
+    assert "f_cap_max=4" in msg
+    assert "chunk boundary" in msg and "chunk=16" in msg
+    assert "JEPSEN_TPU_LIMIT_SORT_ROW_BUDGET" in msg
+    assert "sort_row_budget" in msg

@@ -248,9 +248,13 @@ def test_general_ladder_detects_invalid_and_reports_kernel():
                                             f_cap=4, f_cap_max=16)
     assert out["valid"] is False
     # On a multi-device platform (the test mesh) the dense rung runs
-    # lattice-sharded; single-device it is the host-chunked sweep.
+    # lattice-sharded; single-device it is the host-chunked sweep — each
+    # under the sparse active-tile engine when the geometry is eligible
+    # (ops/wgl3_sparse.py stamps the -sparse names).
     assert out["kernel"] in ("wgl3-dense-chunked",
-                             "wgl3-dense-lattice-sharded")
+                             "wgl3-dense-sparse-chunked",
+                             "wgl3-dense-lattice-sharded",
+                             "wgl3-dense-lattice-sparse")
     assert out["dead_step"] >= 0
     want = check_events_oracle(enc, CASRegister())
     assert want.valid is False

@@ -202,7 +202,9 @@ def test_grouped_kernel_bit_identical_ragged():
     ref = np.asarray(wgl3.cached_batch_checker3_packed(MODEL, cfg)(*arrays))
     got = np.asarray(wgl3_pallas.cached_batch_checker_pallas_grouped(
         MODEL, cfg, group=8, interpret=True)(*arrays))
-    np.testing.assert_array_equal(ref, got)
+    # The XLA packed result carries the extra live-tile telemetry
+    # column; the 5 verdict fields must agree bit for bit.
+    np.testing.assert_array_equal(ref[:, :got.shape[1]], got)
 
 
 def test_grouped_kernel_multi_chunk_carry():
@@ -225,7 +227,7 @@ def test_grouped_kernel_multi_chunk_carry():
             MODEL, cfg, group=8, interpret=True)(*arrays))
     finally:
         set_limits(prev)
-    np.testing.assert_array_equal(ref, got)
+    np.testing.assert_array_equal(ref[:, :got.shape[1]], got)
 
 
 def test_resumable_long_sweep_matches_xla_chunked():

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Lint: every `KernelLimits` field must be documented in doc/perf.md.
+
+PR 2 added four tuning knobs and PR 3 five more; a knob that exists only
+as a dataclass field is invisible to operators (the env override
+`JEPSEN_TPU_LIMIT_<FIELD>` is derived from the field name, so the doc
+table is the only place a human can discover it). This script asserts
+the "`KernelLimits` reference" table in doc/perf.md names every field —
+wired into tier-1 (tests/test_limits_doc.py) so a new knob cannot land
+undocumented.
+
+Usage: python tools/check_limits_doc.py  (exit 1 + the missing names).
+Importable: `missing_fields()` returns the undocumented field names.
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import fields
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+DOC = REPO / "doc" / "perf.md"
+
+
+def limit_field_names() -> list[str]:
+    sys.path.insert(0, str(REPO))
+    from jepsen_etcd_demo_tpu.ops.limits import KernelLimits
+
+    return [f.name for f in fields(KernelLimits)]
+
+
+def missing_fields(doc_path: Path = DOC) -> list[str]:
+    """KernelLimits field names not mentioned (as `field` code spans) in
+    the perf doc."""
+    text = doc_path.read_text(encoding="utf-8")
+    return [name for name in limit_field_names()
+            if f"`{name}`" not in text]
+
+
+def main() -> int:
+    missing = missing_fields()
+    if missing:
+        print(f"{DOC.relative_to(REPO)} is missing documentation for "
+              f"{len(missing)} KernelLimits field(s):", file=sys.stderr)
+        for name in missing:
+            print(f"  - {name} (env JEPSEN_TPU_LIMIT_{name.upper()})",
+                  file=sys.stderr)
+        print("Add each to the 'KernelLimits reference' table in "
+              "doc/perf.md.", file=sys.stderr)
+        return 1
+    print(f"ok: all {len(limit_field_names())} KernelLimits fields "
+          f"documented in {DOC.relative_to(REPO)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
