@@ -536,6 +536,81 @@ def bench_sparse(model, n_ops: int = 150, k_slots: int = 20) -> dict:
     return lane
 
 
+def bench_tuned(model, n_hist: int = 128, ops_range=(20, 300)) -> dict:
+    """Tuned-profile lane (ISSUE 4 tentpole): ONE mixed-length corpus
+    through the bucketed scheduler under the DATACLASS-DEFAULT limits
+    profile, then under this platform's persisted tuning profile
+    (tune/profile.py — whatever `jepsen-tpu tune` measured on this
+    machine; the two arms are identical when no profile exists and the
+    lane says so). Verdicts are asserted identical between arms — a
+    tuned profile reroutes and re-chunks, it must never change an
+    answer — and the lane reports both arms' events/s,
+    `speedup_vs_default`, and the active profile hash. CPU-provable
+    (tests/test_bench_smoke.py), so the degraded rerun keeps it."""
+    from jepsen_etcd_demo_tpu import sched
+    from jepsen_etcd_demo_tpu.ops.limits import KernelLimits, set_limits
+    from jepsen_etcd_demo_tpu.tune import profile as tune_profile
+
+    encs = build_mixed_corpus(n_hist, ops_range, seed=0x7D4E)
+    events = int(sum(e.n_events for e in encs))
+    # tuned_limits() is None while the platform is undetermined (no
+    # initialized backend yet) — treat as "none apply" for the lane.
+    tuned_fields = tune_profile.tuned_limits() or {}
+    lane = {
+        "histories": n_hist,
+        "events": events,
+        "profile_hash": tune_profile.profile_hash(),
+        "tuned_fields": len(tuned_fields),
+        "tuned": bool(tuned_fields),
+    }
+    verdicts = {}
+    # set_limits installs a COMPLETE profile (beating the tuned file,
+    # ops/limits.py precedence), so the default arm measures the shipped
+    # dataclass values even on a machine with a profile; arm two clears
+    # the programmatic override so the tuned profile resolves again.
+    # set_limits returns the previous programmatic state (None included),
+    # so the finally restores exactly what an embedding caller had.
+    prev_set = set_limits(KernelLimits())
+    try:
+        for arm, prof in (("default", KernelLimits()), ("tuned", None)):
+            set_limits(prof)
+            results, kernel, _stats = sched.check_corpus(encs, model)  # warm
+            best = float("inf")
+            for _ in range(REPEATS):
+                t0 = time.perf_counter()
+                results, kernel, _stats = sched.check_corpus(encs, model)
+                best = min(best, time.perf_counter() - t0)
+            verdicts[arm] = results
+            lane[f"{arm}_s"] = round(best, 4)
+            lane[f"{arm}_events_per_sec"] = round(events / best, 1)
+    finally:
+        set_limits(prev_set)
+    assert verdicts["default"] == verdicts["tuned"], \
+        "tuned profile changed a verdict"
+    lane["speedup_vs_default"] = (
+        round(lane["default_s"] / lane["tuned_s"], 3)
+        if lane["tuned_s"] else 0.0)
+    return lane
+
+
+def _profile_record() -> dict:
+    """The profile stamp every bench record carries (degraded path
+    included — a degraded run still states which profile it intended to
+    use): active hash, tuned-field count, every non-default field with
+    its provenance tag, and the tool that prints the full table."""
+    try:
+        from jepsen_etcd_demo_tpu.tune import profile as tune_profile
+
+        rec = tune_profile.run_record()
+    except Exception:
+        from jepsen_etcd_demo_tpu import obs
+
+        rec = {"hash": obs.active_profile_hash(), "tuned_fields": 0,
+               "overrides": {}}
+    rec["inspect"] = "python tools/print_profile.py"
+    return rec
+
+
 def bench_invalid_lane(model) -> dict:
     """Mixed-validity certification of the COMPILED pallas kernels
     (VERDICT r3 item 2: every prior bench lane was valid-by-construction,
@@ -842,6 +917,9 @@ def main():
                 "padding_waste": 0.0,
                 "cache_hit_rate": 0.0,
                 "sweep": obs.sweep_stats(None),
+                # Which tuning profile the run INTENDED to use (ISSUE 4:
+                # tools/print_profile.py prints the full resolved view).
+                "profile": _profile_record(),
                 "degraded": True,
                 "backend": "none",
                 "detail": {"probe": {"default": reason,
@@ -895,6 +973,9 @@ def main():
         # Sparse active-tile lane: dense-vs-sparse sweep on one wide
         # long history (ISSUE 3) — the win measured, not asserted.
         sparse_lane = bench_sparse(model)
+        # Tuned-profile lane (ISSUE 4): default vs tuned-profile limits
+        # on one corpus, verdicts asserted identical, speedup measured.
+        tuned_lane = bench_tuned(model)
         # Inside the capture: the 100k lane's compile/execute/encode
         # seconds must land in the same kernel_phases breakdown as every
         # other lane when it actually runs.
@@ -928,6 +1009,7 @@ def main():
         "invalid_lane": invalid_lane,
         "corpus_sched": sched_lane,
         "sparse": sparse_lane,
+        "tuned": tuned_lane,
     }
     if "roofline" in corpus:
         detail["roofline"] = corpus["roofline"]
@@ -961,6 +1043,9 @@ def main():
         # capture (doc/perf.md): live-tile-ratio gauge + per-mode step/
         # check counters — zeros permitted, never absent.
         "sweep": obs.sweep_stats(cap.metrics),
+        # The tuning profile this round resolved (ISSUE 4): hash +
+        # non-default fields with provenance; detail.tuned measures it.
+        "profile": _profile_record(),
         "degraded": degraded,
         "backend": "cpu" if degraded else jax.default_backend(),
         "detail": detail,

@@ -174,6 +174,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "(CI / one-machine dryrun)")
     _add_sweep_mode_flag(c)
 
+    u = sub.add_parser(
+        "tune",
+        help="autotune the KernelLimits knob space on THIS machine and "
+             "persist a tuning profile (tune/; doc/perf.md 'Autotuning')")
+    u.add_argument("--budget-s", type=positive_float, default=120.0,
+                   help="wall-clock probe budget; expiry keeps defaults "
+                        "for un-probed knobs (default 120)")
+    u.add_argument("--knobs", default=None,
+                   help="comma-separated KernelLimits field or probe-"
+                        "group names (default: every knob with a probe "
+                        "group; groups: dense_sweep, sparse, sched, "
+                        "pipeline, pallas)")
+    u.add_argument("--repeats", type=positive_int, default=2,
+                   help="best-of repeats per measurement (default 2)")
+    u.add_argument("--scale", type=positive_float, default=1.0,
+                   help="probe fixture size multiplier (CI smokes use "
+                        "~0.1; default 1.0)")
+    u.add_argument("--dry-run", action="store_true",
+                   help="measure and print, persist nothing")
+    u.add_argument("--print-profile", action="store_true",
+                   help="print the RESOLVED active limits with per-field "
+                        "provenance (env/set/tuned/default) and exit — "
+                        "no probing (tools/print_profile.py equivalent)")
+    u.add_argument("--store", default="store",
+                   help="results store root (locates the persistent "
+                        "compile cache the probes warm)")
+
     s = sub.add_parser("serve", help="serve the results store over http")
     s.add_argument("--port", type=int, default=8080)
     s.add_argument("--host", default="127.0.0.1")
@@ -197,15 +224,43 @@ def _add_sweep_mode_flag(parser) -> None:
              "debug lane). Verdicts are bit-identical in every mode.")
 
 
+# What _apply_sweep_mode displaced, so a later in-process invocation
+# WITHOUT --sweep-mode restores it (None = nothing displaced yet;
+# (original,) = the operator's prior env value or None). The flag must
+# not leak across cli.main() calls, nor permanently clobber an
+# operator-exported JEPSEN_TPU_LIMIT_SPARSE_MODE.
+_SWEEP_ENV_DISPLACED: tuple | None = None
+
+
 def _apply_sweep_mode(args) -> None:
+    global _SWEEP_ENV_DISPLACED
+    import os
+
+    from ..ops import limits as limits_mod
+
+    var = limits_mod.env_var("sparse_mode")
     mode = getattr(args, "sweep_mode", None)
     if mode is None:
+        if _SWEEP_ENV_DISPLACED is not None:
+            (orig,) = _SWEEP_ENV_DISPLACED
+            if orig is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = orig
+            _SWEEP_ENV_DISPLACED = None
+            limits_mod._reload()
         return
-    from dataclasses import replace
-
-    from ..ops.limits import limits, set_limits
-
-    set_limits(replace(limits(), sparse_mode=SWEEP_MODES[mode]))
+    # Through the ENV layer, not set_limits: this runs before any jax
+    # backend exists, so a set_limits(replace(limits(), ...)) here would
+    # freeze a resolution snapshot that can never include the machine's
+    # tuned profile (ops/limits.py loads it lazily once jax is up). An
+    # env override composes — it pins exactly this one field (provenance
+    # "env", inherited by subprocesses, which is what a CLI-wide mode
+    # switch means) and still lets the tuned profile drive the rest.
+    if _SWEEP_ENV_DISPLACED is None:
+        _SWEEP_ENV_DISPLACED = (os.environ.get(var),)
+    os.environ[var] = str(SWEEP_MODES[mode])
+    limits_mod._reload()
 
 
 def _read_nodes(args) -> list[str]:
@@ -509,6 +564,37 @@ def _cmd_corpus_checked(args, multislice: bool) -> int:
     return 0 if not invalid else 1
 
 
+def cmd_tune(args) -> int:
+    """`jepsen-tpu tune`: measure the KernelLimits knob space on this
+    machine (tune/probes.py fixed-seed microbenchmarks, tune/search.py
+    bounded coordinate descent) and persist the winning values as this
+    platform's tuning profile — auto-loaded by limits() on every later
+    run with precedence env > set_limits > tuned profile > default."""
+    from .. import obs
+    from ..tune import resolve_knobs, run_tune
+    from ..tune import profile as tune_profile
+
+    # The compile-cache dir must be enabled BEFORE any profile-path
+    # resolution: tuned_profile.json lives next to the cache, and a
+    # --print-profile that skipped this would report the home-cache file
+    # while real `--store` runs resolve <store>/.xla-cache's.
+    enable_compilation_cache(args.store)
+    if args.print_profile:
+        print(json.dumps(tune_profile.report(), indent=2))
+        return 0
+    try:
+        knobs = resolve_knobs(args.knobs)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    with obs.capture():
+        out = run_tune(knobs=knobs, budget_s=args.budget_s,
+                       repeats=args.repeats, scale=args.scale,
+                       dry_run=args.dry_run)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
 def cmd_serve(args) -> int:
     from ..web.server import serve
     serve(args.store, host=args.host, port=args.port)
@@ -560,6 +646,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return cmd_analyze(args)
     if args.command == "corpus":
         return cmd_corpus(args)
+    if args.command == "tune":
+        return cmd_tune(args)
     if args.command == "serve":
         return cmd_serve(args)
     return 2

@@ -236,12 +236,28 @@ def record_check_result(res: dict) -> None:
         m.counter("wgl.sweep_checks_dense").add(1)
 
 
+def active_profile_hash() -> str:
+    """The active tuning profile's short hash (tune/profile.py), or
+    "default". Never initializes a jax backend (the profile key resolves
+    only when jax is already imported) and never raises — safe to stamp
+    on the bench's degraded/unreachable-backend records."""
+    try:
+        from ..tune import profile
+
+        return profile.profile_hash()
+    except Exception:
+        return "default"
+
+
 def kernel_phases(metrics: Optional[MetricsRegistry] = None) -> dict:
     """The bench's kernel-phase breakdown, from a registry snapshot.
     With no registry (backend unreachable, telemetry disabled) every
-    field is zero — the contract is "zeros permitted, never absent"."""
+    timing field is zero — the contract is "zeros permitted, never
+    absent". `profile_hash` identifies the tuning profile the process
+    resolved (ISSUE 4: every bench record names its profile, the
+    degraded path included — "default" when none applies)."""
     out = {"compile_s": 0.0, "execute_s": 0.0, "encode_s": 0.0,
-           "frontier_peak": 0}
+           "frontier_peak": 0, "profile_hash": active_profile_hash()}
     if metrics is None or not metrics.enabled:
         return out
     snap = metrics.snapshot()

@@ -33,6 +33,14 @@ measurement entirely, and 0 disables oracle routing (bench.py pins 0 for
 its kernel lanes). Persistence is keyed by the JAX backend + device kind,
 so one cache file serves a laptop CPU run and a TPU pod worker without
 cross-talk.
+
+Persistence lives in the SHARED tuning-profile store (tune/profile.py —
+ISSUE 4: one file, one version bump discipline) as this platform's
+``calibration`` section. The pre-autotuner ``calibration.json`` sidecar
+is a LEGACY migration source: read once when the store has no
+calibration for this platform, re-persisted into the store, and ignored
+thereafter (the store's copy is authoritative even if the sidecar later
+changes).
 """
 
 from __future__ import annotations
@@ -86,9 +94,10 @@ _CAL: Calibration | None = None
 
 
 def calibration_path() -> str:
-    """Lives next to the persistent XLA compile cache (cli/main.py
-    enable_compilation_cache) — same lifecycle: a per-user, per-machine
-    measurement cache."""
+    """The LEGACY sidecar path (next to the persistent XLA compile
+    cache). New calibrations persist into the shared tuning-profile
+    store (tune/profile.py); this file is only ever read, once, as a
+    migration source."""
     base = os.environ.get("JAX_COMPILATION_CACHE_DIR",
                           os.path.expanduser("~/.cache/jepsen_tpu_xla"))
     return os.path.join(base, "calibration.json")
@@ -159,40 +168,59 @@ def measure() -> Calibration:
         measured_at=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
 
 
-def _load() -> Calibration | None:
+def _validate(data) -> Calibration | None:
+    """A Calibration from a raw dict, or None when it is torn, from an
+    older probe (CAL_VERSION mismatch), or from another platform."""
     try:
-        data = json.loads(open(calibration_path()).read())
         cal = Calibration(**data)
-    except (OSError, ValueError, TypeError):
+    except (ValueError, TypeError):
         return None
     if cal.version != CAL_VERSION or cal.platform != platform_tag():
         return None
     return cal
 
 
-def _persist(cal: Calibration) -> None:
-    path = calibration_path()
-    try:
-        import tempfile
+def _load() -> Calibration | None:
+    """This platform's calibration from the shared profile store."""
+    from ..tune import profile
 
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        # Atomic replace: pod workers share this cache dir, and a torn
-        # read would send the reader back into a full re-measure.
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
-        with os.fdopen(fd, "w") as f:
-            json.dump(asdict(cal), f, indent=2)
-        os.replace(tmp, path)
-    except OSError:
-        pass    # persistence is an optimization, never a failure mode
+    data = profile.load_calibration()
+    return None if data is None else _validate(data)
+
+
+def _load_legacy_sidecar() -> Calibration | None:
+    """The pre-ISSUE-4 calibration.json sidecar, consulted only when the
+    profile store has no calibration for this platform (the migration
+    read — after re-persisting into the store, the sidecar is ignored
+    even if it changes)."""
+    try:
+        data = json.loads(open(calibration_path()).read())
+    except (OSError, ValueError):
+        return None
+    return _validate(data) if isinstance(data, dict) else None
+
+
+def _persist(cal: Calibration) -> None:
+    """Into the shared profile store (atomic replace inside); like the
+    old sidecar write, persistence is an optimization, never a failure
+    mode."""
+    from ..tune import profile
+
+    profile.save_calibration(asdict(cal))
 
 
 def get_calibration() -> Calibration:
-    """Active calibration: in-memory, else persisted (if it matches this
-    platform), else measured now and persisted."""
+    """Active calibration: in-memory, else the profile store, else the
+    legacy sidecar (migrated into the store on first read), else
+    measured now and persisted into the store."""
     global _CAL
     if _CAL is not None:
         return _CAL
     cal = _load()
+    if cal is None:
+        cal = _load_legacy_sidecar()
+        if cal is not None:
+            _persist(cal)           # migrate: store copy is authoritative
     if cal is None:
         cal = measure()
         _persist(cal)

@@ -3,24 +3,64 @@
 Round-2 review (VERDICT.md weak #4): the dense/sort/pallas kernels had one
 specific deployment's kill and allocation thresholds (the axon TPU worker
 tunnel) baked into library control flow as magic numbers. They live here
-instead, as ONE dataclass whose default instance IS the axon profile; a pod
-or a newer runtime overrides per-field via environment variables
-(``JEPSEN_TPU_LIMIT_<FIELD>=<int>``, upper-cased field name) or
-programmatically via :func:`set_limits`.
+instead, as ONE dataclass whose default instance IS the axon profile.
 
-Two kinds of fields, flagged per-field below:
-  * [worker]  — empirical envelope of the axon worker (program-kill timeout,
-    allocation faults, SMEM prefetch ceiling). Wrong on other deployments in
-    the conservative direction only: raising them on a roomier runtime is
-    safe and buys speed.
-  * [arch]    — derived from TPU architecture (VMEM block budget, unroll
-    cost). Portable across deployments of the same chip family.
+Three kinds of fields, flagged per-field via ``field(metadata=...)`` and
+surfaced in doc/perf.md's reference table (tools/check_limits_doc.py
+enforces the tag + safe range on every field):
+  * [worker]   — empirical envelope of the axon worker (program-kill
+    timeout, allocation faults, SMEM prefetch ceiling). Wrong on other
+    deployments in the conservative direction only: raising them on a
+    roomier runtime is safe and buys speed. The autotuner (tune/) never
+    probes these past their default in the RISKY direction.
+  * [arch]     — derived from TPU architecture (VMEM block budget, unroll
+    cost) or a semantic mode switch. Portable across deployments of the
+    same chip family; not searched by default.
+  * [tunable]  — pure performance knobs (chunk sizes, bucket floors,
+    crossovers, pipeline depths) whose best value is a property of the
+    MACHINE, measured by ``jepsen-tpu tune`` and persisted per
+    ``(backend, device kind, device count)`` (tune/profile.py).
+
+Resolution precedence, per field (doc/perf.md "Autotuning"):
+
+    JEPSEN_TPU_LIMIT_<FIELD> env  >  set_limits()  >  tuned profile
+                                  >  dataclass default
+
+``limits()`` returns the resolved instance; ``limits_provenance()`` says
+where each field's value came from (``env``/``set``/``tuned``/
+``default``) — ``tools/print_profile.py`` dumps both. A malformed env
+override (non-int, or outside the field's safe range) raises
+:class:`LimitsEnvError` naming the variable and the accepted range, at
+import/reload time — loudly, not as a bare ``ValueError`` from ``int()``.
 """
 
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, fields, replace
+from dataclasses import dataclass, field, fields, replace
+
+# Provenance kinds for the doc/tooling contract (tools/check_limits_doc.py
+# asserts every field's doc row carries its tag + safe range).
+KINDS = ("worker", "arch", "tunable")
+
+
+def _f(default: int, kind: str, lo: int, hi: int, *, group: str | None = None,
+       conservative: str | None = None):
+    """A KernelLimits field: default + machine-readable tuning metadata.
+
+    kind         — worker/arch/tunable (module docstring).
+    (lo, hi)     — the SAFE range: env overrides and tuner candidates are
+                   validated against it.
+    group        — probe group the autotuner measures this knob under
+                   (tune/probes.py); None = not searched.
+    conservative — for [worker] fields the tuner may still touch: "down"
+                   means only values <= default are safe to probe ("up"
+                   the reverse). The search clamps candidates accordingly.
+    """
+    assert kind in KINDS, kind
+    return field(default=default, metadata={
+        "kind": kind, "range": (lo, hi), "group": group,
+        "conservative": conservative})
 
 
 @dataclass(frozen=True)
@@ -29,150 +69,317 @@ class KernelLimits:
     # builds per history. Past K ~ 17 the live frontier is invariably tiny
     # relative to the lattice (sort kernel wins), and a K=20 dense chunk
     # measured ~35 s per 4k steps on axon — near its program-kill window.
-    dense_cell_budget: int = 1 << 20
+    dense_cell_budget: int = _f(1 << 20, "worker", 1 << 8, 1 << 30)
     # [worker] Relaxed cell budget for the CHUNKED dense rung (host-driven
     # loop of small scans; each program stays short, so only allocation
     # size limits the table).
-    dense_cell_budget_chunked: int = 1 << 26
+    dense_cell_budget_chunked: int = _f(1 << 26, "worker", 1 << 8, 1 << 32,
+                                        group="dense_sweep",
+                                        conservative="down")
     # [worker] Step-axis chunk for the host-driven long-scan loop: one
     # ~100k-step scan program crashes the axon worker; 40k is fine. 16k
-    # leaves ~2x margin.
-    long_scan_chunk: int = 16384
+    # leaves ~2x margin. Probed by the dense_sweep tune group in the
+    # conservative (smaller) direction only; the env range stays wide
+    # above the default because raising a [worker] envelope on a roomier
+    # runtime is the documented-safe direction.
+    long_scan_chunk: int = _f(16384, "worker", 256, 1 << 20,
+                              group="dense_sweep", conservative="down")
     # [worker] Longest single scan program the non-chunked XLA path emits.
-    long_scan_max: int = 32768
+    long_scan_max: int = _f(32768, "worker", 1024, 1 << 20)
     # [worker] Sort rows (f_cap * (k_slots + 1) keys) per launch; the axon
     # worker faults allocating past ~2M rows.
-    sort_row_budget: int = 1 << 21
+    sort_row_budget: int = _f(1 << 21, "worker", 1 << 10, 1 << 28)
     # [worker] Element budget for a stacked batch launch of the sort
     # kernel (keeps host->device transfers a few hundred MB).
-    stack_element_budget: int = 1 << 26
+    stack_element_budget: int = _f(1 << 26, "worker", 1 << 12, 1 << 32)
     # [arch] The pallas kernel unrolls the slot sweep K times and carries a
     # u32[S, 2^(K-5)] table in VMEM; K=16 is 64 KiB of table and a sane
     # compile time.
-    max_k_pallas: int = 16
+    max_k_pallas: int = _f(16, "arch", 5, 20, group="pallas",
+                           conservative="down")
     # [arch] Return steps per colmask block: 512 x (8,128) u32 = 2 MiB,
-    # double-buffered well inside the 16 MiB VMEM budget.
-    pallas_step_chunk: int = 512
+    # double-buffered well inside the 16 MiB VMEM budget. Probed by the
+    # pallas tune group where Mosaic compiles.
+    pallas_step_chunk: int = _f(512, "arch", 64, 4096, group="pallas")
     # [worker] Per-history step ceiling for the pallas scalar-prefetch
-    # targets table ([1, ~98k] kills the axon worker; 16k runs routinely).
-    max_r_pallas: int = 16384
+    # targets table ([1, ~98k] kills the axon worker; 16k runs routinely;
+    # env range wide above the default — raising on a roomier runtime is
+    # the safe direction).
+    max_r_pallas: int = _f(16384, "worker", 256, 1 << 20)
     # [worker] Total prefetch entries (batch * steps) per pallas launch.
-    max_prefetch_pallas: int = 1 << 18
-    # [worker] Event-count crossover below which a SINGLE history on a
+    max_prefetch_pallas: int = _f(1 << 18, "worker", 1 << 10, 1 << 22)
+    # [tunable] Event-count crossover below which a SINGLE history on a
     # live TPU backend routes to the exact host oracle instead of a
     # device launch: the dispatch+fetch round trip exceeds the oracle's
     # whole runtime at tutorial scale. -1 (default) = MEASURED per
     # platform at first use (ops/calibrate.py: dispatch floor x oracle
-    # events/s, persisted next to the compile cache); 0 = never route
+    # events/s, persisted in the tuned profile); 0 = never route
     # (bench.py pins 0 for its kernel lanes); >0 = fixed crossover.
     # Batches are never routed regardless.
-    oracle_crossover_events: int = -1
+    oracle_crossover_events: int = _f(-1, "tunable", -1, 1 << 16)
     # [arch] Concurrency ceiling for the oracle route: the frontier can
     # hold up to 2^pending configurations per state, so a wide-pending
     # history must take the capped/budgeted device ladder even when its
     # event count is tiny. 12 pending ops bounds the closure at ~4k
     # masks/state — comfortably inside the config budget below.
-    oracle_route_max_pending: int = 12
+    oracle_route_max_pending: int = _f(12, "arch", 1, 20)
     # [arch] Transition-attempt budget for a routed oracle run; on
     # expiry the route abandons the host search and falls through to the
     # device ladder (ADVICE r4: no unbounded exponential host search on
     # the product path). ~2M step_py calls is <1 s of host time.
-    oracle_config_budget: int = 2_000_000
+    oracle_config_budget: int = _f(2_000_000, "arch", 1, 1 << 28)
     # [arch] Histories per pallas program in the grouped batch kernel
     # (tables stacked on a leading group axis; amortizes per-step
     # instruction overhead — measured 1.6-2.1x end-to-end / ~2.3x
     # kernel-side at G=16 on v5e, plateau past 16). 0 or 1 disables
     # grouping; batches smaller than the group stay per-history.
-    pallas_group: int = 16
-    # [arch] Floor of the step-axis length buckets the corpus scheduler
+    pallas_group: int = _f(16, "arch", 0, 64)
+    # [tunable] Floor of the step-axis length buckets the corpus scheduler
     # (sched/engine.py) and the scan-length bucketing (wgl3.step_bucket)
     # pad to. {2^k, 1.5*2^k} buckets bound per-bucket padding waste to
     # <1.5x and distinct jit compilations per kernel to the bucket count;
     # a lower floor trades a few extra compilations for tighter padding
     # on short-history corpora. 32 chosen from the step-padding gauge
-    # (PR 1): tutorial-scale fuzz corpora (10-120 ops) measured >2x
-    # padded/real under the old 64 floor, <1.6x at 32.
-    step_bucket_floor: int = 32
-    # [arch] Floor of the batch-axis buckets the scheduler pads launches
-    # to (with all-pad histories, targets=-1 — stripped from results).
-    batch_bucket_floor: int = 8
-    # [arch] In-flight chunks of the double-buffered resumable sort sweep
-    # (ops/wgl2.py check_steps_resumable): chunk N+1 dispatches before
-    # chunk N's overflow flag is fetched, hiding the per-chunk host<->
-    # device round trip. 1 restores the fully synchronous loop; deeper
-    # pipelines only buy anything on high-latency (tunneled) backends.
-    sched_pipeline_depth: int = 2
-    # [worker] Death-poll interval (in chunks) of the pipelined dense
+    # (PR 1); the sched tune group measures the padding-vs-compile
+    # tradeoff per machine.
+    step_bucket_floor: int = _f(32, "tunable", 8, 512, group="sched")
+    # [tunable] Floor of the batch-axis buckets the scheduler pads
+    # launches to (with all-pad histories, targets=-1 — stripped from
+    # results).
+    batch_bucket_floor: int = _f(8, "tunable", 1, 128, group="sched")
+    # [tunable] In-flight chunks of the double-buffered resumable sort
+    # sweep (ops/wgl2.py check_steps_resumable): chunk N+1 dispatches
+    # before chunk N's overflow flag is fetched, hiding the per-chunk
+    # host<->device round trip. 1 restores the fully synchronous loop;
+    # deeper pipelines only buy anything on high-latency (tunneled)
+    # backends — which is exactly what the pipeline tune group measures.
+    sched_pipeline_depth: int = _f(2, "tunable", 1, 8, group="pipeline")
+    # [tunable] Death-poll interval (in chunks) of the pipelined dense
     # long sweep (wgl3.check_steps3_long without a time budget): the
     # early-exit fetch costs a host round trip per poll, so the pipeline
     # only syncs every N chunks; dead chunks in between are near-free
     # (empty closures).
-    sched_poll_chunks: int = 8
+    sched_poll_chunks: int = _f(8, "tunable", 1, 64, group="pipeline")
     # [arch] Entry capacity of the scheduler's in-process kernel LRU
     # (sched/compile_cache.py, keyed by (kernel, model, bucket shape)).
-    kernel_cache_entries: int = 256
+    kernel_cache_entries: int = _f(256, "arch", 16, 4096)
     # [arch] Words of the packed table per occupancy tile of the sparse
     # active-tile sweep engine (ops/wgl3_sparse.py). Power of two; one
     # tile is TILE*32 configs per state row. 8 words (256 configs/state)
     # keeps the occupancy bitmap tiny (W/8 bits) while a gathered tile
     # is still a meaningful vector width.
-    sparse_tile_words: int = 8
-    # [arch] Live-tile density (percent of tiles occupied) above which a
-    # closure round runs the DENSE sweep instead of gather->expand->
+    sparse_tile_words: int = _f(8, "arch", 1, 64)
+    # [tunable] Live-tile density (percent of tiles occupied) above which
+    # a closure round runs the DENSE sweep instead of gather->expand->
     # scatter — the direction-optimizing switch (Beamer et al., SC'12):
     # past ~1/4 occupancy the gather/scatter overhead exceeds the work
     # skipped. Applies per round, so a frontier that fills up mid-step
-    # crosses over mid-sweep (and back) with no host involvement.
-    sparse_density_threshold_pct: int = 25
-    # [arch] Static capacity (in tiles) of the sparse engine's gather
+    # crosses over mid-sweep (and back) with no host involvement. The
+    # sparse tune group measures the real crossover per machine (PR 3
+    # hardcoded a CPU measurement).
+    sparse_density_threshold_pct: int = _f(25, "tunable", 1, 100,
+                                           group="sparse")
+    # [tunable] Static capacity (in tiles) of the sparse engine's gather
     # work list. XLA shapes are static, so the gathered frontier is
     # padded to this many tiles; a round whose live-tile count exceeds
     # it falls back to the dense sweep for that round (never drops
     # configs). Per-round sparse cost is O(cap * tile_words), so the
     # cap bounds worst-case sparse work regardless of K.
-    sparse_worklist_cap: int = 512
-    # [arch] Minimum tile count (W / sparse_tile_words) before the
+    sparse_worklist_cap: int = _f(512, "tunable", 64, 8192)
+    # [tunable] Minimum tile count (W / sparse_tile_words) before the
     # sparse engine engages in AUTO mode: below the crossover the dense
     # sweep's straight-line vector code beats the gather/nonzero/scatter
-    # overhead even at <1% occupancy. MEASURED on the CPU backend
-    # (bench.py sparse lane, long register history, warm): K=16 0.62x,
-    # K=18 0.78x, K=20 2.33x sparse-vs-dense — so the default engages at
-    # K >= 19 (2048 tiles at the default 8-word tile). A TPU's VPU
-    # widens the dense side's advantage, so raising this on real
+    # overhead even at <1% occupancy. The default (2048 tiles = K >= 19
+    # at the default 8-word tile) encodes ONE CPU measurement; the
+    # sparse tune group sweeps live-tile density per machine. A TPU's
+    # VPU widens the dense side's advantage, so raising this on real
     # hardware is the conservative direction; sparse_mode=2 forces the
     # engine on regardless for measurement.
-    sparse_min_tiles: int = 2048
+    sparse_min_tiles: int = _f(2048, "tunable", 1, 1 << 20, group="sparse")
     # [arch] Sweep-mode override for the dense lattice kernels:
     # 0 = auto (sparse engine on eligible geometries, per-round density
     # switch), 1 = dense-only (sparse engine off), 2 = prefer-sparse
     # (density threshold ignored; the work-list capacity still forces
     # dense rounds on overflow — configs are never dropped). 2 is the
     # bench/test lane for exercising the sparse path deterministically.
-    sparse_mode: int = 0
+    sparse_mode: int = _f(0, "arch", 0, 2)
 
 
-def _from_env() -> KernelLimits:
-    lim = KernelLimits()
-    overrides = {}
+def field_meta() -> dict[str, dict]:
+    """Machine-readable tuning metadata per field: {name: {kind, range,
+    group, conservative, default}} — the doc lint's and the autotuner's
+    single source of truth for tags and search bounds."""
+    out = {}
     for f in fields(KernelLimits):
-        raw = os.environ.get(f"JEPSEN_TPU_LIMIT_{f.name.upper()}")
-        if raw is not None:
-            overrides[f.name] = int(raw)
-    return replace(lim, **overrides) if overrides else lim
+        out[f.name] = dict(f.metadata) | {"default": f.default}
+    return out
 
 
-_LIMITS: KernelLimits = _from_env()
+class LimitsEnvError(ValueError):
+    """A JEPSEN_TPU_LIMIT_<FIELD> override that cannot apply: non-integer
+    or outside the field's safe range. The message names the env var and
+    the accepted range so the operator can fix it without reading code."""
+
+
+def env_var(name: str) -> str:
+    return f"JEPSEN_TPU_LIMIT_{name.upper()}"
+
+
+def _parse_env() -> dict[str, int]:
+    """Validated env overrides. Loud failure (satellite of ISSUE 4): a
+    malformed value must name the variable and the accepted range, not
+    surface as a bare ValueError from int()."""
+    overrides: dict[str, int] = {}
+    for f in fields(KernelLimits):
+        var = env_var(f.name)
+        raw = os.environ.get(var)
+        if raw is None:
+            continue
+        lo, hi = f.metadata["range"]
+        try:
+            # Plain decimal first (accepts zero-padded "010" like the
+            # pre-ISSUE-4 parser did), then prefixed literals (0x…).
+            val = int(raw)
+        except ValueError:
+            try:
+                val = int(raw, 0)
+            except ValueError:
+                raise LimitsEnvError(
+                    f"{var}={raw!r} is not an integer (accepted range "
+                    f"for {f.name}: {lo}..{hi})") from None
+        if not lo <= val <= hi:
+            raise LimitsEnvError(
+                f"{var}={val} is outside the safe range for {f.name}: "
+                f"{lo}..{hi} (doc/perf.md 'KernelLimits reference')")
+        overrides[f.name] = val
+    return overrides
+
+
+# -- resolution state -------------------------------------------------------
+#
+# _ENV     validated env overrides, parsed at import (and on _reload()).
+# _SET     the programmatic profile installed by set_limits(), or None.
+# _TUNED   the persisted tuned profile's field dict for this platform, or
+#          None when not yet loaded (lazy — loading may need a jax
+#          backend, see tune/profile.py), or {} when loaded-and-absent.
+# _LIMITS  the memoized resolved instance (invalidated on any change).
+
+_ENV: dict[str, int] = _parse_env()
+_SET: KernelLimits | None = None
+_TUNED: dict[str, int] | None = None
+_LIMITS: KernelLimits | None = None
+
+
+def _tuned_overrides() -> dict[str, int]:
+    """The tuned profile's overrides for this platform, loaded lazily on
+    the first resolution that CAN determine them. tune/profile.py only
+    touches a jax backend when a profile FILE exists (an operator ran
+    `jepsen-tpu tune` on this machine), so processes on machines with no
+    profile never risk initializing a wedged backend from here. While
+    the answer is UNDETERMINED (a profile file exists but jax is not
+    imported yet, so the platform key cannot resolve), nothing is cached
+    — a limits() call made before backend init must not freeze an empty
+    tuned set for the process lifetime (tuned_limits() returns None for
+    that case, {} for a definitive no-profile answer)."""
+    global _TUNED
+    if _TUNED is None:
+        try:
+            from ..tune import profile as _profile
+
+            tuned = _profile.tuned_limits()
+        except Exception:
+            # The tuned profile is an optimization, never a failure mode
+            # (a torn file / unimportable jax must not break limits()).
+            tuned = {}
+        if tuned is None:
+            return {}            # undetermined: retry on a later call
+        _TUNED = dict(tuned)
+    return _TUNED
+
+
+def _resolve() -> KernelLimits:
+    base = _SET if _SET is not None else \
+        replace(KernelLimits(), **_tuned_overrides())
+    return replace(base, **_ENV) if _ENV else base
 
 
 def limits() -> KernelLimits:
-    """The active limits profile (axon defaults + env overrides)."""
-    return _LIMITS
-
-
-def set_limits(lim: KernelLimits) -> KernelLimits:
-    """Swap the active profile (tests / embedding runtimes); returns the
-    previous one so callers can restore it."""
+    """The active limits profile, resolved with precedence
+    env > set_limits() > tuned profile > dataclass default. The
+    resolution is memoized only once the tuned-profile question is
+    settled (or a set_limits profile shadows it) — see
+    _tuned_overrides."""
     global _LIMITS
-    prev = _LIMITS
-    _LIMITS = lim
+    if _LIMITS is not None:
+        return _LIMITS
+    lim = _resolve()
+    if _SET is not None or _TUNED is not None:
+        _LIMITS = lim
+    return lim
+
+
+def limits_provenance() -> dict[str, str]:
+    """Where each resolved field's value came from: "env" (a
+    JEPSEN_TPU_LIMIT_* override), "set" (set_limits() installed a value
+    differing from the default), "tuned" (the persisted tuned profile),
+    or "default" (the dataclass / axon profile). Surfaced by
+    tools/print_profile.py, the bench records, and run telemetry."""
+    lim = limits()
+    out = {}
+    for f in fields(KernelLimits):
+        if f.name in _ENV:
+            out[f.name] = "env"
+        elif _SET is not None:
+            out[f.name] = ("set" if getattr(lim, f.name) != f.default
+                           else "default")
+        elif f.name in (_TUNED or {}):
+            out[f.name] = "tuned"
+        else:
+            out[f.name] = "default"
+    return out
+
+
+def set_limits(lim: KernelLimits | None) -> KernelLimits | None:
+    """Install a programmatic profile (tests / embedding runtimes);
+    returns the PREVIOUS programmatic profile — None when there was none
+    — so the save/restore idiom ``prev = set_limits(x); ...;
+    set_limits(prev)`` restores the exact prior state (in particular it
+    does NOT freeze a resolved snapshot that would mask a tuned profile
+    loaded later). Env overrides still win over the installed instance
+    (precedence above); the tuned profile does not apply while a
+    set_limits profile is active — the caller chose a complete instance.
+    ``None`` clears the programmatic profile, re-enabling tuned-profile
+    resolution. When an env override SHADOWS a differing installed value
+    (e.g. a bench pin under an exported JEPSEN_TPU_LIMIT_*), that is
+    logged once per field — a measurement pin being silently ignored is
+    exactly the surprise the precedence doc alone doesn't prevent."""
+    global _SET, _LIMITS
+    prev = _SET
+    _SET = lim
+    _LIMITS = None
+    if lim is not None and _ENV:
+        shadowed = [f for f, v in _ENV.items() if getattr(lim, f) != v]
+        new = [f for f in shadowed if f not in _WARNED_SHADOWED]
+        if new:
+            _WARNED_SHADOWED.update(new)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "set_limits value(s) shadowed by env overrides "
+                "(precedence env > set_limits): %s",
+                ", ".join(f"{f} ({env_var(f)}={_ENV[f]})"
+                          for f in sorted(new)))
     return prev
+
+
+_WARNED_SHADOWED: set = set()
+
+
+def _reload() -> None:
+    """Re-parse env and drop the memoized resolution AND the cached tuned
+    profile (tests, and tune/profile.py after persisting a new profile).
+    Raises LimitsEnvError on malformed env, like import does."""
+    global _ENV, _TUNED, _LIMITS
+    _ENV = _parse_env()
+    _TUNED = None
+    _LIMITS = None

@@ -292,10 +292,21 @@ async def _run_test_inner(test: dict, store) -> dict:
             obs.maybe_jax_trace(store.path if store else None):
         result = (checker.check(test, history, opts)
                   if checker is not None else {"valid": True})
-        sp.set(valid=str(result.get("valid")))
+        sp.set(valid=str(result.get("valid")),
+               profile=obs.active_profile_hash())
     result.setdefault("op_count",
                       sum(1 for o in history if o.type == INVOKE))
     result["run_seconds"] = run_s
+    # Which tuning profile the check resolved (ISSUE 4): hash + every
+    # non-default KernelLimits field with its provenance tag — lands in
+    # results.json so the web run index can say which profile produced
+    # each verdict/throughput figure.
+    try:
+        from ..tune.profile import run_record
+
+        result["profile"] = run_record()
+    except Exception:
+        pass   # profile stamping is observability, never a failure mode
 
     if store is not None:
         with tracer.span("store"):

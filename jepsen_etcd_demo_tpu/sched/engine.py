@@ -48,16 +48,16 @@ def assign_step_buckets(step_counts: Sequence[int]) -> list[int]:
     return [wgl3.step_bucket(int(n), floor=floor) for n in step_counts]
 
 
-def _batch_bucket(n: int, cap: int, multiple: int) -> int:
+def _batch_bucket(n: int, cap: int) -> int:
     """Batch-axis bucket: {2^k, 1.5*2^k} growth from the batch floor,
-    capped by the launch-size cap, then rounded up to the sharding
-    multiple (device count x pallas group where the grouped kernel will
-    run)."""
+    capped by the launch-size cap. The sharding-multiple round-up
+    happens at the call site AFTER bucketing, because the multiple must
+    be derived from the BUCKETED size (a bucket can inflate a 1-history
+    part past 1, flipping the launcher onto the sharded kernel)."""
     from ..ops import wgl3
 
     b = min(wgl3.step_bucket(n, floor=limits().batch_bucket_floor), cap)
-    b = max(b, n)
-    return (b + multiple - 1) // multiple * multiple
+    return max(b, n)
 
 
 def _pad_rs(k_slots: int):
@@ -239,8 +239,17 @@ def check_corpus(encs: Sequence, model=None, f_cap: int = 256
                 for c0 in range(0, len(idxs), chunk):
                     part = idxs[c0:c0 + chunk]
                     part_steps = [steps_of[i] for i in part]
-                    mult = _launch_multiple(model, cfg, len(part), r)
-                    b = _batch_bucket(len(part), chunk, mult)
+                    # Bucket FIRST, then derive the sharding multiple
+                    # from the bucketed size: the launcher picks the
+                    # sharded kernel by the PADDED batch, so a part the
+                    # bucket inflates past 1 must pad to the device
+                    # multiple even though the raw part would have run
+                    # single-history (a batch_bucket_floor that is not a
+                    # multiple of the device count — any tuned floor on a
+                    # pod — crashed here otherwise).
+                    b0 = _batch_bucket(len(part), chunk)
+                    mult = _launch_multiple(model, cfg, b0, r)
+                    b = (b0 + mult - 1) // mult * mult
                     run, name = _dense_bucket_launcher(model, cfg, b, r)
                     padded = part_steps + [_pad_rs(k)] * (b - len(part))
                     arrays = wgl3.stack_steps3(padded, r)

@@ -1,0 +1,290 @@
+"""Autotuner probes — small deterministic microbenchmarks per knob group.
+
+Each probe group builds its fixtures ONCE (fixed-seed histories from
+utils/fuzz.py, so two tune runs on the same machine measure the same
+work) and then times the real production code path — the same kernels,
+the same routers — under candidate `KernelLimits` overrides installed
+via `set_limits`. Probes measure; the search (tune/search.py) decides.
+
+Groups (the `group` metadata on KernelLimits fields, ops/limits.py):
+
+  dense_sweep  — the host-chunked dense long sweep
+                 (wgl3.check_steps3_long): events/s vs `long_scan_chunk`
+                 and `dense_cell_budget_chunked` (conservative-down
+                 candidates only — [worker] envelope fields).
+  sparse       — the sparse active-tile engine's crossover
+                 (ops/wgl3_sparse.py): live-tile density sweep tuning
+                 `sparse_density_threshold_pct` / `sparse_min_tiles`
+                 (PR 3 hardcoded a CPU measurement for these).
+  sched        — the bucketed corpus scheduler (sched/engine.py):
+                 padding-vs-compile tradeoff for `step_bucket_floor` /
+                 `batch_bucket_floor` on a mixed-length corpus.
+  pipeline     — `sched_pipeline_depth` (resumable sort sweep,
+                 wgl2.check_steps_resumable) and `sched_poll_chunks`
+                 (pipelined dense long sweep).
+  pallas       — `pallas_step_chunk` / `max_k_pallas` where Mosaic
+                 compiles (skipped wholesale off-TPU).
+
+Every measurement is warmup-then-best-of-N: the warmup call eats the
+compile (the persistent XLA cache makes it cheap on re-tunes), the min
+over repeats estimates the machine's floor — the quantity routing
+decisions care about — rather than a load-dependent mean.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import replace
+from typing import Callable
+
+from ..ops.limits import KernelLimits, limits, set_limits
+
+# Fixed probe seeds — one per group, so fixtures never alias.
+SEED_DENSE = 0xD5E1
+SEED_SPARSE = 0x5BA5
+SEED_SCHED = 0x5C4ED
+SEED_PIPE = 0x919E
+SEED_PALLAS = 0x9A11
+
+# Per-knob limit pins applied UNDER the candidate override while probing
+# (e.g. the density threshold only matters once the sparse engine is
+# eligible, so its probe pins the engagement floor to 1).
+KNOB_PINS: dict[str, dict[str, int]] = {
+    "sparse_density_threshold_pct": {"sparse_min_tiles": 1},
+}
+
+
+class ProbeContext:
+    """Shared probe configuration. `scale` shrinks every fixture
+    proportionally (the tier-1 CPU smoke runs at scale ~0.1, seconds of
+    wall clock); `repeats` is the best-of count per measurement."""
+
+    def __init__(self, model=None, scale: float = 1.0, repeats: int = 2):
+        if model is None:
+            from ..models import CASRegister
+
+            model = CASRegister()
+        self.model = model
+        self.scale = max(0.02, float(scale))
+        self.repeats = max(1, int(repeats))
+
+    def n(self, full: int, floor: int) -> int:
+        return max(floor, int(full * self.scale))
+
+
+def _timed(fn: Callable[[], object], repeats: int) -> float:
+    fn()                          # warmup: compile + caches
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _with_overrides(overrides: dict[str, int], fn: Callable[[], object],
+                    repeats: int) -> float:
+    """Time `fn` under a fresh default profile + `overrides`. The base is
+    the DATACLASS default, not the currently-resolved profile: the tuner
+    measures what a shipped profile would do, not what the previous
+    profile already did. Env overrides still win (ops/limits.py
+    precedence), which is why the search excludes env-pinned knobs."""
+    prev = set_limits(replace(KernelLimits(), **overrides))
+    try:
+        return _timed(fn, repeats)
+    finally:
+        set_limits(prev)
+
+
+class _LongSweepFixture:
+    """One fixed-seed register history prepared for the chunked dense
+    long sweep — shared shape between the dense_sweep, pipeline, and
+    pallas groups (each with its own seed/geometry)."""
+
+    def __init__(self, ctx: ProbeContext, seed: int, n_ops: int,
+                 k_slots: int | None = None, budget: int | None = None):
+        from ..ops import wgl3
+        from ..ops.encode import (encode_register_history,
+                                  encode_return_steps, reslot_events)
+        from ..utils.fuzz import gen_register_history
+
+        h = gen_register_history(random.Random(seed), n_ops=n_ops,
+                                 n_procs=8, p_info=0.002)
+        enc = encode_register_history(h, k_slots=32)
+        k = k_slots if k_slots is not None else wgl3.tight_k_slots(enc)
+        self.cfg = wgl3.dense_config(ctx.model, k, enc.max_value,
+                                     budget=budget)
+        if self.cfg is None:
+            raise RuntimeError(f"probe geometry infeasible (k={k})")
+        self.enc = reslot_events(enc, k) if enc.k_slots != k else enc
+        self.rs = encode_return_steps(self.enc)
+        self.model = ctx.model
+
+
+class DenseSweepProbe:
+    """events/s of the host-chunked dense sweep vs the chunking knobs.
+    The history is long enough that `long_scan_chunk` candidates below
+    its step count really change the chunk loop's shape."""
+
+    knobs = ("long_scan_chunk", "dense_cell_budget_chunked")
+
+    def __init__(self, ctx: ProbeContext):
+        self.ctx = ctx
+        self.fix = _LongSweepFixture(ctx, SEED_DENSE,
+                                     n_ops=ctx.n(4000, 400))
+
+    def candidates(self, knob: str) -> list[int] | None:
+        if knob == "long_scan_chunk":
+            # Ladder below the fixture's step count so every candidate
+            # exercises a different chunk-loop shape; the conservative
+            # clamp (<= default) is applied by the search.
+            steps = self.fix.rs.n_steps
+            return sorted({max(256, steps // 8), max(256, steps // 4),
+                           max(256, steps // 2), 16384})
+        return None
+
+    def measure(self, knob: str, overrides: dict[str, int]) -> float:
+        from ..ops import wgl3
+
+        return _with_overrides(
+            overrides,
+            lambda: wgl3.check_steps3_long(self.fix.rs, self.fix.model,
+                                           self.fix.cfg),
+            self.ctx.repeats)
+
+
+class SparseProbe:
+    """Sparse-vs-dense crossover: a WIDE table (k_slots beyond the
+    history's real concurrency — the tiny-live-frontier regime the
+    sparse engine exists for) swept under candidate density thresholds
+    and engagement floors. Chosen values replace PR 3's hardcoded CPU
+    measurement with THIS machine's."""
+
+    knobs = ("sparse_density_threshold_pct", "sparse_min_tiles")
+
+    def __init__(self, ctx: ProbeContext):
+        self.ctx = ctx
+        k = 13 if ctx.scale < 0.5 else 18
+        self.fix = _LongSweepFixture(ctx, SEED_SPARSE,
+                                     n_ops=ctx.n(1500, 150),
+                                     k_slots=k, budget=1 << 28)
+
+    def tiles(self) -> int:
+        lim = limits()
+        w = self.fix.cfg.n_masks // 32
+        return max(1, w // lim.sparse_tile_words)
+
+    def candidates(self, knob: str) -> list[int] | None:
+        if knob == "sparse_min_tiles":
+            # Bracket THIS geometry's tile count: the engage/stay-dense
+            # decision is what the candidates toggle.
+            t = self.tiles()
+            return sorted({max(1, t // 2), t, 2 * t, 2048})
+        return None
+
+    def measure(self, knob: str, overrides: dict[str, int]) -> float:
+        from ..ops import wgl3
+
+        return _with_overrides(
+            overrides,
+            lambda: wgl3.check_steps3_long(self.fix.rs, self.fix.model,
+                                           self.fix.cfg),
+            self.ctx.repeats)
+
+
+class SchedProbe:
+    """Bucketed-scheduler floors on a fixed mixed-length corpus: lower
+    floors pad tighter but compile more shapes; the measurement is the
+    warm steady state (the persistent XLA cache amortizes compiles
+    across processes, so steady-state is what production pays)."""
+
+    knobs = ("step_bucket_floor", "batch_bucket_floor")
+
+    def __init__(self, ctx: ProbeContext):
+        from ..ops.encode import encode_register_history
+        from ..utils.fuzz import gen_register_history
+
+        self.ctx = ctx
+        rng = random.Random(SEED_SCHED)
+        n_hist = ctx.n(192, 24)
+        hi = ctx.n(300, 60)
+        self.encs = [encode_register_history(
+            gen_register_history(rng, n_ops=rng.randrange(10, hi),
+                                 n_procs=8, p_info=0.002), k_slots=32)
+            for _ in range(n_hist)]
+
+    def measure(self, knob: str, overrides: dict[str, int]) -> float:
+        from .. import sched
+
+        return _with_overrides(
+            overrides,
+            lambda: sched.check_corpus(self.encs, self.ctx.model),
+            self.ctx.repeats)
+
+
+class PipelineProbe:
+    """Chunk-pipelining depth knobs. `sched_pipeline_depth` drives the
+    resumable sort sweep's in-flight window (only buys anything on
+    high-latency backends — which is the point of measuring it HERE);
+    `sched_poll_chunks` drives the dense long sweep's death-poll
+    interval."""
+
+    knobs = ("sched_pipeline_depth", "sched_poll_chunks")
+
+    def __init__(self, ctx: ProbeContext):
+        self.ctx = ctx
+        self.fix = _LongSweepFixture(ctx, SEED_PIPE, n_ops=ctx.n(3000, 300))
+
+    def measure(self, knob: str, overrides: dict[str, int]) -> float:
+        from ..ops import wgl2, wgl3
+
+        if knob == "sched_pipeline_depth":
+            fn = lambda: wgl2.check_steps_resumable(  # noqa: E731
+                self.fix.rs, self.fix.model, chunk=256)
+        else:
+            fn = lambda: wgl3.check_steps3_long(  # noqa: E731
+                self.fix.rs, self.fix.model, self.fix.cfg)
+        return _with_overrides(overrides, fn, self.ctx.repeats)
+
+
+class PallasProbe:
+    """Mosaic-compiled resumable kernel knobs — only meaningful where
+    pallas actually compiles; constructing the probe off-TPU raises
+    ProbeUnavailable and the search records the group as skipped."""
+
+    knobs = ("pallas_step_chunk", "max_k_pallas")
+
+    def __init__(self, ctx: ProbeContext):
+        from ..ops import wgl3_pallas
+
+        if not wgl3_pallas.pallas_available():
+            raise ProbeUnavailable("pallas unavailable on this backend")
+        self.ctx = ctx
+        self.fix = _LongSweepFixture(ctx, SEED_PALLAS,
+                                     n_ops=ctx.n(3000, 300))
+
+    def measure(self, knob: str, overrides: dict[str, int]) -> float:
+        from ..ops import wgl3_pallas
+
+        return _with_overrides(
+            overrides,
+            lambda: wgl3_pallas.check_steps3_long_pallas(
+                self.fix.rs, self.fix.model, self.fix.cfg),
+            self.ctx.repeats)
+
+
+class ProbeUnavailable(RuntimeError):
+    """This probe group cannot run on this backend (recorded as skipped,
+    never an error — a CPU tune simply has no pallas lane)."""
+
+
+# Group name -> probe class; the search instantiates lazily (fixture
+# encoding costs host time) and in this order.
+PROBES = {
+    "dense_sweep": DenseSweepProbe,
+    "sparse": SparseProbe,
+    "sched": SchedProbe,
+    "pipeline": PipelineProbe,
+    "pallas": PallasProbe,
+}

@@ -118,6 +118,7 @@ def _index_html(store: Store) -> str:
             f"<td>{html.escape(waste)}</td>"
             f"<td>{html.escape(sweep)}</td>"
             f"<td>{html.escape(live)}</td>"
+            f"<td><code>{html.escape(_profile_column(results))}</code></td>"
             f"<td>{tele}</td></tr>")
     return (
         "<!doctype html><html><head><meta charset='utf-8'>"
@@ -127,9 +128,23 @@ def _index_html(store: Store) -> str:
         f"<table><tr><th>run</th><th>valid</th><th>detail</th>"
         f"<th>check eps</th><th>pad waste</th>"
         f"<th>sweep</th><th>live tiles</th>"
+        f"<th>profile</th>"
         f"<th>obs</th></tr>"
         f"{''.join(rows)}</table>"
         "</body></html>")
+
+
+def _profile_column(results: dict) -> str:
+    """Which tuning profile the run's check resolved (runner/core.py
+    stamps results.json with tune/profile.run_record): the short hash,
+    plus the tuned-field count when any applied. Blank for runs recorded
+    before the autotuner existed."""
+    prof = results.get("profile")
+    if not isinstance(prof, dict) or not prof.get("hash"):
+        return ""
+    h = str(prof["hash"])
+    n = prof.get("tuned_fields") or 0
+    return f"{h} ({n} tuned)" if n else h
 
 
 # -- telemetry page --------------------------------------------------------

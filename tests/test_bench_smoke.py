@@ -35,7 +35,8 @@ def test_sched_corpus_lane_contract():
     assert lane["kernel_phases"]["execute_s"] > 0.0
     assert lane["cache_hit_rate"] == 1.0
     assert set(lane["kernel_phases"]) == {
-        "compile_s", "execute_s", "encode_s", "frontier_peak"}
+        "compile_s", "execute_s", "encode_s", "frontier_peak",
+        "profile_hash"}
 
 
 def test_bench_error_path_reports_degraded_contract_fields(monkeypatch,
@@ -50,16 +51,57 @@ def test_bench_error_path_reports_degraded_contract_fields(monkeypatch,
     assert bench.main() == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["value"] == 0
-    assert out["kernel_phases"] == {"compile_s": 0.0, "execute_s": 0.0,
-                                    "encode_s": 0.0, "frontier_peak": 0}
+    phases = dict(out["kernel_phases"])
+    profile_hash = phases.pop("profile_hash")
+    assert phases == {"compile_s": 0.0, "execute_s": 0.0,
+                      "encode_s": 0.0, "frontier_peak": 0}
     assert out["padding_waste"] == 0.0
     assert out["cache_hit_rate"] == 0.0
     assert out["sweep"]["live_tile_ratio"] == 0.0
     assert out["sweep"]["steps_sparse"] == 0
+    # ISSUE 4 satellite: even the all-probes-dead record states which
+    # tuning profile it intended to use, and points at the tool that
+    # prints the full resolved table.
+    assert out["profile"]["hash"] == profile_hash
+    assert "tuned_fields" in out["profile"]
+    assert out["profile"]["inspect"] == "python tools/print_profile.py"
     assert out["degraded"] is True
     assert out["backend"] == "none"
     assert "probe stubbed" in out["error"]
     assert out["detail"]["probe"]["default"] == "probe stubbed"
+
+
+def test_tuned_lane_contract(tmp_path, monkeypatch):
+    """The bench's tuned-profile lane at tiny scale (ISSUE 4): both
+    arms' events/s present, speedup_vs_default computed, the active
+    profile hash reported, verdicts asserted identical inside the lane —
+    and a planted tuned profile really drives the tuned arm."""
+    from jepsen_etcd_demo_tpu.ops import limits as limits_mod
+    from jepsen_etcd_demo_tpu.tune import profile
+
+    monkeypatch.setenv("JEPSEN_TPU_TUNE_PROFILE",
+                       str(tmp_path / "tuned_profile.json"))
+    prev_set = limits_mod._SET
+    limits_mod._SET = None
+    profile.reset()
+    try:
+        profile.save_entry({"step_bucket_floor": 16,
+                            "batch_bucket_floor": 4})
+        model = CASRegister()
+        lane = bench.bench_tuned(model, n_hist=32, ops_range=(10, 100))
+        for key in ("default_events_per_sec", "tuned_events_per_sec",
+                    "speedup_vs_default", "profile_hash", "tuned",
+                    "tuned_fields", "default_s", "tuned_s"):
+            assert key in lane, key
+        json.dumps(lane)
+        assert lane["tuned"] is True and lane["tuned_fields"] == 2
+        assert lane["profile_hash"] == profile.profile_hash() != "default"
+        assert lane["speedup_vs_default"] > 0
+        # The lane restored the resolution state it found.
+        assert limits_mod._SET is None
+    finally:
+        limits_mod._SET = prev_set
+        profile.reset()
 
 
 def test_sparse_lane_contract():

@@ -50,9 +50,13 @@ def tpu_route(monkeypatch):
 
 @pytest.fixture(autouse=True)
 def _restore_calibration():
+    from jepsen_etcd_demo_tpu.tune import profile
+
     prev = set_calibration(None)
-    yield
+    profile.reset()     # drop any memoized profile-store entry (the
+    yield               # store path is env-dependent per test)
     set_calibration(prev)
+    profile.reset()
 
 
 def test_measure_produces_sane_calibration(tmp_path, monkeypatch):
@@ -66,13 +70,26 @@ def test_measure_produces_sane_calibration(tmp_path, monkeypatch):
 
 
 def test_persist_and_reload(tmp_path, monkeypatch):
+    """Persistence lives in the SHARED tuning-profile store since
+    ISSUE 4 (tune/profile.py — the legacy calibration.json sidecar is
+    only a migration source, tests/test_tune.py)."""
+    from jepsen_etcd_demo_tpu.tune import profile
+
     monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path))
-    set_calibration(None)
-    cal = get_calibration()                        # measures + persists
-    on_disk = json.loads((tmp_path / "calibration.json").read_text())
-    assert on_disk["crossover_events"] == cal.crossover_events
-    set_calibration(None)                          # drop memory; reload file
-    assert get_calibration() == cal
+    profile.reset()
+    try:
+        set_calibration(None)
+        cal = get_calibration()                    # measures + persists
+        on_disk = json.loads((tmp_path / "tuned_profile.json").read_text())
+        entry = on_disk["profiles"][profile.platform_key()]
+        assert entry["calibration"]["crossover_events"] \
+            == cal.crossover_events
+        assert not (tmp_path / "calibration.json").exists()  # no sidecar
+        set_calibration(None)                      # drop memory; reload
+        profile.reset()
+        assert get_calibration() == cal
+    finally:
+        profile.reset()
 
 
 def test_stale_platform_remeasured(tmp_path, monkeypatch):

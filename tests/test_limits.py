@@ -17,12 +17,15 @@ def test_defaults_are_axon_profile():
 
 
 def test_set_limits_roundtrip():
+    before = limits().dense_cell_budget
     prev = set_limits(KernelLimits(dense_cell_budget=1 << 10))
     try:
         assert limits().dense_cell_budget == 1 << 10
     finally:
         set_limits(prev)
-    assert limits().dense_cell_budget == prev.dense_cell_budget
+    # set_limits returns the previous PROGRAMMATIC state (None when none
+    # was installed), so the restore recovers the exact prior resolution.
+    assert limits().dense_cell_budget == before
 
 
 def test_limits_change_dense_routing():

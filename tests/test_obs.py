@@ -161,9 +161,12 @@ class TestCaptureStack:
         assert not (out / obs.METRICS_FILE).exists()
 
     def test_kernel_phases_zero_shape(self):
-        # The unreachable-backend bench path: all four fields present,
-        # all zero.
-        assert obs.kernel_phases(None) == {
+        # The unreachable-backend bench path: every timing field present
+        # and zero, plus the active tuning-profile hash (ISSUE 4 — a
+        # degraded record still states which profile it intended).
+        phases = obs.kernel_phases(None)
+        assert phases.pop("profile_hash") == obs.active_profile_hash()
+        assert phases == {
             "compile_s": 0.0, "execute_s": 0.0, "encode_s": 0.0,
             "frontier_peak": 0}
 
@@ -254,7 +257,7 @@ class TestEndToEndArtifacts:
                 reg.gauge(name).set(rec["max"])
         phases = obs.kernel_phases(reg)
         assert set(phases) == {"compile_s", "execute_s", "encode_s",
-                               "frontier_peak"}
+                               "frontier_peak", "profile_hash"}
         assert phases["frontier_peak"] >= 1
 
     def test_telemetry_disabled_run_writes_no_artifacts(self, tmp_path,
@@ -285,5 +288,7 @@ def test_bench_error_path_always_emits_kernel_phases(monkeypatch, capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["value"] == 0
     assert out["degraded"] is True and out["backend"] == "none"
-    assert out["kernel_phases"] == {"compile_s": 0.0, "execute_s": 0.0,
-                                    "encode_s": 0.0, "frontier_peak": 0}
+    phases = dict(out["kernel_phases"])
+    assert isinstance(phases.pop("profile_hash"), str)
+    assert phases == {"compile_s": 0.0, "execute_s": 0.0,
+                      "encode_s": 0.0, "frontier_peak": 0}

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Dump the ACTIVE resolved KernelLimits with per-field provenance.
+
+The first question about any perf number or misrouted check is "what
+limits was that process actually running?" — which depends on env
+overrides, any embedding set_limits, the machine's tuned profile
+(tune/profile.py, written by `jepsen-tpu tune`), and the dataclass
+defaults, in that precedence order. This tool prints the resolved
+answer, field by field, with where each value came from — the table to
+paste into bug reports, and the tool the bench's degraded record points
+at so even a round whose backend never came up states which profile it
+intended to use.
+
+Usage:
+  python tools/print_profile.py           # human-readable table
+  python tools/print_profile.py --json    # full machine-readable report
+
+Equivalent: `jepsen-tpu tune --print-profile` (always JSON).
+
+NOTE: resolving the platform key / tuned profile may initialize the jax
+backend when a profile file exists; set JAX_PLATFORMS=cpu to inspect the
+CPU resolution without dialing a TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def report() -> dict:
+    from jepsen_etcd_demo_tpu.tune.profile import report as _report
+
+    return _report()
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    rep = report()
+    if "--json" in argv:
+        print(json.dumps(rep, indent=2))
+        return 0
+    print(f"platform:        {rep['platform']}")
+    print(f"profile file:    {rep['profile_path']} "
+          f"(v{rep['profile_version']}, "
+          f"{'enabled' if rep['profile_enabled'] else 'DISABLED'})")
+    print(f"profile hash:    {rep['profile_hash']}")
+    if rep.get("measured_at"):
+        print(f"measured at:     {rep['measured_at']}")
+    cal = rep.get("calibration")
+    if cal:
+        print(f"calibration:     crossover {cal.get('crossover_events')} "
+              f"events (dispatch {cal.get('dispatch_floor_s')}s, oracle "
+              f"{cal.get('oracle_events_per_s')}/s)")
+    print()
+    name_w = max(len(n) for n in rep["fields"])
+    print(f"{'field':<{name_w}}  {'value':>12}  {'prov':<7} {'kind':<7} "
+          f"{'safe range':<22} env override")
+    for name, f in rep["fields"].items():
+        lo, hi = f["range"]
+        mark = "" if f["provenance"] == "default" else " *"
+        print(f"{name:<{name_w}}  {f['value']:>12}  "
+              f"{f['provenance']:<7} {f['kind']:<7} "
+              f"{f'{lo}..{hi}':<22} {f['env']}{mark}")
+    n_over = sum(1 for f in rep["fields"].values()
+                 if f["provenance"] != "default")
+    print(f"\n{n_over} field(s) off default (*); precedence: "
+          f"env > set_limits > tuned profile > default")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
