@@ -53,7 +53,7 @@ def split_by_key(history: Sequence[Op]) -> dict[Any, list[Op]]:
             v = op.value[1] if (isinstance(op.value, tuple)
                                 and len(op.value) == 2) else op.value
         sub = Op(type=op.type, f=op.f, value=v, process=op.process,
-                 time=op.time, index=op.index, error=op.error)
+                 time=op.time, index=op.index, error=op.error, seq=op.seq)
         keyed.setdefault(k, []).append(sub)
     return keyed
 
@@ -82,9 +82,26 @@ class IndependentChecker(Checker):
                     if isinstance(sub, Linearizable) and sub.backend == "jax":
                         batchable[name] = sub
 
+        # Keys the run's streaming check session (stream/engine.py) has
+        # already settled valid for a given model skip the batched
+        # launch entirely — _check_key's per-key path picks the streamed
+        # verdict up via Linearizable._stream_result. Invalid/unsettled
+        # keys keep the full batched + ladder treatment (witnesses).
+        stream_results = (opts or {}).get("stream_results") or {}
+
+        def settled_for(lin: Linearizable) -> set:
+            return {k for k, r in stream_results.items()
+                    if isinstance(r, dict) and r.get("valid") is True
+                    and r.get("model") == lin.model.name}
+
+        def batch_keys(lin: Linearizable) -> dict[Any, list[Op]]:
+            settled = settled_for(lin)
+            return {k: h for k, h in keyed.items() if k not in settled}
+
         batched: dict[str | None, dict[Any, dict]] = {
-            name: _batched_linearizable(lin, keyed,
-                                        (opts or {}).get("store_dir"))
+            name: (_batched_linearizable(lin, sub_keyed,
+                                         (opts or {}).get("store_dir"))
+                   if (sub_keyed := batch_keys(lin)) else {})
             for name, lin in batchable.items()
         }
 

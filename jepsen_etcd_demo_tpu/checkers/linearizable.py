@@ -97,8 +97,50 @@ class Linearizable(Checker):
             res = self._check_traced(test, history, opts, sp)
         return res
 
+    def _stream_result(self, opts: dict | None) -> dict | None:
+        """A VALID streamed verdict for this (key, model) from the run's
+        streaming check session (stream/engine.py, threaded through
+        opts["stream_results"] by runner/core.py). Invalid/absent keys
+        fall through to the full path — invalid ones must re-run it for
+        counterexample witness reconstruction; the streamed and post-hoc
+        verdicts are bit-identical, so re-running never flips one."""
+        if self.backend != "jax":
+            return None
+        sr = (opts or {}).get("stream_results")
+        if not sr:
+            return None
+        pre = sr.get((opts or {}).get("key"))
+        if not isinstance(pre, dict) or pre.get("model") != self.model.name:
+            return None
+        return pre if pre.get("valid") is True else None
+
     def _check_traced(self, test: dict, history: Sequence[Op],
                       opts: dict | None, sp) -> dict[str, Any]:
+        pre = self._stream_result(opts)
+        if pre is not None:
+            # The stream engine already encoded and swept this history;
+            # persist the SAME tensor artifact the post-hoc path would
+            # have (corpus replay's coverage contract), then settle.
+            enc = pre.get("_enc")
+            store_dir = (opts or {}).get("store_dir")
+            if store_dir and enc is not None:
+                from ..store.store import write_encoded_tensor
+
+                write_encoded_tensor(store_dir, (opts or {}).get("key"),
+                                     enc, self.model.name)
+            res = {"valid": True, "backend": "jax-dense-streamed",
+                   "op_count": int(pre.get("op_count", 0)),
+                   "streamed": True}
+            for f in ("dead_step", "max_frontier", "configs_explored"):
+                if f in pre:
+                    res[f] = int(pre[f])
+            if "table_cells" in pre:
+                res["overflow"] = False
+                res["f_cap"] = int(pre["table_cells"])
+                res["kernel"] = pre.get("kernel")
+            sp.set(valid="True", backend="jax-dense-streamed",
+                   op_count=res["op_count"])
+            return res
         # Fault-plane ops (nemesis start/stop) are not client operations —
         # drop them like knossos does [dep]. Workloads under the
         # independent wrapper never see them (split_by_key filters), but a

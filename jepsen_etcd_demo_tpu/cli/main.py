@@ -114,6 +114,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="[fake] inject stale non-quorum reads")
     t.add_argument("--lost-write-prob", type=float, default=0.0,
                    help="[fake] inject acked-but-lost updates")
+    t.add_argument("--check-mode", default="post",
+                   choices=["post", "stream"],
+                   help="post (default): record the full history, then "
+                        "check it — unchanged behavior. stream: overlap "
+                        "the linearizability check with the live run "
+                        "(stable-prefix chunk dispatch, stream/; the "
+                        "check phase becomes drain+finalize; verdicts "
+                        "are bit-identical). Non-streamable workloads "
+                        "fall back to post.")
+    t.add_argument("--fail-fast", action="store_true",
+                   help="with --check-mode stream: tear the test down "
+                        "the moment the streamed frontier falsifies the "
+                        "history (detection lag bounded by the "
+                        "stream_max_lag_chunks knob) instead of running "
+                        "the full --time-limit")
     t.add_argument("--check-budget-s", type=nonnegative_float, default=120.0,
                    help="wall-clock bound per linearizability search "
                         "(0 = unbounded); expiry yields the tri-state "
@@ -185,7 +200,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated KernelLimits field or probe-"
                         "group names (default: every knob with a probe "
                         "group; groups: dense_sweep, sparse, sched, "
-                        "pipeline, pallas)")
+                        "pipeline, pallas, stream)")
     u.add_argument("--repeats", type=positive_int, default=2,
                    help="best-of repeats per measurement (default 2)")
     u.add_argument("--scale", type=positive_float, default=1.0,
@@ -294,6 +309,8 @@ def _test_opts(args) -> dict:
         "check_budget_s": args.check_budget_s,
         "reorder_prob": args.reorder_prob,
         "duplicate_delivery_prob": args.duplicate_delivery_prob,
+        "check_mode": args.check_mode,
+        "fail_fast": args.fail_fast,
     }
 
 

@@ -74,6 +74,12 @@ SWEEP_COUNTERS = ("wgl.sweep_steps_sparse", "wgl.sweep_steps_dense",
                   "wgl.sweep_checks_sparse", "wgl.sweep_checks_dense",
                   "wgl.sweep_checks_mixed")
 SWEEP_GAUGE = "wgl.live_tile_ratio"
+# Streaming check engine (stream/engine.py): fraction of return steps
+# swept while the run was still live, and the watermark's lag behind
+# the recorder (history entries recorded but not yet stable) — pre-
+# registered so every run's metrics.json carries them (zeros permitted,
+# never absent; a post-hoc run simply records zeros).
+STREAM_GAUGES = ("stream.overlap_ratio", "stream.watermark_lag")
 
 _NULL_TRACER = Tracer(enabled=False)
 _NULL_METRICS = MetricsRegistry(enabled=False)
@@ -94,6 +100,8 @@ class Capture:
                 self.metrics.counter(name)
             self.metrics.gauge(PHASE_GAUGE)
             self.metrics.gauge(SWEEP_GAUGE)
+            for name in STREAM_GAUGES:
+                self.metrics.gauge(name)
 
     def write(self) -> None:
         if not self.enabled or self.out_dir is None:

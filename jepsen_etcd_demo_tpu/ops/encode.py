@@ -34,6 +34,8 @@ surviving config has linearized the op, so its bit is cleared everywhere).
 
 from __future__ import annotations
 
+import heapq
+import itertools
 import time
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence
@@ -303,6 +305,188 @@ def encode_events(invocations: Sequence[Invocation], k_slots: int = 32
     return EncodedHistory(events=events, n_events=len(rows), n_ops=n_ops,
                           k_slots=k_slots, max_pending=max_pending,
                           max_value=max_value)
+
+
+class IncrementalEncoder:
+    """Streaming counterpart of pair_history + encode_events (stream/).
+
+    Consumes history entries ONE AT A TIME (in recorded order) and emits
+    event rows exactly when they become STABLE — when no future history
+    entry can change, remove, or reorder them. The stable-prefix rule:
+
+      * an op's events are determined only once its completion is
+        recorded (``fail`` -> dropped entirely, indeterminate read ->
+        dropped, ``info`` -> EV_INVOKE only, carrying the completion's
+        value, ``ok`` -> EV_INVOKE + EV_RETURN);
+      * therefore every event at a history position at or after the
+        earliest STILL-OPEN invoke is unstable: that op's eventual
+        completion may insert (or not) an EV_INVOKE at that earlier
+        position, shifting everything behind it.
+
+    The *watermark* is that earliest-open-invoke position, ordered by
+    the recorder's monotonic per-entry sequence (``Op.seq`` /
+    append order — never wall clock). An op that will crash pins the
+    watermark from its invoke until its ``:info`` completion is
+    recorded; after that it is encoded pending-forever (WGL open-op
+    semantics — its slot is never freed) and the watermark moves on.
+    Ops still open when the run ends are resolved as ``info`` by
+    :meth:`finalize`, exactly like pair_history's end-of-run rule.
+
+    The emitted rows are BIT-IDENTICAL to the corresponding prefix of
+    ``encode_events(pair_history(history, model))``: same point order
+    (invoke/return points sorted by (position, kind)), same slot
+    assignment — encode_events pops fresh slots in increasing order and
+    reuses freed slots LIFO, which depends only on the event
+    interleaving, never on the slot-table capacity, so the unbounded
+    stack here reproduces any non-overflowing capacity's ids — and the
+    same n_ops / max_pending / max_value bookkeeping
+    (tests/test_stream.py pins it on fuzz histories).
+    """
+
+    def __init__(self, model=None):
+        self.model = model
+        self._open: dict[Any, tuple[int, Op]] = {}   # process -> (idx, op)
+        self._heap: list = []      # (pos, is_return, tiebreak, Invocation)
+        self._tie = itertools.count()
+        self._idx = 0              # history entries consumed
+        self._free: list[int] = []  # freed slot ids (LIFO stack)
+        self._next_slot = 0
+        self._slot_of: dict[int, int] = {}           # invoke_index -> slot
+        self._cur_pending = 0
+        self._row_max: Optional[int] = None
+        self._last_seq = -1        # last recorder seq consumed
+        self._finalized = False
+        self.rows: list[list[int]] = []              # stable event rows
+        self.n_ops = 0
+        self.max_pending = 0
+
+    @property
+    def max_value(self) -> int:
+        # Exactly encode_events' bookkeeping: max over the emitted rows'
+        # (a1, a2, rv) fields, 0 when no rows were emitted.
+        return 0 if self._row_max is None else self._row_max
+
+    def watermark(self) -> int:
+        """First UNSTABLE history position: the earliest still-open
+        invoke's index (== entries consumed when nothing is open)."""
+        if self._finalized or not self._open:
+            return self._idx
+        return min(idx for idx, _ in self._open.values())
+
+    def lag(self) -> int:
+        """History entries consumed but not yet stable (the
+        stream.watermark_lag gauge)."""
+        return self._idx - self.watermark()
+
+    def append(self, op: Op) -> list[list[int]]:
+        """Consume one history entry; returns the newly-STABLE event
+        rows (possibly none). Raises EncodeError on the same malformed
+        shapes pair_history rejects."""
+        if self._finalized:
+            raise EncodeError("append after finalize")
+        # Recorder-stamped entries must arrive in strictly increasing
+        # seq — the total order the watermark's stability argument rests
+        # on. A violation means the feed path reordered (or duplicated)
+        # entries; encoding on would silently corrupt the prefix.
+        if op.seq >= 0:
+            if op.seq <= self._last_seq:
+                raise EncodeError(
+                    f"out-of-order feed: seq {op.seq} after "
+                    f"{self._last_seq} (history index {self._idx})")
+            self._last_seq = op.seq
+        idx = self._idx
+        self._idx += 1
+        if op.type == INVOKE:
+            if op.process in self._open:
+                raise EncodeError(
+                    f"process {op.process} invoked twice without completing "
+                    f"(history indices {self._open[op.process][0]} and {idx})"
+                )
+            self._open[op.process] = (idx, op)
+        elif op.type in (OK, FAIL, INFO):
+            if op.process not in self._open:
+                raise EncodeError(
+                    f"completion for process {op.process} at history index "
+                    f"{idx} has no pending invocation"
+                )
+            inv_idx, inv = self._open.pop(op.process)
+            self._resolve(inv, op, inv_idx, idx)
+        else:
+            raise EncodeError(f"unknown op type {op.type!r} at index {idx}")
+        return self._drain()
+
+    def finalize(self) -> list[list[int]]:
+        """Resolve every still-open invocation as ``info`` (crashed
+        mid-op — pair_history's end-of-run rule) and drain everything;
+        returns the remaining rows. Idempotent."""
+        if not self._finalized:
+            for inv_idx, inv in sorted(self._open.values()):
+                self._resolve(inv, None, inv_idx, -1)
+            self._open.clear()
+            self._finalized = True
+        return self._drain()
+
+    def encoded_history(self, k_slots: int = 32) -> EncodedHistory:
+        """The stable rows as an EncodedHistory — after finalize, this is
+        exactly what ``encode_history(history, model, k_slots)`` under
+        the checker's slot-escalation ladder (k doubles past
+        max_pending, checkers/linearizable.py) would have produced."""
+        k = max(1, int(k_slots))
+        while self.max_pending > k:
+            k *= 2
+        events = (np.asarray(self.rows, dtype=np.int32)
+                  .reshape(-1, EVENT_WIDTH))
+        return EncodedHistory(events=events, n_events=len(self.rows),
+                              n_ops=self.n_ops, k_slots=k,
+                              max_pending=self.max_pending,
+                              max_value=self.max_value)
+
+    # -- internals --------------------------------------------------------
+    def _resolve(self, inv: Op, comp: Optional[Op], inv_idx: int,
+                 comp_idx: int) -> None:
+        invocation = _make_invocation(inv, comp, inv_idx, comp_idx,
+                                      self.model)
+        # The _timeline_points exclusions, applied at resolution time.
+        if invocation.status == FAIL:
+            return
+        if invocation.status == INFO and invocation.f == F_READ:
+            return
+        heapq.heappush(self._heap,
+                       (inv_idx, 0, next(self._tie), invocation))
+        if invocation.status == OK:
+            heapq.heappush(self._heap,
+                           (comp_idx, 1, next(self._tie), invocation))
+
+    def _drain(self) -> list[list[int]]:
+        wm = self.watermark()
+        new: list[list[int]] = []
+        while self._heap and self._heap[0][0] < wm:
+            _pos, is_return, _t, inv = heapq.heappop(self._heap)
+            if not is_return:
+                # encode_events' exact policy: its free list is a stack
+                # seeded [k-1..0], so fresh slots come out in increasing
+                # order and FREED slots are reused most-recent-first.
+                if self._free:
+                    slot = self._free.pop()
+                else:
+                    slot = self._next_slot
+                    self._next_slot += 1
+                self._slot_of[inv.invoke_index] = slot
+                row = [EV_INVOKE, slot, inv.f, inv.a1, inv.a2, inv.rv]
+                self.n_ops += 1
+                self._cur_pending += 1
+                self.max_pending = max(self.max_pending, self._cur_pending)
+            else:
+                slot = self._slot_of.pop(inv.invoke_index)
+                row = [EV_RETURN, slot, inv.f, inv.a1, inv.a2, inv.rv]
+                self._free.append(slot)
+                self._cur_pending -= 1
+            hi = max(row[3], row[4], row[5])
+            self._row_max = hi if self._row_max is None \
+                else max(self._row_max, hi)
+            self.rows.append(row)
+            new.append(row)
+        return new
 
 
 def encode_register_history(history: Sequence[Op], k_slots: int = 32

@@ -203,6 +203,22 @@ class KernelLimits:
     # dense rounds on overflow — configs are never dropped). 2 is the
     # bench/test lane for exercising the sparse path deterministically.
     sparse_mode: int = _f(0, "arch", 0, 2)
+    # [tunable] Return steps per streamed check chunk (stream/engine.py):
+    # the stable-prefix dispatcher accumulates this many stable return
+    # steps before feeding one resumable dense chunk to the device.
+    # Smaller chunks start overlapping with the live run earlier and
+    # tighten the fail-fast detection bound; larger chunks amortize
+    # per-dispatch overhead (one jitted launch per chunk). Verdicts are
+    # chunk-size-independent (the carry chains exactly).
+    stream_flush_ops: int = _f(256, "tunable", 8, 1 << 16, group="stream")
+    # [tunable] Death-poll bound of the streaming dispatcher: at most
+    # this many chunks are dispatched between fetches of the frontier's
+    # death flag, so the falsification LAG behind the live run is
+    # bounded by stream_max_lag_chunks * stream_flush_ops return steps.
+    # 1 polls every chunk (fastest --fail-fast, one host<->device round
+    # trip per chunk); deeper lets the async dispatch pipeline run
+    # ahead between syncs.
+    stream_max_lag_chunks: int = _f(4, "tunable", 1, 64, group="stream")
 
 
 def field_meta() -> dict[str, dict]:

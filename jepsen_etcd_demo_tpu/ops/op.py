@@ -38,6 +38,12 @@ class Op:
     time: int = 0                  # nanoseconds relative to test start
     index: int = -1                # position in the recorded history
     error: Optional[Any] = None    # e.g. "timeout", "not-found"
+    # Monotonic record sequence number, assigned by the HistoryRecorder
+    # at append time from a process-local counter (NOT wall clock): the
+    # total order the streaming checker's stable-prefix watermark keys
+    # on, stable under thread-scheduling jitter even when monotonic_ns
+    # ties. -1 = never recorded (hand-built ops, pre-seq artifacts).
+    seq: int = -1
     extra: dict = field(default_factory=dict)
 
     def is_invoke(self) -> bool:
@@ -50,12 +56,15 @@ class Op:
         d = asdict(self)
         if not d["extra"]:
             d.pop("extra")
+        if d["seq"] < 0:
+            d.pop("seq")   # keep pre-seq artifacts byte-stable
         return json.dumps(d, default=_jsonable)
 
     @staticmethod
     def from_json(line: str) -> "Op":
         d = json.loads(line)
         d.setdefault("extra", {})
+        d.setdefault("seq", -1)
         # JSON round-trips tuples as lists; normalize 2-lists back to tuples so
         # (key, value) independent-tuples survive store round trips.
         v = d.get("value")

@@ -638,11 +638,19 @@ def cached_batch_checker3_packed(model: Model, cfg: DenseConfig):
     return _CACHE[key]
 
 
+def tight_k_for_pending(max_pending: int) -> int:
+    """Smallest mask width serving this max_pending, rounded up to even
+    so nearby concurrencies share one jit cache entry; floor 6 because
+    the packed table needs K >= 5 (and 2^6 masks = 2 words is already
+    tiny). The ONE definition of the tight geometry — the streaming
+    engine (stream/engine.py) keys on it over a running max_pending, so
+    any retune here keeps streamed and post-hoc geometries identical."""
+    return max(6, (max_pending + 1) // 2 * 2)
+
+
 def tight_k_slots(enc: EncodedHistory) -> int:
-    """Smallest mask width serving this history, rounded up to even so
-    nearby concurrencies share one jit cache entry; floor 6 because the
-    packed table needs K >= 5 (and 2^6 masks = 2 words is already tiny)."""
-    return max(6, (enc.max_pending + 1) // 2 * 2)
+    """tight_k_for_pending over an encoded history."""
+    return tight_k_for_pending(enc.max_pending)
 
 
 def step_bucket(n_steps: int, floor: int | None = None) -> int:

@@ -157,9 +157,15 @@ def _maybe_open_barrier(gen: Gen, state: _RunState):
         gen.barrier_done()
 
 
-async def interpret_generators(test: dict, recorder: HistoryRecorder
-                               ) -> list[Op]:
-    """Run the generator interpreter loop to exhaustion; returns history."""
+async def interpret_generators(test: dict, recorder: HistoryRecorder,
+                               stop_check=None) -> list[Op]:
+    """Run the generator interpreter loop to exhaustion; returns history.
+
+    `stop_check` (--fail-fast, runner check-mode stream): a zero-arg
+    callable polled every 50 ms; when it turns true the worker tasks are
+    cancelled and the history recorded so far is returned — the
+    streamed checker has already falsified the run, so finishing the
+    generators would only burn wall clock on a known-invalid test."""
     concurrency = int(test.get("concurrency", 10))
     # Publish the RESOLVED value: thread-identity consumers (generators
     # mapping reincarnated process p + concurrency back to its worker
@@ -178,7 +184,62 @@ async def interpret_generators(test: dict, recorder: HistoryRecorder
     if nemesis is not None:
         tasks.append(asyncio.create_task(
             _worker(test, gen, state, -1, concurrency, None, nemesis)))
-    await asyncio.gather(*tasks)
+    if stop_check is None:
+        await asyncio.gather(*tasks)
+        return recorder.history
+
+    stopped = False
+
+    async def watch():
+        nonlocal stopped
+        while True:
+            await asyncio.sleep(0.05)
+            if stop_check():
+                stopped = True
+                break
+            # A worker that crashed outright (not cancelled) must tear
+            # the rest down NOW: gather(return_exceptions=True) below
+            # would otherwise sit on the exception until every other
+            # worker exhausts the generator — the full --time-limit the
+            # post-mode gather() surfaces immediately. `stopped` stays
+            # False, so the raise-after-gather path re-raises it.
+            if any(t.done() and not t.cancelled()
+                   and t.exception() is not None for t in tasks):
+                break
+        # Keep cancelling until every worker is actually done: a lone
+        # cancel() can be silently swallowed when it races the worker's
+        # own wait_for timeout in _wait (bpo-37658 — the inner waiter
+        # completing during cancellation eats the CancelledError on
+        # py<=3.10), which would leave gather() blocked until the
+        # generator exhausts naturally — the full --time-limit the
+        # abort exists to cut short.
+        while any(not t.done() for t in tasks):
+            for t in tasks:
+                if not t.done():
+                    t.cancel()
+            await asyncio.sleep(0.05)
+
+    watcher = asyncio.create_task(watch())
+    try:
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+    finally:
+        watcher.cancel()
+        try:
+            await watcher
+        except asyncio.CancelledError:
+            pass
+    for r in results:
+        if isinstance(r, BaseException) \
+                and not isinstance(r, asyncio.CancelledError):
+            if stopped:
+                # Workers torn down mid-await can surface secondary
+                # errors; the abort verdict is already decided.
+                log.warning("worker error during fail-fast abort: %r", r)
+            else:
+                raise r
+    if stopped:
+        log.info("=== fail-fast: streamed check falsified the run; "
+                 "aborting with %d history entries", len(recorder.history))
     return recorder.history
 
 
@@ -259,13 +320,49 @@ async def _run_test_inner(test: dict, store) -> dict:
             await nemesis.setup(test)
 
     log.info("=== running workload")
-    recorder = HistoryRecorder()
+    # Streaming check mode (ISSUE 5): the recorder's listener feeds a
+    # background session that watermark-encodes and chunk-dispatches the
+    # stable prefix into the resumable dense sweep WHILE workers run;
+    # the check phase below becomes drain + finalize. Post remains the
+    # default with zero behavior change; a non-streamable checker
+    # topology falls back to post (stream/engine.session_for_test).
+    check_mode = str(test.get("check_mode") or "post").lower()
+    fail_fast = bool(test.get("fail_fast"))
+    session = None
+    if check_mode == "stream":
+        from ..stream import session_for_test
+
+        session = session_for_test(test)
+        if session is None:
+            log.info("=== check-mode stream unavailable for this checker; "
+                     "running post-hoc")
+        elif fail_fast:
+            # Keys the workload rotates away from would otherwise hold
+            # their last partial chunk unswept until the final drain —
+            # at production chunk sizes the abort could never fire.
+            session.enable_eager_flush()
+    recorder = HistoryRecorder(listener=session.feed if session else None)
+
+    def stop_check():
+        # Fail-fast trigger: the streamed frontier falsified the run.
+        if session.falsified():
+            session.aborted = True
+            return True
+        return False
+
     try:
         with tracer.span("run",
-                         concurrency=int(test.get("concurrency", 10))) as sp:
-            history = await interpret_generators(test, recorder)
+                         concurrency=int(test.get("concurrency", 10)),
+                         check_mode="stream" if session else "post") as sp:
+            history = await interpret_generators(
+                test, recorder,
+                stop_check=stop_check if (session and fail_fast) else None)
             sp.set(history_entries=len(history))
     finally:
+        if session is not None:
+            # Close the stream's overlap window; the drain continues on
+            # its own thread underneath the teardown below.
+            session.finish_input()
         with tracer.span("teardown"):
             if nemesis is not None:
                 await nemesis.teardown(test)
@@ -290,6 +387,15 @@ async def _run_test_inner(test: dict, store) -> dict:
     enable_persistent_cache(test.get("store_root"))
     with tracer.span("check") as sp, \
             obs.maybe_jax_trace(store.path if store else None):
+        if session is not None:
+            # Drain + finalize: most of the check already happened
+            # during the run; valid streamed verdicts settle their keys
+            # in the checkers below, invalid keys re-run post-hoc for
+            # witness reconstruction.
+            with tracer.span("check.stream_drain"):
+                stream_results = session.finalize()
+            if stream_results is not None:
+                opts["stream_results"] = stream_results
         result = (checker.check(test, history, opts)
                   if checker is not None else {"valid": True})
         sp.set(valid=str(result.get("valid")),
@@ -297,6 +403,9 @@ async def _run_test_inner(test: dict, store) -> dict:
     result.setdefault("op_count",
                       sum(1 for o in history if o.type == INVOKE))
     result["run_seconds"] = run_s
+    result["check_mode"] = "stream" if session is not None else "post"
+    if session is not None:
+        result["stream"] = session.stats()
     # Which tuning profile the check resolved (ISSUE 4): hash + every
     # non-default KernelLimits field with its provenance tag — lands in
     # results.json so the web run index can say which profile produced

@@ -5,20 +5,41 @@ invoke/complete entries with process ids and timestamps [dep: jepsen core
 recorder]. Append assigns the index and relative-time fields. All appends
 happen on the one event loop, so ordering is the loop's scheduling order —
 the same "real time" order a concurrent checker needs.
+
+Two streaming-check additions (ISSUE 5):
+
+  * every appended entry is stamped with a monotonic per-op ``seq``
+    from a process-local counter. ``time`` (monotonic_ns) is
+    NON-DECREASING but can tie under thread-scheduling jitter; the
+    streaming checker's stable-prefix watermark needs a strict total
+    order, and ``seq`` is that order (it coincides with ``index`` for
+    an unfiltered history, but survives filtering/splitting).
+  * an optional ``listener`` is invoked with each entry AFTER it is
+    fully stamped — the feed point of the streaming check engine
+    (stream/engine.py). A listener must be O(enqueue) cheap (it runs on
+    the event loop); a listener that raises is detached, never allowed
+    to take the run down.
 """
 
 from __future__ import annotations
 
+import itertools
+import logging
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from ..ops.op import Op
 
+log = logging.getLogger(__name__)
+
 
 class HistoryRecorder:
-    def __init__(self, start_ns: Optional[int] = None):
+    def __init__(self, start_ns: Optional[int] = None,
+                 listener: Optional[Callable[[Op], None]] = None):
         self.start_ns = start_ns if start_ns is not None else time.monotonic_ns()
         self.entries: list[Op] = []
+        self.listener = listener
+        self._seq = itertools.count()
 
     def now(self) -> int:
         """Relative ns since test start."""
@@ -26,8 +47,15 @@ class HistoryRecorder:
 
     def append(self, op: Op) -> Op:
         op.index = len(self.entries)
+        op.seq = next(self._seq)
         op.time = self.now()
         self.entries.append(op)
+        if self.listener is not None:
+            try:
+                self.listener(op)
+            except Exception:
+                log.exception("history listener failed; detaching it")
+                self.listener = None
         return op
 
     @property

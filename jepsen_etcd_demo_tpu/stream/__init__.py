@@ -1,0 +1,17 @@
+"""stream — the streaming check engine (ISSUE 5 tentpole).
+
+Overlaps linearizability checking with the live run: history entries
+feed a stable-prefix incremental encoder (ops/encode.py
+IncrementalEncoder) as the recorder appends them, and stable chunks of
+return steps dispatch into the resumable dense WGL3 frontier carry
+while workers are still executing — converting the harness's largest
+remaining serial section (run_time + check_time) into overlap, and
+enabling ``--fail-fast`` teardown the moment a history is falsified.
+
+See engine.py for the architecture; doc/perf.md "Streaming checks" for
+the watermark rule and knobs.
+"""
+
+from .engine import KeyStream, StreamSession, session_for_test
+
+__all__ = ["KeyStream", "StreamSession", "session_for_test"]

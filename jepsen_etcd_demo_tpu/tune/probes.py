@@ -24,6 +24,10 @@ Groups (the `group` metadata on KernelLimits fields, ops/limits.py):
                  (pipelined dense long sweep).
   pallas       — `pallas_step_chunk` / `max_k_pallas` where Mosaic
                  compiles (skipped wholesale off-TPU).
+  stream       — the streaming check engine (stream/engine.py):
+                 `stream_flush_ops` / `stream_max_lag_chunks` via a
+                 full-speed replay of a fixed keyed op stream through
+                 the stable-prefix dispatcher.
 
 Every measurement is warmup-then-best-of-N: the warmup call eats the
 compile (the persistent XLA cache makes it cheap on re-tunes), the min
@@ -46,6 +50,7 @@ SEED_SPARSE = 0x5BA5
 SEED_SCHED = 0x5C4ED
 SEED_PIPE = 0x919E
 SEED_PALLAS = 0x9A11
+SEED_STREAM = 0x57E4
 
 # Per-knob limit pins applied UNDER the candidate override while probing
 # (e.g. the density threshold only matters once the sparse engine is
@@ -274,6 +279,42 @@ class PallasProbe:
             self.ctx.repeats)
 
 
+class StreamProbe:
+    """Streaming check engine knobs: a fixed keyed op stream (disjoint
+    process-id ranges per key, round-robin interleaved — the record
+    order a live independent-key run produces) replayed at full feed
+    speed through the stable-prefix dispatcher (stream/engine.py).
+    Measures the chunk-size / poll-lag tradeoff: smaller chunks start
+    overlapping earlier but pay more dispatches, more frequent death
+    polls sync the pipeline."""
+
+    knobs = ("stream_flush_ops", "stream_max_lag_chunks")
+
+    def __init__(self, ctx: ProbeContext):
+        from ..utils.fuzz import gen_register_history, interleave_keyed
+
+        self.ctx = ctx
+        rng = random.Random(SEED_STREAM)
+        n_keys = max(2, ctx.n(8, 2))
+        per_key = [gen_register_history(rng, n_ops=ctx.n(1200, 120),
+                                        n_procs=8, p_info=0.002)
+                   for _ in range(n_keys)]
+        self.ops = interleave_keyed(per_key)
+
+    def measure(self, knob: str, overrides: dict[str, int]) -> float:
+        from ..stream import StreamSession
+
+        def replay():
+            session = StreamSession(self.ctx.model, keyed=True)
+            for op in self.ops:
+                session.feed(op)
+            res = session.finalize()
+            assert res, "stream probe fixture must stream"
+            return res
+
+        return _with_overrides(overrides, replay, self.ctx.repeats)
+
+
 class ProbeUnavailable(RuntimeError):
     """This probe group cannot run on this backend (recorded as skipped,
     never an error — a CPU tune simply has no pallas lane)."""
@@ -287,4 +328,5 @@ PROBES = {
     "sched": SchedProbe,
     "pipeline": PipelineProbe,
     "pallas": PallasProbe,
+    "stream": StreamProbe,
 }

@@ -143,6 +143,30 @@ def mutate_history(rng: random.Random, history: list[Op],
     return out
 
 
+def interleave_keyed(per_key, proc_stride: int = 1000) -> list[Op]:
+    """Round-robin interleave per-key histories into the single keyed op
+    stream a live independent-key run's recorder would produce: values
+    wrapped as ``(key, v)`` tuples, process ids namespaced into disjoint
+    ``proc_stride``-wide ranges per key so no process spans keys.
+    ``per_key`` is a list of histories (key = position) or a dict
+    ``{key: history}``. Shared by the bench streaming lane, the stream
+    tune probe, and tests/test_stream.py — one definition of the
+    stream's expected record order."""
+    items = list(per_key.items()) if isinstance(per_key, dict) \
+        else list(enumerate(per_key))
+    ops: list[Op] = []
+    cursors = [0] * len(items)
+    while any(c < len(h) for c, (_, h) in zip(cursors, items)):
+        for i, (k, h) in enumerate(items):
+            if cursors[i] < len(h):
+                op = h[cursors[i]]
+                cursors[i] += 1
+                ops.append(Op(type=op.type, f=op.f, value=(k, op.value),
+                              process=proc_stride * i + int(op.process),
+                              time=op.time, error=op.error))
+    return ops
+
+
 # -- other model families (models/gset.py, queues.py, multi_register.py) --
 #
 # Same construction as gen_register_history: simulate the REAL object with

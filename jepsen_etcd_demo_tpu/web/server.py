@@ -89,6 +89,21 @@ def _check_perf_columns(run) -> tuple[str, str, str, str]:
     return eps, waste, sweep, live
 
 
+def _stream_columns(results: dict) -> tuple[str, str]:
+    """(check mode, overlap ratio) columns for the run index, from the
+    run's results.json (runner/core.py stamps check_mode + the stream
+    session record). Blank for runs recorded before streaming existed;
+    overlap shows only for streamed runs (a post run has none by
+    definition)."""
+    mode = results.get("check_mode")
+    if mode not in ("post", "stream"):
+        return "", ""
+    if mode != "stream":
+        return mode, ""
+    ov = (results.get("stream") or {}).get("overlap_ratio")
+    return mode, (f"{ov:.0%}" if isinstance(ov, (int, float)) else "")
+
+
 def _index_html(store: Store) -> str:
     rows = []
     for run in reversed(store.runs()):
@@ -109,6 +124,7 @@ def _index_html(store: Store) -> str:
             thref = urllib.parse.quote(f"/telemetry/{rel}")
             tele = f"<a href='{thref}'>telemetry</a>"
         eps, waste, sweep, live = _check_perf_columns(run)
+        mode, overlap = _stream_columns(results)
         rows.append(
             f"<tr><td><a href='{href}'>"
             f"{html.escape(str(rel))}</a></td>"
@@ -118,6 +134,8 @@ def _index_html(store: Store) -> str:
             f"<td>{html.escape(waste)}</td>"
             f"<td>{html.escape(sweep)}</td>"
             f"<td>{html.escape(live)}</td>"
+            f"<td>{html.escape(mode)}</td>"
+            f"<td>{html.escape(overlap)}</td>"
             f"<td><code>{html.escape(_profile_column(results))}</code></td>"
             f"<td>{tele}</td></tr>")
     return (
@@ -128,6 +146,7 @@ def _index_html(store: Store) -> str:
         f"<table><tr><th>run</th><th>valid</th><th>detail</th>"
         f"<th>check eps</th><th>pad waste</th>"
         f"<th>sweep</th><th>live tiles</th>"
+        f"<th>check mode</th><th>overlap</th>"
         f"<th>profile</th>"
         f"<th>obs</th></tr>"
         f"{''.join(rows)}</table>"
@@ -151,17 +170,42 @@ def _profile_column(results: dict) -> str:
 
 def _perf_summary_html(run_dir) -> str:
     """Compact per-run strip on the telemetry page mirroring the index's
-    perf columns (check eps / pad waste / sweep mode / live-tile ratio);
-    empty when the run recorded none of them."""
+    perf columns (check eps / pad waste / sweep mode / live-tile ratio),
+    plus the streaming check gauges (stream/engine.py) next to them —
+    overlap ratio and the watermark's lag high-water mark; empty when
+    the run recorded none of them."""
     class _Run:
         path = run_dir
 
     eps, waste, sweep, live = _check_perf_columns(_Run)
     bits = [("check eps", eps), ("pad waste", waste), ("sweep", sweep),
             ("live tiles", live)]
+    bits += _stream_gauge_bits(run_dir)
     shown = [f"{name}: <b>{html.escape(val)}</b>"
              for name, val in bits if val]
     return f"<p class='a'>{' · '.join(shown)}</p>" if shown else ""
+
+
+def _stream_gauge_bits(run_dir) -> list[tuple[str, str]]:
+    """The stream.overlap_ratio / stream.watermark_lag gauges from the
+    run's metrics.json, formatted for the telemetry strip. A post-hoc
+    run records both at zero-n (pre-registered, never set) — shown
+    blank."""
+    try:
+        metrics = read_metrics(run_dir / METRICS_FILE)
+    except Exception:
+        return []
+    out: list[tuple[str, str]] = []
+    g = metrics.get("stream.overlap_ratio") or {}
+    if g.get("type") == "gauge" and g.get("n") \
+            and isinstance(g.get("last"), (int, float)):
+        out.append(("stream overlap", f"{g['last']:.0%}"))
+    g = metrics.get("stream.watermark_lag") or {}
+    if g.get("type") == "gauge" and g.get("n") \
+            and g.get("max") is not None:
+        out.append(("watermark lag", f"{g.get('last'):g} "
+                                     f"(max {g['max']:g})"))
+    return out
 
 def _fmt_ms(ns: int) -> str:
     return f"{ns / 1e6:,.1f}"

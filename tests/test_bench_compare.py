@@ -1,0 +1,120 @@
+"""Smoke for tools/bench_compare.py (ISSUE 5 satellite): the perf
+trajectory is machine-checkable — per-lane deltas, regression threshold,
+nonzero exit on a drop, graceful not-comparable on degraded records."""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+TOOLS = Path(__file__).resolve().parent.parent / "tools"
+sys.path.insert(0, str(TOOLS))
+
+import bench_compare  # noqa: E402
+
+
+def _record(eps: float, sched_eps: float = 5000.0,
+            stream_speedup: float = 1.4) -> dict:
+    return {
+        "metric": "wgl_check_throughput", "value": eps,
+        "unit": "history-events/sec", "vs_baseline": 12.0,
+        "cache_hit_rate": 1.0,
+        "degraded": False, "backend": "cpu",
+        "detail": {
+            "corpus_sched": {"events_per_sec": sched_eps},
+            "sparse": {"dense_events_per_sec": 900.0,
+                       "sparse_events_per_sec": 1100.0},
+            "tuned": {"default_events_per_sec": 4000.0,
+                      "tuned_events_per_sec": 4400.0},
+            "streaming": {"speedup_total": stream_speedup,
+                          "overlap_ratio": 0.5},
+            "long_history": [{"ops": 1000, "kernel_s": 0.5},
+                             {"ops": 10000, "kernel_s": 4.0}],
+        },
+    }
+
+
+def test_no_regression_within_threshold():
+    res = bench_compare.compare(_record(1000.0), _record(950.0),
+                                threshold_pct=10.0)
+    assert res["comparable"] is True
+    assert res["regressions"] == []
+    by_lane = {r["lane"]: r for r in res["lanes"]}
+    assert by_lane["throughput_eps"]["delta_pct"] == -5.0
+    assert by_lane["long_1000_eps"]["regression"] is False
+
+
+def test_regression_detected_beyond_threshold():
+    res = bench_compare.compare(_record(1000.0), _record(800.0),
+                                threshold_pct=10.0)
+    assert "throughput_eps" in res["regressions"]
+    by_lane = {r["lane"]: r for r in res["lanes"]}
+    assert by_lane["throughput_eps"]["delta_pct"] == -20.0
+    assert by_lane["throughput_eps"]["regression"] is True
+    # Only the dropped lane flags; flat lanes stay green.
+    assert by_lane["corpus_sched_eps"]["regression"] is False
+
+
+def test_long_history_lanes_invert_seconds():
+    """Long lanes are recorded in seconds (lower is better); the
+    comparison must invert them into rates so a SLOWER record reads as
+    a drop, not a gain."""
+    slow = _record(1000.0)
+    slow["detail"]["long_history"] = [{"ops": 1000, "kernel_s": 1.0}]
+    res = bench_compare.compare(_record(1000.0), slow, threshold_pct=10.0)
+    assert "long_1000_eps" in res["regressions"]
+
+
+def test_missing_lane_is_skipped_not_failed():
+    old = _record(1000.0)
+    del old["detail"]["streaming"]   # older round predates the lane
+    res = bench_compare.compare(old, _record(1000.0), threshold_pct=10.0)
+    by_lane = {r["lane"]: r for r in res["lanes"]}
+    assert by_lane["streaming_speedup"].get("skipped") is True
+    assert res["regressions"] == []
+
+
+def test_degraded_record_not_comparable():
+    """A dead-tunnel round (value 0 / degraded) must not read as a 100%
+    regression — BENCH_r05's record is exactly this shape."""
+    dead = {"metric": "wgl_check_throughput", "value": 0,
+            "vs_baseline": 0, "degraded": True, "backend": "none",
+            "error": "JAX backend unusable"}
+    res = bench_compare.compare(_record(1000.0), dead)
+    assert res["comparable"] is False
+    assert "degraded" in res["reason"]
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    old, new = tmp_path / "old.json", tmp_path / "new.json"
+    old.write_text(json.dumps(_record(1000.0)))
+    new.write_text(json.dumps(_record(800.0)))
+    assert bench_compare.main([str(old), str(new),
+                               "--threshold-pct", "10"]) == 1
+    assert bench_compare.main([str(old), str(new),
+                               "--threshold-pct", "30"]) == 0
+    out = capsys.readouterr().out
+    assert "throughput_eps" in out
+
+    # Driver-wrapper inputs (BENCH_rNN.json shape) unwrap via "parsed";
+    # a degraded new record compares as not-comparable, exit 0.
+    wrapped = tmp_path / "wrapped.json"
+    wrapped.write_text(json.dumps({
+        "n": 5, "cmd": "python bench.py", "rc": 1,
+        "parsed": {"metric": "wgl_check_throughput", "value": 0,
+                   "vs_baseline": 0,
+                   "error": "JAX backend unusable"}}))
+    assert bench_compare.main([str(old), str(wrapped)]) == 0
+    assert "not comparable" in capsys.readouterr().out
+
+
+def test_real_repo_records_load():
+    """The committed BENCH_rNN.json wrappers parse (including the
+    degraded r05) — the tool works on the artifacts it exists for."""
+    repo = Path(__file__).resolve().parent.parent
+    recs = sorted(repo.glob("BENCH_r*.json"))
+    assert recs, "no BENCH_r*.json in repo root"
+    for p in recs:
+        rec = bench_compare.load_record(p)
+        assert "value" in rec, p

@@ -146,7 +146,7 @@ def test_pallas_grouped_sharded_interpret_matches_xla_sharded():
     """The GROUPED pallas kernel under shard_map (each device runs a
     (B/D/G, NC) grid over its shard) must be bit-identical to the sharded
     XLA kernel — the real-pod form of the production fast path."""
-    encs = _corpus(32, seed=0x6C, n_ops=30)   # B/D = 4 groups of G=... 
+    encs = _corpus(16, seed=0x6C, n_ops=30)   # B/D = 2 groups of G=2
     mesh = pdense.batch_mesh()
     d = mesh.shape["batch"]
     cfg, steps, r_cap = wgl3.batch_steps3(encs, MODEL)

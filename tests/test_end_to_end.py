@@ -124,6 +124,15 @@ def test_history_is_well_formed(tmp_path):
             pending.discard(op.process)
     times = [o.time for o in hist]
     assert times == sorted(times)
+    # ISSUE 5 satellite: every entry carries a STRICTLY monotonic
+    # sequence number stamped at record time — the total order the
+    # streaming checker's stable-prefix watermark keys on. Wall-clock
+    # `time` may tie under scheduling jitter; `seq` never does, and it
+    # survives the store round trip.
+    seqs = [o.seq for o in hist]
+    assert all(s >= 0 for s in seqs)
+    assert all(a < b for a, b in zip(seqs, seqs[1:])), \
+        "seq must be strictly increasing in record order"
 
 
 def test_clock_skew_run_is_valid(tmp_path):

@@ -36,11 +36,15 @@ def _steps(h, k):
     return encode_return_steps(enc)
 
 
-def _compare(h, k, chunk=None):
+def _compare(h, k, chunk=32):
+    # chunk=32 keeps the host-loop padding tight at test scale (the
+    # default floor pads tiny histories to >=128 scanned steps, ~4x
+    # wasted sweep on the oversubscribed virtual mesh); boundary
+    # invisibility is pinned by test_chunked_carry_across_host_loop.
     cfg = wgl3.dense_config(MODEL, k, 4, budget=1 << 28)
     assert cfg is not None
     rs = _steps(h, k)
-    single = wgl3.check_steps3_long(rs, MODEL, cfg)
+    single = wgl3.check_steps3_long(rs, MODEL, cfg, chunk=chunk)
     shard = lattice.check_steps_lattice_long(rs, MODEL, cfg, chunk=chunk)
     for f in FIELDS:
         assert single[f] == shard[f], (f, single, shard)
@@ -50,11 +54,11 @@ def _compare(h, k, chunk=None):
 
 def test_matches_single_device_valid_and_invalid():
     rng = random.Random(0xA1)
-    for i in range(4):
-        h = gen_register_history(rng, n_ops=60, n_procs=6)
+    for i in range(2):
+        h = gen_register_history(rng, n_ops=45, n_procs=6)
         if i % 2:
             h = mutate_history(rng, h)
-        _compare(h, k=12)
+        _compare(h, k=10)   # W=16 words over 8 devices: W/D=2
 
 
 def test_w_loc_one_edge_case():
@@ -62,8 +66,8 @@ def test_w_loc_one_edge_case():
     is a device bit, so every high-slot expansion and prune crosses the
     mesh."""
     rng = random.Random(0xB2)
-    for i in range(3):
-        h = gen_register_history(rng, n_ops=40, n_procs=4)
+    for i in range(2):
+        h = gen_register_history(rng, n_ops=28, n_procs=4)
         if i == 1:
             h = mutate_history(rng, h)
         _compare(h, k=8)
@@ -74,7 +78,7 @@ def test_chunked_carry_across_host_loop():
     device-side)."""
     rng = random.Random(0xC3)
     h = gen_register_history(rng, n_ops=120, n_procs=6)
-    _compare(h, k=12, chunk=8)
+    _compare(h, k=10, chunk=8)
 
 
 def test_wide_geometry_k20():
@@ -119,7 +123,7 @@ def test_production_routing_via_general_ladder():
     from jepsen_etcd_demo_tpu.ops.wgl3_pallas import check_encoded_general
 
     rng = random.Random(0xE5)
-    h = gen_register_history(rng, n_ops=60, n_procs=6, p_info=0.2)
+    h = gen_register_history(rng, n_ops=32, n_procs=6, p_info=0.2)
     enc = encode_register_history(h, k_slots=32)
     out = check_encoded_general(enc, MODEL, f_cap=4, f_cap_max=4)
     assert out["kernel"] == "wgl3-dense-lattice-sharded"

@@ -250,7 +250,7 @@ def test_fuzz_differential(family):
     model, gen = FAMILIES[family]
     checker = Linearizable(model=model, backend="jax")
     n_invalid = 0
-    for seed in range(25):
+    for seed in range(20):
         rng = random.Random(0xFA0 + seed)
         for mutate in (False, True):
             h = gen(rng)

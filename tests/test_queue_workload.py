@@ -192,7 +192,7 @@ def test_mutex_run_with_partitions_never_false_positives(tmp_path):
     (search fit the budget) or the honest tri-state "unknown", never
     False."""
     test = fake_test(queue_opts(tmp_path, workload="mutex", seed=27,
-                                time_limit=1.2, check_budget_s=10))
+                                time_limit=1.2, check_budget_s=5))
     result = run(test)
     lin = result["indep"]["linear"]
     assert lin["valid"] is not False

@@ -83,7 +83,7 @@ class TestVerdictEquivalence:
             assert res["valid"] is want, (name, res)
 
     def test_fuzz_corpus_bit_identical_to_unbatched(self):
-        encs = _mixed_corpus(0x5CED, 18)
+        encs = _mixed_corpus(0x5CED, 8)
         results, _kernel, stats = sched.check_corpus(encs, MODEL)
         invalid = 0
         for enc, got in zip(encs, results):

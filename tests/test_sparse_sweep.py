@@ -73,10 +73,13 @@ def test_golden_histories_sparse(restore_limits):
     _pin(sparse_mode=2, sparse_min_tiles=2)
     for name, hist, expected in GOLDEN:
         rs = _steps(hist, 12)
-        cfg = wgl3.dense_config(MODEL, 12, rs.max_value or 4)
+        # Floor the value axis at 4 so the small goldens share one
+        # compiled (cfg, chunk) shape with the fuzz tests below (a
+        # wider table never changes a verdict, just explores more).
+        cfg = wgl3.dense_config(MODEL, 12, max(rs.max_value, 4))
         plan = sparse_plan(cfg)
         assert plan is not None
-        out = check_steps3_long_sparse(rs, MODEL, cfg, plan, chunk=16)
+        out = check_steps3_long_sparse(rs, MODEL, cfg, plan, chunk=64)
         assert out["valid"] == expected, name
 
 
@@ -85,7 +88,7 @@ def test_fuzz_sparse_matches_dense(restore_limits):
     long sweeps must agree on every verdict field."""
     rng = random.Random(0x5AB5)
     n_invalid = 0
-    for i in range(12):
+    for i in range(8):
         h = gen_register_history(rng, n_ops=rng.randrange(40, 160),
                                  n_procs=8, p_info=0.01)
         if i % 2:
@@ -174,13 +177,13 @@ def test_auto_mode_routes_long_sweep_sparse(restore_limits):
     crossover policy itself is pinned by test_sparse_plan_gating."""
     _pin(sparse_mode=0, sparse_min_tiles=2)
     rng = random.Random(0xA070)
-    h = gen_register_history(rng, n_ops=80, n_procs=6)
-    cfg = wgl3.dense_config(MODEL, 14, 4,
+    h = gen_register_history(rng, n_ops=60, n_procs=6)
+    cfg = wgl3.dense_config(MODEL, 13, 4,
                             budget=limits().dense_cell_budget_chunked)
-    rs = _steps(h, 14)
-    got = wgl3.check_steps3_long(rs, MODEL, cfg, chunk=64)
+    rs = _steps(h, 13)
+    got = wgl3.check_steps3_long(rs, MODEL, cfg, chunk=32)
     assert got["kernel"] == "wgl3-dense-sparse-chunked"
-    ref = _dense_ref(rs, cfg, chunk=64)
+    ref = _dense_ref(rs, cfg, chunk=32)
     _assert_same(ref, got, ctx="auto")
 
 
@@ -190,7 +193,7 @@ def test_lattice_shard_boundary_occupancy(restore_limits):
     shards — verdicts bit-identical to the single-device dense sweep.
     K=13 on 8 devices puts tile-index AND device-index bits in play."""
     rng = random.Random(0x1A77)
-    for i in range(3):
+    for i in range(2):
         h = gen_register_history(rng, n_ops=70, n_procs=8, p_info=0.02)
         if i % 2:
             h = mutate_history(rng, h)
@@ -209,7 +212,7 @@ def test_lattice_worklist_overflow_uniform_fallback(restore_limits):
     EVERY device (the pmax side of the all-reduced signal) — and the
     verdict still matches."""
     rng = random.Random(0x1A78)
-    h = gen_register_history(rng, n_ops=90, n_procs=10, p_info=0.05)
+    h = gen_register_history(rng, n_ops=60, n_procs=10, p_info=0.05)
     cfg = wgl3.dense_config(MODEL, 13, 4, budget=1 << 28)
     rs = _steps(h, 13)
     ref = _dense_ref(rs, cfg, chunk=32)
@@ -222,8 +225,8 @@ def test_pallas_sparse_worklist_kernel_interpret(restore_limits):
     """The sparse work-list pallas kernel (interpret mode), windowed
     resume chain included, vs the forced-dense XLA sweep."""
     rng = random.Random(0x9A77)
-    for k, trial in ((13, 0), (14, 1)):
-        h = gen_register_history(rng, n_ops=60, n_procs=8)
+    for k, trial in ((13, 0), (13, 1)):   # valid + mutated, one geometry
+        h = gen_register_history(rng, n_ops=32, n_procs=8)
         if trial % 2:
             h = mutate_history(rng, h)
         cfg = wgl3.dense_config(MODEL, k, 4, budget=1 << 28)
@@ -269,7 +272,7 @@ def test_long_sweep_records_sweep_metrics(restore_limits):
     rs = _steps(h, 12)
     plan = sparse_plan(cfg)
     with obs.capture() as cap:
-        out = check_steps3_long_sparse(rs, MODEL, cfg, plan, chunk=32)
+        out = check_steps3_long_sparse(rs, MODEL, cfg, plan, chunk=64)
     snap = cap.metrics.snapshot()
     assert snap["wgl.sweep_steps_sparse"]["value"] == \
         out["sweep"]["steps_sparse"]

@@ -1,0 +1,448 @@
+"""Streaming check engine (ISSUE 5): golden + fuzz bit-identity of
+streamed vs post-hoc verdicts, crashed-op watermark pinning, geometry
+restarts, corpus multiplex, fail-fast early teardown, and the
+end-to-end runner wiring. Tier-1 fast on CPU."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from jepsen_etcd_demo_tpu.models import CASRegister
+from jepsen_etcd_demo_tpu.ops import wgl3
+from jepsen_etcd_demo_tpu.ops.encode import (IncrementalEncoder,
+                                             encode_register_history,
+                                             encode_return_steps,
+                                             reslot_events)
+from jepsen_etcd_demo_tpu.ops.limits import limits, set_limits
+from jepsen_etcd_demo_tpu.ops.op import Op, invoke
+from jepsen_etcd_demo_tpu.stream import StreamSession, session_for_test
+from jepsen_etcd_demo_tpu.stream.engine import KeyStream
+from jepsen_etcd_demo_tpu.utils.fuzz import (gen_register_history,
+                                             interleave_keyed,
+                                             mutate_history)
+
+MODEL = CASRegister()
+
+VERDICT_FIELDS = ("valid", "survived", "dead_step", "max_frontier",
+                  "configs_explored")
+
+
+@pytest.fixture
+def small_chunks():
+    """Force multiple chunks + frequent death polls at test scale."""
+    prev = set_limits(replace(limits(), stream_flush_ops=16,
+                              stream_max_lag_chunks=1))
+    yield
+    set_limits(prev)
+
+
+def posthoc_long(h):
+    """The post-hoc chunked dense sweep over the same history — the
+    reference the streamed verdict must match bit for bit."""
+    enc = encode_register_history(h, k_slots=32)
+    k = wgl3.tight_k_slots(enc)
+    cfg = wgl3.dense_config(MODEL, k, enc.max_value)
+    enc2 = reslot_events(enc, k) if enc.k_slots != k else enc
+    return wgl3.check_steps3_long(encode_return_steps(enc2), MODEL, cfg), enc
+
+
+# -- incremental encoder ----------------------------------------------------
+
+def test_incremental_encoder_bit_identity_fuzz():
+    """Stable rows == the post-hoc encoding, for valid AND mutated
+    histories with crashed (:info) ops; the stream never emits a row it
+    would later have to take back (append-only prefix property)."""
+    for seed in range(8):
+        rng = random.Random(seed)
+        h = gen_register_history(rng, n_ops=250, n_procs=8, p_info=0.02)
+        if seed % 2:
+            h = mutate_history(rng, h)
+        post = encode_register_history(h, k_slots=32)
+        inc = IncrementalEncoder()
+        emitted = 0
+        for op in h:
+            new = inc.append(op)
+            emitted += len(new)
+            assert emitted == len(inc.rows)
+            assert inc.lag() >= 0
+        inc.finalize()
+        enc = inc.encoded_history(32)
+        assert np.array_equal(enc.events, post.events[: post.n_events]), seed
+        assert (enc.n_ops, enc.k_slots, enc.max_pending, enc.max_value) \
+            == (post.n_ops, post.k_slots, post.max_pending,
+                post.max_value), seed
+
+
+def test_watermark_pins_on_open_and_crashed_ops():
+    """An in-flight op pins the watermark: NOTHING at or after its
+    invoke is stable until its completion is recorded — including a
+    later op's completed pair. A crash (:info) resolves the pin and the
+    op encodes pending-forever (no EV_RETURN for its slot)."""
+    inc = IncrementalEncoder()
+    # p0 invokes a write and hangs (will crash).
+    assert inc.append(invoke("write", 1, process=0)) == []
+    # p1 runs a full read while p0 is still open: UNSTABLE.
+    assert inc.append(invoke("read", None, process=1)) == []
+    assert inc.append(Op(type="ok", f="read", value=None, process=1)) == []
+    assert inc.lag() == 3          # three entries recorded, none stable
+    assert inc.rows == []
+    # p0's crash is recorded: the pin releases, everything drains.
+    rows = inc.append(Op(type="info", f="write", value=1, process=0,
+                         error="timeout"))
+    assert len(rows) == 3           # p0 invoke, p1 invoke, p1 return
+    assert rows[0][0] == 0 and rows[0][1] == 0    # EV_INVOKE slot 0
+    # The crashed op never returns: its slot 0 stays occupied; p1 had
+    # slot 1.
+    assert [r[1] for r in rows] == [0, 1, 1]
+    assert inc.lag() == 0
+    inc.finalize()
+    enc = inc.encoded_history(32)
+    # No EV_RETURN for slot 0 anywhere (pending forever, WGL semantics).
+    ev = enc.events
+    assert not ((ev[:, 0] == 1) & (ev[:, 1] == 0)).any()
+
+
+def test_encoder_rejects_malformed_like_pair_history():
+    from jepsen_etcd_demo_tpu.ops.encode import EncodeError
+
+    inc = IncrementalEncoder()
+    inc.append(invoke("read", None, process=0))
+    with pytest.raises(EncodeError):
+        inc.append(invoke("read", None, process=0))   # double invoke
+    with pytest.raises(EncodeError):
+        IncrementalEncoder().append(
+            Op(type="ok", f="read", value=None, process=9))
+
+
+def test_encoder_rejects_out_of_order_seq():
+    """Recorder-stamped entries must arrive in strictly increasing seq:
+    a reordered (or duplicated) feed would silently corrupt the stable
+    prefix, so the encoder refuses it. Unstamped ops (seq=-1, hand-built
+    histories) are exempt."""
+    from jepsen_etcd_demo_tpu.ops.encode import EncodeError
+
+    inc = IncrementalEncoder()
+    inc.append(replace(invoke("read", None, process=0), seq=5))
+    with pytest.raises(EncodeError, match="out-of-order feed"):
+        inc.append(replace(invoke("write", 1, process=1), seq=5))
+    inc.append(replace(invoke("write", 1, process=1), seq=6))
+    inc.append(invoke("read", None, process=2))   # unstamped: fine
+
+
+# -- streamed vs post-hoc verdicts ------------------------------------------
+
+def test_stream_verdicts_bit_identical_golden_and_fuzz(small_chunks):
+    """Valid + mutated-invalid fuzz histories through the KeyStream:
+    every verdict field matches the post-hoc chunked dense sweep, and
+    the final encoding is bit-identical to the post-hoc encoder's."""
+    for seed in range(4):
+        rng = random.Random(40 + seed)
+        h = gen_register_history(rng, n_ops=240, n_procs=8, p_info=0.01)
+        if seed >= 2:
+            h = mutate_history(rng, h)
+        ks = KeyStream(MODEL, None, k_slots=32)
+        for op in h:
+            ks.feed(op, live=True)
+        res = ks.finalize()
+        post, enc = posthoc_long(h)
+        for f in VERDICT_FIELDS:
+            assert res[f] == post[f], (seed, f, res[f], post[f])
+        assert np.array_equal(res["_enc"].events,
+                              enc.events[: enc.n_events])
+        assert ks.chunks >= 2, "test scale must exercise multiple chunks"
+
+
+def test_stream_geometry_restart_bit_identical(small_chunks):
+    """Values (and concurrency) that GROW mid-run force the dispatcher
+    to restart under a bigger dense geometry; the verdict still matches
+    post-hoc exactly and the restart really happened."""
+    h = []
+    # Phase 1: small values, sequential — establishes a small table.
+    for i in range(24):
+        v = i % 3
+        h.append(invoke("write", v, process=0))
+        h.append(Op(type="ok", f="write", value=v, process=0))
+    # Phase 2: the value domain grows 10x -> n_states outgrows the cfg.
+    for i in range(28):
+        v = 20 + (i % 9)
+        h.append(invoke("write", v, process=0))
+        h.append(Op(type="ok", f="write", value=v, process=0))
+        h.append(invoke("read", None, process=1))
+        h.append(Op(type="ok", f="read", value=v, process=1))
+    ks = KeyStream(MODEL, None, k_slots=32)
+    for op in h:
+        ks.feed(op, live=True)
+    res = ks.finalize()
+    assert ks.restarts >= 1, "fixture must outgrow the initial geometry"
+    post, _enc = posthoc_long(h)
+    for f in VERDICT_FIELDS:
+        assert res[f] == post[f], (f, res[f], post[f])
+
+
+def test_stream_crashed_op_pinning_matches_posthoc(small_chunks):
+    """A long-open op that eventually crashes: the watermark pins while
+    it is open (lag grows), releases on the :info completion, and the
+    final verdict still matches post-hoc (the op is pending forever —
+    linearizable at any later point)."""
+    h = [invoke("write", 4, process=9)]       # will hang for a while
+    rng = random.Random(7)
+    body = gen_register_history(rng, n_ops=120, n_procs=6, p_info=0.0)
+    h += body
+    h.append(Op(type="info", f="write", value=4, process=9,
+                error="timeout"))             # the crash records late
+    ks = KeyStream(MODEL, None, k_slots=32)
+    max_lag = 0
+    for op in h[:-1]:
+        ks.feed(op, live=True)
+        max_lag = max(max_lag, ks.encoder.lag())
+    assert ks.chunks == 0, "pinned watermark must hold back every chunk"
+    assert max_lag >= len(body)
+    ks.feed(h[-1], live=True)                 # crash recorded: pin released
+    res = ks.finalize()
+    post, _enc = posthoc_long(h)
+    for f in VERDICT_FIELDS:
+        assert res[f] == post[f], (f, res[f], post[f])
+
+
+def test_partial_flush_bit_identical(small_chunks):
+    """flush_partial (the fail-fast eager path) injects PADDED chunks
+    mid-stream; pads are scan no-ops and chunks index by real steps, so
+    every verdict field — dead_step especially — still matches post-hoc
+    exactly, for valid and invalid histories alike."""
+    for seed in (7, 8):
+        rng = random.Random(seed)
+        h = gen_register_history(rng, n_ops=180, n_procs=6, p_info=0.01)
+        if seed % 2 == 0:
+            h = mutate_history(rng, h)
+        ks = KeyStream(MODEL, None, k_slots=32)
+        for i, op in enumerate(h):
+            ks.feed(op, live=True)
+            if i % 23 == 0:      # interleave partial flushes mid-stream
+                ks.flush_partial(live=True)
+        res = ks.finalize()
+        post, _enc = posthoc_long(h)
+        for f in VERDICT_FIELDS:
+            assert res[f] == post[f], (seed, f, res[f], post[f])
+        # Padded partial chunks really happened (else this tested nothing)
+        assert ks.steps_done > ks.real_dispatched, seed
+
+
+def test_stream_session_corpus_multiplex(small_chunks):
+    """Keyed session: an interleaved independent-key op stream splits
+    per key exactly like checkers/independent.split_by_key and every
+    key's streamed verdict matches its post-hoc check."""
+    rng = random.Random(99)
+    per_key = {}
+    for k in range(4):
+        h = gen_register_history(rng, n_ops=150, n_procs=6, p_info=0.005)
+        if k == 3:
+            h = mutate_history(rng, h)
+        per_key[k] = h
+    ops = interleave_keyed(per_key, proc_stride=100)
+    session = StreamSession(MODEL, keyed=True, k_slots=32)
+    for op in ops:
+        session.feed(op)
+    results = session.finalize()
+    assert results is not None and set(results) == set(per_key)
+    for k, h in per_key.items():
+        # The mux strips the key wrapper; compare against the per-key
+        # sub-history checked post-hoc.
+        post, _enc = posthoc_long(h)
+        for f in VERDICT_FIELDS:
+            assert results[k][f] == post[f], (k, f)
+    assert results[3]["valid"] is False
+    stats = session.stats()
+    assert stats["keys"] == 4 and stats["streamed_keys"] == 4
+    assert stats["chunks"] >= 4
+
+
+def test_stream_empty_and_no_return_histories(small_chunks):
+    ks = KeyStream(MODEL, None, k_slots=32)
+    assert ks.finalize()["valid"] is True          # empty history
+    ks = KeyStream(MODEL, None, k_slots=32)
+    ks.feed(invoke("write", 1, process=0), live=True)   # open forever
+    res = ks.finalize()
+    assert res["valid"] is True and res["op_count"] == 1
+
+
+def test_session_abandons_unstreamable_shapes():
+    """A keyed session fed non-(key, value) ops must fall back to
+    post-hoc (finalize -> None), never crash the run."""
+    session = StreamSession(MODEL, keyed=True)
+    session.feed(invoke("write", 3, process=0))   # not a (key, v) tuple
+    assert session.finalize() is None
+    assert "fallback" in session.stats()
+
+
+# -- session_for_test topology gating ---------------------------------------
+
+def test_session_for_test_topologies(tmp_path):
+    from jepsen_etcd_demo_tpu.compose import fake_test
+
+    base = dict(store_root=str(tmp_path / "s"), time_limit=1)
+    reg = fake_test(dict(base, workload="register"))
+    s = session_for_test(reg)
+    assert s is not None and s.keyed is True
+    s.finalize()
+    gset = fake_test(dict(base, workload="gset"))
+    s = session_for_test(gset)
+    assert s is not None and s.keyed is False
+    s.finalize()
+    # set: no Linearizable at all; mutex: prepare_history translation —
+    # both fall back to post-hoc.
+    assert session_for_test(fake_test(dict(base, workload="set"))) is None
+    assert session_for_test(fake_test(dict(base, workload="mutex"))) is None
+
+
+# -- end-to-end runner wiring -----------------------------------------------
+
+def _run(test):
+    from jepsen_etcd_demo_tpu.runner import run_test
+
+    return asyncio.run(run_test(test))
+
+
+def _fast_opts(tmp_path, **kw):
+    opts = {"time_limit": 1.5, "rate": 200.0, "ops_per_key": 40,
+            "concurrency": 10, "recovery_wait": 0.1,
+            "nemesis_interval": 0.3, "store_root": str(tmp_path / "store"),
+            "seed": 1, "workload": "register", "no_nemesis": True}
+    opts.update(kw)
+    return opts
+
+
+def test_stream_run_matches_posthoc_recheck(tmp_path, small_chunks):
+    """A full hermetic run in stream mode: valid, streamed backends
+    stamped, tensor artifacts for every key (corpus coverage), and a
+    post-hoc re-check of the stored history produces the identical
+    per-key verdicts."""
+    from jepsen_etcd_demo_tpu.checkers import (Compose, IndependentChecker,
+                                               Linearizable)
+    from jepsen_etcd_demo_tpu.compose import fake_test
+    from jepsen_etcd_demo_tpu.store import Store
+
+    test = fake_test(_fast_opts(tmp_path, check_mode="stream"))
+    result = _run(test)
+    assert result["valid"] is True
+    assert result["check_mode"] == "stream"
+    stream = result["stream"]
+    assert stream["streamed_keys"] == result["indep"]["key_count"] > 0
+    assert stream["failfast_aborted"] is False
+    per_key = result["indep"]["results"]
+    assert all(v["linear"]["backend"] == "jax-dense-streamed"
+               for v in per_key.values())
+    run_dir = Store(test["store_root"]).latest()
+    tensors = list(run_dir.path.glob("history-*.npz"))
+    assert len(tensors) == result["indep"]["key_count"]
+    recheck = IndependentChecker(Compose({
+        "linear": Linearizable("cas-register", backend="jax")})).check(
+        {}, run_dir.read_history(), {})
+    for k, sub in recheck["results"].items():
+        mine = per_key[str(k)]["linear"]
+        assert sub["linear"]["valid"] == mine["valid"], k
+        for f in ("dead_step", "max_frontier", "configs_explored"):
+            if f in sub["linear"] and f in mine:
+                assert sub["linear"][f] == mine[f], (k, f)
+
+
+def test_stream_invalid_run_reconstructs_witness(tmp_path, small_chunks):
+    """Streamed-invalid keys re-run the post-hoc path so the
+    counterexample witness artifacts are unchanged."""
+    from jepsen_etcd_demo_tpu.compose import fake_test
+    from jepsen_etcd_demo_tpu.store import Store
+
+    test = fake_test(_fast_opts(tmp_path, check_mode="stream",
+                                stale_read_prob=0.8, time_limit=2.0,
+                                seed=3))
+    result = _run(test)
+    assert result["valid"] is False
+    assert result["check_mode"] == "stream"
+    run_dir = Store(test["store_root"]).latest().path
+    assert sorted(run_dir.glob("linear-*.json")), \
+        "invalid streamed run must still store a witness"
+
+
+def test_failfast_aborts_before_generator_completes(tmp_path,
+                                                    small_chunks):
+    """Acceptance: --fail-fast tears the run down the moment the
+    streamed frontier falsifies it — far short of --time-limit and of
+    the op budget the generator would otherwise deliver."""
+    from jepsen_etcd_demo_tpu.compose import fake_test
+
+    # Warm the chunk kernel AT THE RUN'S PER-KEY GEOMETRY (each key sees
+    # a handful of processes -> K=6; register values -> S=8) so
+    # detection isn't bound by the one-time jit compile of a cold
+    # (cfg, chunk) shape — in production the persistent XLA compile
+    # cache plays this role. A cold compile under a busy event loop
+    # contends on the GIL both ways and can stall past the time limit.
+    warm = KeyStream(MODEL, None, 32)
+    for op in gen_register_history(random.Random(0), n_ops=120,
+                                   n_procs=4, p_info=0.0):
+        warm.feed(op, live=False)
+    warm.finalize()
+    assert (warm.cfg.k_slots, warm.cfg.n_states) == (6, 8), \
+        "warm fixture drifted off the run's geometry"
+
+    time_limit = 30.0
+    test = fake_test(_fast_opts(tmp_path, check_mode="stream",
+                                fail_fast=True, stale_read_prob=0.5,
+                                time_limit=time_limit, ops_per_key=500,
+                                rate=300.0, seed=3))
+    t0 = time.monotonic()
+    result = _run(test)
+    wall = time.monotonic() - t0
+    assert result["valid"] is False
+    assert result["stream"]["failfast_aborted"] is True
+    assert result["run_seconds"] < time_limit / 2, result["run_seconds"]
+    # The generator had 500 ops/key across many keys budgeted; the
+    # abort must have cut it far short.
+    assert result["op_count"] < 2000
+    assert wall < time_limit, wall
+
+
+def test_failfast_default_knobs_aborts_via_eager_flush(tmp_path):
+    """--fail-fast at PRODUCTION stream knobs: the workload rotates
+    keys long before any accumulates stream_flush_ops (256) stable
+    steps, so without the eager partial flush no chunk would ever
+    dispatch and the abort could never fire. With it, a falsified
+    rotated-away key still trips the watcher within ~the flush
+    interval."""
+    from jepsen_etcd_demo_tpu.compose import fake_test
+
+    # Warm the (cfg, 256) padded-chunk shape the eager flush launches
+    # (persistent-XLA-cache stand-in; a cold jit under the busy event
+    # loop could stall past the deadline this test asserts).
+    warm = KeyStream(MODEL, None, 32)
+    for op in gen_register_history(random.Random(1), n_ops=120,
+                                   n_procs=4, p_info=0.0):
+        warm.feed(op, live=False)
+    warm.finalize()
+
+    time_limit = 30.0
+    test = fake_test(_fast_opts(tmp_path, check_mode="stream",
+                                fail_fast=True, stale_read_prob=0.5,
+                                time_limit=time_limit, ops_per_key=40,
+                                rate=300.0, seed=3))
+    result = _run(test)
+    assert result["valid"] is False
+    assert result["stream"]["failfast_aborted"] is True
+    assert result["run_seconds"] < time_limit / 2, result["run_seconds"]
+
+
+def test_post_mode_results_unchanged(tmp_path):
+    """Default mode stays post: no stream record, no streamed backends —
+    the zero-behavior-change half of the acceptance criteria."""
+    from jepsen_etcd_demo_tpu.compose import fake_test
+
+    result = _run(fake_test(_fast_opts(tmp_path)))
+    assert result["valid"] is True
+    assert result["check_mode"] == "post"
+    assert "stream" not in result
+    assert all("streamed" not in v["linear"]
+               for v in result["indep"]["results"].values())

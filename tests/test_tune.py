@@ -421,10 +421,10 @@ class TestVerdictBitIdentity:
         encs = [encode_register_history(h, k_slots=16)
                 for _name, h, _want in GOLDEN if h]
         rng = random.Random(0x7E57)
-        # 12 histories keep several distinct bucket shapes per arm while
+        # 8 histories keep several distinct bucket shapes per arm while
         # bounding the double compile bill (each arm's floors compile
         # their own shapes — that difference IS the coverage).
-        for i in range(12):
+        for i in range(8):
             h = gen_register_history(rng, n_ops=rng.randrange(8, 150),
                                      n_procs=rng.randrange(2, 8),
                                      p_info=rng.choice([0.0, 0.02]))

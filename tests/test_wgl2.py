@@ -53,7 +53,7 @@ def test_v2_matches_oracle_fuzzed():
     model = CASRegister()
     disagreements = 0
     n_invalid = 0
-    for i in range(60):
+    for i in range(14):
         h = gen_register_history(rng, n_ops=rng.randrange(5, 60),
                                  n_procs=rng.randrange(2, 7))
         if i % 2 == 0:
@@ -80,7 +80,7 @@ def test_v2_matches_v3():
 
     rng = random.Random(0xF3)
     model = CASRegister()
-    for i in range(20):
+    for i in range(9):
         h = gen_register_history(rng, n_ops=40, n_procs=5)
         if i % 3 == 0:
             h = mutate_history(rng, h)

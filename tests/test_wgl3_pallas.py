@@ -44,7 +44,7 @@ def test_differential_vs_xla_kernel():
     """Fuzzed valid + mutated histories: every result field must match the
     XLA dense kernel exactly (same search, same metrics)."""
     encs = []
-    for i in range(12):
+    for i in range(6):
         h = gen_register_history(random.Random(i), n_ops=70, n_procs=8,
                                  p_info=0.01)
         if i % 2:
@@ -58,7 +58,7 @@ def test_differential_vs_xla_kernel():
 
 
 def test_differential_vs_oracle_single():
-    for i in range(4):
+    for i in range(3):
         h = gen_register_history(random.Random(50 + i), n_ops=50, n_procs=6)
         enc = encode_register_history(h, k_slots=16)
         want = check_events_oracle(enc, MODEL).valid
@@ -68,7 +68,7 @@ def test_differential_vs_oracle_single():
 def test_step_chunking_long_history():
     """R > STEP_CHUNK forces the multi-chunk grid with scratch-carried
     search state; results must match the single-block XLA kernel."""
-    h = gen_register_history(random.Random(9), n_ops=1500, n_procs=8,
+    h = gen_register_history(random.Random(9), n_ops=1100, n_procs=8,
                              p_info=0.0005)
     enc = encode_register_history(h, k_slots=32)
     steps = wgl3.step_bucket(
@@ -191,8 +191,8 @@ def test_grouped_kernel_bit_identical_ragged():
     per-history death metadata under group padding."""
     rng = random.Random(0x6A)
     encs = []
-    for i in range(11):          # 11 % 8 != 0: exercises group padding
-        h = gen_register_history(rng, n_ops=45, n_procs=6)
+    for i in range(9):           # 9 % 8 != 0: exercises group padding
+        h = gen_register_history(rng, n_ops=32, n_procs=6)
         if i % 3 == 0:
             h = mutate_history(rng, h)
         encs.append(encode_register_history(h, k_slots=16))
@@ -215,7 +215,7 @@ def test_grouped_kernel_multi_chunk_carry():
 
     rng = random.Random(0x6B)
     encs = [encode_register_history(
-        gen_register_history(rng, n_ops=120, n_procs=6), k_slots=16)
+        gen_register_history(rng, n_ops=55, n_procs=6), k_slots=16)
         for _ in range(8)]
     cfg, steps, r_cap = wgl3.batch_steps3(encs, MODEL)
     arrays = wgl3.stack_steps3(steps, r_cap)
@@ -243,7 +243,7 @@ def test_resumable_long_sweep_matches_xla_chunked():
 
     prev = set_limits(KernelLimits(max_r_pallas=64, pallas_step_chunk=32))
     try:
-        for trial in range(4):
+        for trial in range(3):
             h = gen_register_history(random.Random(trial), n_ops=300,
                                      n_procs=6, p_info=0.01)
             if trial % 2:

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Diff two bench records — make the perf trajectory machine-checkable.
+
+The repo accumulates one `BENCH_rNN.json` per round (the driver's
+wrapper around `python bench.py`'s single JSON line), and until now the
+only way to see a regression was to eyeball them. This tool diffs two
+records lane by lane and exits nonzero when any lane dropped more than
+the threshold:
+
+    python tools/bench_compare.py BENCH_r04.json BENCH_r05.json
+    python tools/bench_compare.py old.json new.json --threshold-pct 5
+
+Accepted inputs, per file: the driver wrapper (`{"parsed": {...}}` —
+the `parsed` record is used; a wrapper whose bench crashed carries no
+parsed record and compares as degraded), or the raw bench line itself.
+
+Lanes (all higher-is-better events/s or ratios): the top-level
+throughput + vs_baseline, the corpus_sched / sparse / tuned / streaming
+lane rates, the long-history lanes keyed by op count, and cache /
+padding health. A lane missing from EITHER record is reported as
+skipped, never a failure (older rounds predate newer lanes). A DEGRADED
+record (`degraded: true` or `value == 0` / backend none) is not a
+perf measurement at all: the comparison is reported as not-comparable
+and exits 0 — a dead TPU tunnel must not read as a 100% regression.
+
+Importable: `load_record(path)`, `compare(old, new, threshold_pct)` —
+`tests/test_bench_compare.py` smokes both plus the exit-code contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Any, Optional
+
+DEFAULT_THRESHOLD_PCT = 10.0
+
+# (lane name, path into the record). All higher-is-better.
+LANES: list[tuple[str, tuple]] = [
+    ("throughput_eps", ("value",)),
+    ("vs_baseline", ("vs_baseline",)),
+    ("corpus_sched_eps", ("detail", "corpus_sched", "events_per_sec")),
+    ("cache_hit_rate", ("cache_hit_rate",)),
+    ("sparse_dense_eps", ("detail", "sparse", "dense_events_per_sec")),
+    ("sparse_sparse_eps", ("detail", "sparse", "sparse_events_per_sec")),
+    ("tuned_default_eps", ("detail", "tuned", "default_events_per_sec")),
+    ("tuned_tuned_eps", ("detail", "tuned", "tuned_events_per_sec")),
+    ("streaming_speedup", ("detail", "streaming", "speedup_total")),
+    ("streaming_overlap", ("detail", "streaming", "overlap_ratio")),
+]
+# Long-history lanes: seconds, LOWER is better — handled via inversion.
+LONG_LANES_PATH = ("detail", "long_history")
+
+
+def load_record(path: str | Path) -> dict:
+    """A bench record from a BENCH_rNN.json driver wrapper or a raw
+    bench output file. A wrapper without a parseable record (the bench
+    crashed / emitted nothing) returns a degraded stand-in rather than
+    raising, so comparisons against a dead round degrade gracefully."""
+    data = json.loads(Path(path).read_text())
+    if "parsed" in data or "cmd" in data:      # driver wrapper
+        rec = data.get("parsed")
+        if not isinstance(rec, dict):
+            return {"value": 0, "degraded": True,
+                    "error": "wrapper has no parsed bench record"}
+        return rec
+    return data
+
+
+def is_degraded(rec: dict) -> bool:
+    return bool(rec.get("degraded")) or rec.get("backend") == "none" \
+        or not rec.get("value")
+
+
+def _dig(rec: dict, path: tuple) -> Optional[float]:
+    v = _dig_raw(rec, path)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _long_lanes(rec: dict) -> dict[str, float]:
+    """{'long_<ops>_eps': ops/kernel_s} per long-history entry — the
+    seconds inverted into a rate so every lane is higher-is-better."""
+    entries = _dig_raw(rec, LONG_LANES_PATH)
+    out: dict[str, float] = {}
+    if not isinstance(entries, list):
+        return out
+    for e in entries:
+        if isinstance(e, dict) and e.get("kernel_s") and e.get("ops"):
+            out[f"long_{e['ops']}_eps"] = e["ops"] / e["kernel_s"]
+    return out
+
+
+def _dig_raw(rec: dict, path: tuple):
+    cur: Any = rec
+    for p in path:
+        if not isinstance(cur, dict) or p not in cur:
+            return None
+        cur = cur[p]
+    return cur
+
+
+def compare(old: dict, new: dict,
+            threshold_pct: float = DEFAULT_THRESHOLD_PCT) -> dict:
+    """Per-lane deltas + the regression verdict.
+
+    Returns {"comparable": bool, "reason": str|None,
+             "lanes": [{lane, old, new, delta_pct, regression}],
+             "regressions": [lane...], "threshold_pct": float}."""
+    out: dict = {"comparable": True, "reason": None, "lanes": [],
+                 "regressions": [], "threshold_pct": threshold_pct}
+    for rec, name in ((old, "old"), (new, "new")):
+        if is_degraded(rec):
+            out["comparable"] = False
+            out["reason"] = (f"{name} record is degraded "
+                             f"({rec.get('error') or rec.get('backend') or 'value 0'}); "
+                             f"not a perf measurement")
+            return out
+    pairs = [(lane, _dig(old, path), _dig(new, path))
+             for lane, path in LANES]
+    old_long, new_long = _long_lanes(old), _long_lanes(new)
+    pairs += [(lane, old_long.get(lane), new_long.get(lane))
+              for lane in sorted(set(old_long) | set(new_long))]
+    for lane, o, n in pairs:
+        if o is None or n is None or o == 0:
+            out["lanes"].append({"lane": lane, "old": o, "new": n,
+                                 "delta_pct": None, "regression": False,
+                                 "skipped": True})
+            continue
+        delta = (n - o) / o * 100.0
+        reg = delta < -threshold_pct
+        out["lanes"].append({"lane": lane, "old": round(o, 4),
+                             "new": round(n, 4),
+                             "delta_pct": round(delta, 2),
+                             "regression": reg})
+        if reg:
+            out["regressions"].append(lane)
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="diff two bench records; nonzero exit on a lane "
+                    "regression beyond the threshold")
+    p.add_argument("old", help="baseline record (BENCH_rNN.json or raw)")
+    p.add_argument("new", help="candidate record")
+    p.add_argument("--threshold-pct", type=float,
+                   default=DEFAULT_THRESHOLD_PCT,
+                   help="fail when a lane drops more than this percent "
+                        f"(default {DEFAULT_THRESHOLD_PCT:g})")
+    p.add_argument("--json", action="store_true",
+                   help="emit the comparison as one JSON object")
+    args = p.parse_args(argv)
+    try:
+        old, new = load_record(args.old), load_record(args.new)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    res = compare(old, new, args.threshold_pct)
+    if args.json:
+        print(json.dumps(res, indent=2))
+    else:
+        if not res["comparable"]:
+            print(f"not comparable: {res['reason']}")
+        else:
+            w = max((len(r["lane"]) for r in res["lanes"]), default=4)
+            for r in res["lanes"]:
+                if r.get("skipped"):
+                    print(f"{r['lane']:<{w}}  (skipped: absent in one "
+                          f"record)")
+                else:
+                    flag = "  << REGRESSION" if r["regression"] else ""
+                    print(f"{r['lane']:<{w}}  {r['old']:>12g} -> "
+                          f"{r['new']:>12g}  {r['delta_pct']:+7.2f}%{flag}")
+    if not res["comparable"]:
+        return 0
+    if res["regressions"]:
+        print(f"FAIL: {len(res['regressions'])} lane(s) regressed more "
+              f"than {args.threshold_pct:g}%: "
+              f"{', '.join(res['regressions'])}", file=sys.stderr)
+        return 1
+    print(f"ok: no lane regressed more than {args.threshold_pct:g}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
