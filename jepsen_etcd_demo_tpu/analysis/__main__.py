@@ -1,0 +1,7 @@
+"""`python -m jepsen_etcd_demo_tpu.analysis` -> the jtlint CLI."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,201 @@
+"""Shared AST plumbing for jtlint rules.
+
+Everything here is stdlib-``ast`` only — the lint layer must never
+import jax (it runs in tier-1's fast path; tests/test_lint.py asserts
+the no-jax property in a subprocess).
+
+The helpers encode the repo's import idioms once so rules don't each
+re-derive them: ``ImportMap`` resolves local names to dotted origins
+(``from jax import jit as j`` -> ``j`` means ``jax.jit``), ``dotted``
+renders attribute chains (``self.carry.dead`` -> that string), and the
+enclosing-scope walkers answer "is this node inside a loop / which
+function owns it" without each rule re-threading parent links.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+# Shared name heuristics — ONE definition so paired rules can never
+# diverge: JTL201's lock identity and JTL203's under-lock exemption
+# must recognize the same lock-like names; likewise the cache-store
+# checks in JTL101 and JTL105.
+LOCKISH_RE = re.compile(r"lock$|^lock|mutex", re.I)
+CACHE_NAME_RE = re.compile(r"cache", re.I)
+
+
+def parse_module(text: str, filename: str = "<lint>") -> ast.Module:
+    """Parse + annotate every node with ``.jt_parent`` (None at root)."""
+    tree = ast.parse(text, filename=filename)
+    tree.jt_parent = None  # type: ignore[attr-defined]
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.jt_parent = node  # type: ignore[attr-defined]
+    return tree
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "jt_parent", None)
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = parent(node)
+    while cur is not None:
+        yield cur
+        cur = parent(cur)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain; None for anything else."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ImportMap:
+    """Local-name -> dotted-origin resolution from a module's imports.
+
+    ``import jax`` maps ``jax`` -> ``jax``; ``from jax import jit as j``
+    maps ``j`` -> ``jax.jit``; ``from ..obs import instrument_kernel``
+    maps the name -> ``obs.instrument_kernel`` (relative dots dropped —
+    rules match on suffixes).
+    """
+
+    def __init__(self, tree: ast.Module):
+        self.names: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.names[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = (node.module or "").lstrip(".")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    origin = f"{mod}.{a.name}" if mod else a.name
+                    self.names[a.asname or a.name] = origin
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted origin of a Name/Attribute expression, imports applied:
+        ``jax.jit`` stays ``jax.jit``; an aliased ``j`` becomes
+        ``jax.jit``."""
+        d = dotted(node)
+        if d is None:
+            return None
+        head, _, rest = d.partition(".")
+        origin = self.names.get(head, head)
+        return f"{origin}.{rest}" if rest else origin
+
+    def is_call_to(self, call: ast.Call, *suffixes: str) -> bool:
+        """True when the call's resolved function name equals or ends
+        with any of the given dotted suffixes."""
+        origin = self.resolve(call.func)
+        if origin is None:
+            return False
+        return any(origin == s or origin.endswith("." + s)
+                   for s in suffixes)
+
+
+def enclosing_function(node: ast.AST):
+    """Nearest enclosing FunctionDef/AsyncFunctionDef, or None."""
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return a
+    return None
+
+
+def enclosing_class(node: ast.AST):
+    for a in ancestors(node):
+        if isinstance(a, ast.ClassDef):
+            return a
+    return None
+
+
+def in_loop(node: ast.AST) -> bool:
+    """Inside a for/while body within the SAME function scope (loops in
+    an outer function don't count — the inner def is its own unit).
+    Comprehensions don't count as loops here: rules that care about
+    per-iteration host work mean statement loops."""
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return False
+        if isinstance(a, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+    return False
+
+
+def walk_same_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Descendants of `node` WITHOUT crossing into nested function /
+    lambda bodies: a `with lock:` inside a deferred callback defined
+    here runs later, under different held state, and must not count as
+    nested under this scope's locks (same boundary in_loop respects)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def ancestors_same_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Ancestors up to (excluding) the nearest enclosing function/
+    lambda — the dual of walk_same_scope."""
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return
+        yield a
+
+
+def call_args_source(node: ast.AST, text: str = "") -> str:
+    """Approximate source text of a node. Uses ast.unparse (pure AST —
+    ast.get_source_segment would rescan the file per node, O(n^2) over
+    a module) so the whole-package lint stays inside tier-1's budget."""
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def assigned_names(target: ast.AST) -> set[str]:
+    """Every dotted name bound by an assignment target (tuple targets
+    flattened; subscripted/starred bases included by their base chain:
+    ``self.carry, p = ...`` binds {"self.carry", "p"})."""
+    out: set[str] = set()
+    for n in ast.walk(target):
+        if isinstance(n, (ast.Name, ast.Attribute)):
+            d = dotted(n)
+            if d is not None:
+                out.add(d)
+    return out
+
+
+def statement_of(node: ast.AST) -> ast.stmt:
+    """The statement a node belongs to (the node itself if a stmt)."""
+    cur: ast.AST = node
+    while not isinstance(cur, ast.stmt):
+        p = parent(cur)
+        if p is None:
+            break
+        cur = p
+    return cur  # type: ignore[return-value]
+
+
+def decorator_names(fn, imports: ImportMap) -> set[str]:
+    out = set()
+    for d in fn.decorator_list:
+        target = d.func if isinstance(d, ast.Call) else d
+        origin = imports.resolve(target)
+        if origin:
+            out.add(origin)
+    return out

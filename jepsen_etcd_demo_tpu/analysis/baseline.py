@@ -1,0 +1,101 @@
+"""Checked-in lint baseline: accepted findings by stable fingerprint.
+
+The baseline file (default ``.jtlint-baseline.json`` at the repo root)
+maps finding fingerprints to a record carrying the rule, path, and a
+REQUIRED human justification note — the file is reviewed like code, so
+every accepted finding carries its argument. ``--strict`` fails on any
+finding NOT in the baseline; stale entries (fingerprints no longer
+produced — the flagged code changed or was fixed) are reported so the
+file never accretes dead weight.
+
+Fingerprints are line-drift tolerant (analysis/findings.py), so the
+baseline survives edits elsewhere in a file and goes stale exactly when
+the flagged line itself changes.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional
+
+from .findings import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = ".jtlint-baseline.json"
+
+
+@dataclass
+class Baseline:
+    path: Optional[Path] = None
+    entries: dict[str, dict] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        data = json.loads(Path(path).read_text(encoding="utf-8"))
+        if data.get("version") != BASELINE_VERSION:
+            raise ValueError(
+                f"{path}: unsupported baseline version "
+                f"{data.get('version')!r} (want {BASELINE_VERSION})")
+        entries = data.get("findings")
+        if not isinstance(entries, dict):
+            raise ValueError(f"{path}: baseline 'findings' must be a "
+                             f"fingerprint -> record object")
+        return cls(path=Path(path), entries=entries)
+
+    @classmethod
+    def load_or_empty(cls, path: Optional[Path]) -> "Baseline":
+        if path is None or not Path(path).is_file():
+            return cls(path=Path(path) if path else None)
+        return cls.load(path)
+
+    def save(self, path: Optional[Path] = None) -> Path:
+        path = Path(path or self.path or DEFAULT_BASELINE)
+        payload = {"version": BASELINE_VERSION, "findings": dict(
+            sorted(self.entries.items()))}
+        path.write_text(json.dumps(payload, indent=2) + "\n",
+                        encoding="utf-8")
+        self.path = path
+        return path
+
+    def split(self, findings: Iterable[Finding],
+              covered_paths: Optional[set[str]] = None,
+              ran_rules: Optional[set[str]] = None
+              ) -> tuple[list[Finding], list[Finding], list[str]]:
+        """(new, baselined, stale-fingerprints): findings not covered by
+        the baseline, findings it accepts, and entries no longer
+        produced by the lint run.
+
+        Staleness is judged only against `covered_paths` (repo-relative,
+        the files this run actually scanned) and `ran_rules` (rule ids
+        this run executed): a partial run — ``lint --strict <subdir>``
+        or ``--rules JTL101`` — must not report entries for unscanned
+        files / un-run rules as "fixed" (nor let --write-baseline prune
+        them). None = everything was in scope."""
+        new: list[Finding] = []
+        baselined: list[Finding] = []
+        seen: set[str] = set()
+        for f in findings:
+            if f.fingerprint in self.entries:
+                baselined.append(f)
+                seen.add(f.fingerprint)
+            else:
+                new.append(f)
+        stale = [fp for fp, ent in self.entries.items()
+                 if fp not in seen
+                 and (covered_paths is None
+                      or ent.get("path") in covered_paths)
+                 and (ran_rules is None or ent.get("rule") in ran_rules)]
+        return new, baselined, stale
+
+    def extend(self, findings: Iterable[Finding],
+               note: str = "TODO: justify this accepted finding") -> None:
+        """Accept findings into the baseline, preserving any existing
+        entry's note (the human-authored part)."""
+        for f in findings:
+            prev = self.entries.get(f.fingerprint, {})
+            self.entries[f.fingerprint] = {
+                "rule": f.rule, "path": f.path, "line": f.line,
+                "message": f.message,
+                "note": prev.get("note", note)}

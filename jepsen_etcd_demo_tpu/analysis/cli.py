@@ -1,0 +1,170 @@
+"""`jepsen-tpu lint` — the jtlint CLI (also `python -m ...analysis`).
+
+Exit codes: 0 clean (non-strict always exits 0 unless the run itself
+errored), 1 = --strict with unbaselined findings or stale baseline
+entries, 2 = usage error. The default target is the package itself;
+the default baseline is <repo-root>/.jtlint-baseline.json when
+present. This module imports nothing heavy — no jax, no kernel code —
+so the tier-1 wiring stays well under its 5 s budget.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+from .baseline import Baseline, DEFAULT_BASELINE
+from .core import PACKAGE_NAME, resolve_rules
+from .engine import find_repo_root, run_lint
+from .findings import format_json, format_text
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="jepsen-tpu lint",
+        description="jtlint: JAX kernel hygiene + concurrency "
+                    "discipline static analysis (doc/analysis.md)")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the "
+                        "jepsen_etcd_demo_tpu package)")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 on any unbaselined finding or stale "
+                        "baseline entry (the tier-1 gate)")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON object instead of text")
+    p.add_argument("--rules", default=None, metavar="IDS",
+                   help="comma-separated rule ids/names to run "
+                        "(default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule reference (id, name, scopes, "
+                        "rationale) and exit")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help=f"baseline file (default: <repo-root>/"
+                        f"{DEFAULT_BASELINE} when it exists)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept every current finding into the "
+                        "baseline file (notes on existing entries are "
+                        "preserved; new entries get a TODO note to "
+                        "justify)")
+    p.add_argument("--no-project-rules", action="store_true",
+                   help="skip project-level rules (the doc lint)")
+    return p
+
+
+def _list_rules(rules) -> str:
+    out = []
+    for rid in sorted(rules):
+        r = rules[rid]
+        scopes = ", ".join(r.scopes) if r.scopes else "whole package"
+        out.append(f"{rid} {r.name}  [{scopes}]\n"
+                   f"    {r.rationale}\n    fix: {r.hint}")
+    return "\n".join(out)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        rules = resolve_rules(args.rules)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.list_rules:
+        print(_list_rules(rules))
+        return 0
+    if args.no_baseline and args.write_baseline:
+        # Writing "ignore the baseline" INTO the checked-in baseline
+        # file would clobber it with every current finding.
+        print("error: --no-baseline and --write-baseline conflict",
+              file=sys.stderr)
+        return 2
+
+    if args.paths:
+        paths = [Path(p) for p in args.paths]
+        missing = [p for p in paths if not p.exists()]
+        if missing:
+            # A typo'd CI path must not read as a clean lint.
+            print("error: no such path(s): "
+                  + ", ".join(str(p) for p in missing), file=sys.stderr)
+            return 2
+        root = find_repo_root(paths[0])
+    else:
+        root = find_repo_root(Path(__file__))
+        paths = [root / PACKAGE_NAME]
+        if not paths[0].is_dir():
+            print(f"error: cannot locate the {PACKAGE_NAME} package "
+                  f"from {root}; pass explicit paths", file=sys.stderr)
+            return 2
+
+    # One loading path for --baseline and the repo default: a corrupt /
+    # wrong-version baseline must be the documented exit-2 usage error
+    # on BOTH (the default path is the tier-1 invocation), never a raw
+    # traceback.
+    try:
+        if args.no_baseline:
+            baseline = Baseline()
+        elif args.baseline:
+            bp = Path(args.baseline)
+            baseline = (Baseline.load(bp) if bp.is_file()
+                        else Baseline(path=bp))
+        else:
+            baseline = Baseline.load_or_empty(root / DEFAULT_BASELINE)
+    except (ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    res = run_lint(paths, rules=rules, root=root, baseline=baseline,
+                   project_rules=not args.no_project_rules)
+    if res.files == 0:
+        # Nothing scanned can never read as a clean lint (a green that
+        # checked nothing is the worst CI outcome).
+        print(f"error: no Python files found under "
+              f"{', '.join(str(p) for p in paths)}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        baseline.extend(res.findings)
+        # Prune what this run proved stale (scoped to scanned paths):
+        # the stale-entry message names --write-baseline as the fix, so
+        # it must actually remove them or --strict stays red forever.
+        for fp in res.stale_baseline:
+            baseline.entries.pop(fp, None)
+        path = baseline.save(baseline.path or root / DEFAULT_BASELINE)
+        print(f"baseline: {len(res.findings)} finding(s) accepted, "
+              f"{len(res.stale_baseline)} stale entr"
+              f"{'y' if len(res.stale_baseline) == 1 else 'ies'} pruned "
+              f"-> {path} — add a justification note per entry")
+        return 0
+
+    if args.json:
+        print(format_json(
+            res.findings, files=res.files,
+            suppressed=len(res.suppressed), baselined=len(res.baselined),
+            stale_baseline=res.stale_baseline, strict=args.strict,
+            ok=res.ok()))
+    else:
+        if res.findings:
+            print(format_text(res.findings))
+        for fp in res.stale_baseline:
+            ent = baseline.entries.get(fp, {})
+            print(f"stale baseline entry {fp} "
+                  f"({ent.get('rule', '?')} {ent.get('path', '?')}): the "
+                  f"flagged code changed or was fixed — remove the "
+                  f"entry (or re-run --write-baseline)")
+        print(f"jtlint: {res.files} file(s), "
+              f"{len(res.findings)} finding(s), "
+              f"{len(res.suppressed)} suppressed, "
+              f"{len(res.baselined)} baselined"
+              + (f", {len(res.stale_baseline)} stale baseline entr"
+                 f"{'y' if len(res.stale_baseline) == 1 else 'ies'}"
+                 if res.stale_baseline else ""))
+    if args.strict and not res.ok():
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

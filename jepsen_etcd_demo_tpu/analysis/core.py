@@ -1,0 +1,220 @@
+"""jtlint rule framework: ModuleSource, Rule base classes, registry.
+
+A rule is a small class with an id, a human name, the package scopes it
+applies to, a rationale (citing the incident that motivated it — see
+doc/analysis.md), and a fix hint. Module rules get a parsed
+``ModuleSource`` and yield :class:`~.findings.Finding` rows; project
+rules run once per lint invocation against the repo root (the doc lint
+lives there). Registration is import-time via the :func:`register`
+decorator — ``analysis/rules/__init__.py`` imports every rule module,
+so ``all_rules()`` is the complete suite.
+
+Suppression syntax (matched on the finding's line or the line above):
+
+    # jtlint: disable=JTL103 -- bounded death poll, see doc/perf.md
+
+The justification after ``--`` is REQUIRED by convention (doc/
+analysis.md): a suppression is an argument, not an off switch. Multiple
+ids comma-separate; ``disable=all`` silences every rule for that line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterator, Optional
+
+from .astutil import ImportMap, parse_module
+from .findings import Finding
+
+# Top-level package directories each rule family runs over (ISSUE 7):
+# kernel hygiene = the jit/device hot paths; concurrency = everything
+# with threads or event loops. "" means top-level modules (compose.py).
+KERNEL_SCOPES = ("ops", "parallel", "sched", "stream", "tune")
+CONCURRENCY_SCOPES = ("runner", "stream", "sched", "db", "web", "clients",
+                      "control")
+
+PACKAGE_NAME = "jepsen_etcd_demo_tpu"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jtlint:\s*disable=([A-Za-z0-9_,\s]+?)(?:\s*--\s*(.*))?$")
+
+
+@dataclass
+class ModuleSource:
+    """One parsed file, handed to every applicable module rule."""
+
+    path: Path                 # absolute
+    relpath: str               # repo-relative, posix separators
+    text: str
+    tree: ast.Module
+    imports: ImportMap
+    scope: Optional[str]       # package subdir ("ops", "", ...) or None
+                               # when the file is outside the package —
+                               # then every rule applies (lint fixtures)
+    lines: list[str] = field(default_factory=list)
+    # line -> (rule ids, has a ` -- justification`); see load().
+    suppressions: dict[int, tuple[set[str], bool]] = field(
+        default_factory=dict)
+
+    @classmethod
+    def load(cls, path: Path, root: Path) -> "ModuleSource":
+        text = path.read_text(encoding="utf-8")
+        tree = parse_module(text, filename=str(path))
+        lines = text.splitlines()
+        # line -> (rule ids, has a `--` justification). Only JUSTIFIED
+        # suppressions suppress (the engine reports bare ones as JTL001
+        # — "a suppression is an argument, not an off switch" is
+        # enforced here, not just in a test).
+        sup: dict[int, tuple[set[str], bool]] = {}
+        for i, ln in enumerate(lines, start=1):
+            m = _SUPPRESS_RE.search(ln)
+            if m:
+                ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+                sup[i] = (ids, bool(m.group(2) and m.group(2).strip()))
+        return cls(path=path, relpath=_relpath(path, root), text=text,
+                   tree=tree, imports=ImportMap(tree),
+                   scope=_scope_of(path), lines=lines, suppressions=sup)
+
+    def line(self, n: int) -> str:
+        return self.lines[n - 1] if 1 <= n <= len(self.lines) else ""
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        """A `# jtlint: disable=` on the finding's line, or anywhere in
+        the contiguous comment block directly above it, silences it —
+        so a multi-line justification reads naturally:
+
+            # jtlint: disable=JTL103 -- bounded death poll: fetch every
+            # long_scan_poll chunks is the documented fail-fast contract.
+            if bool(np.asarray(carry.dead)):
+        """
+        def hit(n: int) -> bool:
+            ids, justified = self.suppressions.get(n, (set(), False))
+            return justified and (rule_id in ids or "all" in ids)
+
+        if hit(line):
+            return True
+        n = line - 1
+        while n >= 1 and self.line(n).lstrip().startswith("#"):
+            if hit(n):
+                return True
+            n -= 1
+        return False
+
+    def finding(self, rule: "Rule", node_or_line, message: str,
+                hint: Optional[str] = None) -> Finding:
+        from .astutil import statement_of
+
+        if isinstance(node_or_line, int):
+            line = anchor = node_or_line
+        else:
+            line = getattr(node_or_line, "lineno", 1)
+            # The enclosing statement's first line: a suppression above
+            # the statement must keep covering a flagged call that a
+            # line-length wrap pushed onto a continuation line.
+            anchor = getattr(statement_of(node_or_line), "lineno", line)
+        return Finding(rule=rule.id, path=self.relpath, line=line,
+                       message=message,
+                       hint=rule.hint if hint is None else hint,
+                       snippet=self.line(line), anchor=anchor)
+
+
+def _scope_of(path: Path) -> Optional[str]:
+    """Package subdir a file belongs to: "ops" for
+    .../jepsen_etcd_demo_tpu/ops/wgl3.py, "" for a top-level module,
+    None when the file is outside the package entirely."""
+    parts = path.parts
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i] == PACKAGE_NAME:
+            rest = parts[i + 1:-1]
+            return rest[0] if rest else ""
+    return None
+
+
+def _relpath(path: Path, root: Path) -> str:
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+class Rule:
+    """Base module rule. Subclasses set the class attributes and
+    implement :meth:`check`."""
+
+    id: str = ""
+    name: str = ""
+    scopes: Optional[tuple[str, ...]] = None   # None = whole package
+    rationale: str = ""
+    hint: str = ""
+
+    def applies_to(self, mod: ModuleSource) -> bool:
+        if mod.scope is None:          # outside the package: fixtures,
+            return True                # explicit file targets
+        if self.scopes is None:
+            return True
+        return mod.scope in self.scopes
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+class ProjectRule(Rule):
+    """A rule that runs once per invocation against the repo root
+    instead of per module (e.g. the KernelLimits doc lint)."""
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        return iter(())
+
+    def check_project(self, root: Path) -> list[Finding]:
+        raise NotImplementedError
+
+    def covered_paths(self, root: Path) -> list[str]:
+        """Repo-relative paths this rule's findings land on — baseline
+        entries for them count as in-scope (and can go stale) whenever
+        the rule runs, even when the rule currently emits nothing."""
+        return []
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate + register a rule by id."""
+    inst = cls()
+    assert inst.id and inst.id not in _REGISTRY, f"bad rule id {inst.id!r}"
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    """id -> rule instance for the full registered suite (importing
+    analysis.rules as a side effect)."""
+    from . import rules  # noqa: F401  (imports register the suite)
+
+    return dict(_REGISTRY)
+
+
+def resolve_rules(spec: Optional[str]) -> dict[str, Rule]:
+    """Comma-separated rule ids/names -> registry subset; None = all.
+    Unknown names raise ValueError naming the valid ids."""
+    rules = all_rules()
+    if not spec:
+        return rules
+    by_name = {r.name: r for r in rules.values()}
+    out: dict[str, Rule] = {}
+    for tok in (t.strip() for t in spec.split(",")):
+        if not tok:
+            continue
+        if tok in rules:
+            out[tok] = rules[tok]
+        elif tok in by_name:
+            out[by_name[tok].id] = by_name[tok]
+        else:
+            raise ValueError(
+                f"unknown rule {tok!r}; valid: "
+                + ", ".join(f"{i} ({r.name})"
+                            for i, r in sorted(rules.items())))
+    return out

@@ -1,0 +1,173 @@
+"""jtlint engine: walk files, run applicable rules, fold in baseline.
+
+This module is the library API (``run_lint``) behind both the
+``jepsen-tpu lint`` CLI verb and the tier-1 wiring (tests/test_lint.py
+self-clean assertion). It is deliberately jax-free and fast: linting
+the whole package is AST parsing + pure-Python rule passes, well under
+the 5 s tier-1 budget.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional, Sequence
+
+from .baseline import Baseline
+from .core import (ModuleSource, ProjectRule, Rule, all_rules,
+                   PACKAGE_NAME, _relpath)
+from .findings import Finding, fingerprint_findings
+
+# Directories never worth descending into (linting a checkout root must
+# not crawl virtualenvs/build output — foreign code, minutes of wall).
+_SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "node_modules",
+              ".xla-cache", ".venv", "venv", ".tox", ".eggs",
+              "site-packages", "build", "dist"}
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding] = field(default_factory=list)   # unbaselined
+    baselined: list[Finding] = field(default_factory=list)
+    suppressed: list[Finding] = field(default_factory=list)
+    stale_baseline: list[str] = field(default_factory=list)
+    files: int = 0
+    parse_errors: list[Finding] = field(default_factory=list)
+
+    @property
+    def all_findings(self) -> list[Finding]:
+        return self.findings + self.baselined
+
+    def ok(self) -> bool:
+        """Clean under --strict: nothing unbaselined (parse errors are
+        findings too — rule JTL000) and no stale baseline entries."""
+        return not self.findings and not self.stale_baseline
+
+
+def iter_python_files(paths: Sequence[Path]) -> list[Path]:
+    """Python files under the given paths, deduped by resolved path
+    (overlapping arguments must not double-lint a file — the duplicate
+    would take occurrence+1 and invalidate its baseline fingerprint).
+    _SKIP_DIRS applies only to directories BELOW each argument: a
+    checkout that happens to live under .../venv/... — or the package
+    installed into site-packages and passed explicitly — still lints."""
+    out: list[Path] = []
+    seen: set[Path] = set()
+
+    def add(f: Path) -> None:
+        r = f.resolve()
+        if r not in seen:
+            seen.add(r)
+            out.append(f)
+
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                below = f.relative_to(p).parts[:-1]
+                if not any(part in _SKIP_DIRS for part in below):
+                    add(f)
+        elif p.suffix == ".py":
+            add(p)
+    return out
+
+
+def find_repo_root(start: Path) -> Path:
+    """Nearest ancestor holding the package (or a .git/pyproject.toml);
+    relpaths and the default baseline location anchor here so
+    fingerprints are machine-independent."""
+    cur = start.resolve()
+    if cur.is_file():
+        cur = cur.parent
+    for cand in (cur, *cur.parents):
+        if ((cand / PACKAGE_NAME).is_dir() or (cand / ".git").exists()
+                or (cand / "pyproject.toml").is_file()):
+            return cand
+    return cur
+
+
+def run_lint(paths: Sequence[Path | str],
+             rules: Optional[dict[str, Rule]] = None,
+             root: Optional[Path] = None,
+             baseline: Optional[Baseline] = None,
+             project_rules: bool = True) -> LintResult:
+    """Lint `paths` (files or directories) and return a LintResult.
+
+    `rules` defaults to the full registry; pass a subset for targeted
+    runs (fixture tests). Project-level rules (the doc lint) run once
+    against `root` unless disabled — they are skipped automatically
+    when `rules` was narrowed to exclude them."""
+    paths = [Path(p) for p in paths]
+    if root is None:
+        root = find_repo_root(paths[0] if paths else Path.cwd())
+    rules = all_rules() if rules is None else rules
+    res = LintResult()
+    raw: list[Finding] = []
+    sup_raw: list[tuple[Finding, ModuleSource]] = []
+
+    module_rules = [r for r in rules.values()
+                    if not isinstance(r, ProjectRule)]
+    covered: set[str] = set()
+    for path in iter_python_files(paths):
+        res.files += 1
+        covered.add(_relpath(path, root))
+        try:
+            mod = ModuleSource.load(path, root)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            lineno = getattr(e, "lineno", 1) or 1
+            # Repo-relative like every finding: the fingerprint must be
+            # machine-independent so a parse error is baselinable.
+            pe = Finding(rule="JTL000", path=_relpath(path, root),
+                         line=lineno,
+                         message=f"file does not parse: "
+                                 f"{type(e).__name__}: {e}",
+                         hint="jtlint only checks parseable modules")
+            res.parse_errors.append(pe)
+            raw.append(pe)
+            continue
+        # Unjustified suppression comments are findings themselves
+        # (JTL001) and do NOT suppress — including stale bare disables
+        # on lines where no rule fires anymore.
+        for ln, (ids, justified) in sorted(mod.suppressions.items()):
+            if not justified:
+                raw.append(Finding(
+                    rule="JTL001", path=mod.relpath, line=ln,
+                    message=f"suppression of {', '.join(sorted(ids))} "
+                            f"without a justification — a suppression "
+                            f"is an argument, not an off switch (and "
+                            f"this one does not suppress)",
+                    hint="append ` -- <why this is safe/bounded>` to "
+                         "the jtlint: disable comment",
+                    snippet=mod.line(ln)))
+        for rule in module_rules:
+            if not rule.applies_to(mod):
+                continue
+            for f in rule.check(mod):
+                if mod.suppressed(f.rule, f.line) or (
+                        f.anchor and f.anchor != f.line
+                        and mod.suppressed(f.rule, f.anchor)):
+                    sup_raw.append((f, mod))
+                else:
+                    raw.append(f)
+
+    if project_rules:
+        for rule in rules.values():
+            if isinstance(rule, ProjectRule):
+                raw.extend(rule.check_project(root))
+                covered.update(rule.covered_paths(root))
+
+    # ONE fingerprint pass over kept + suppressed findings together:
+    # occurrence indices (the identical-line disambiguator) must not
+    # shift when a sibling finding gets suppressed — a baseline entry
+    # may only go stale when the flagged code itself changes.
+    fingerprint_findings(raw + [f for f, _ in sup_raw])
+    res.suppressed = [f for f, _ in sup_raw]
+    if baseline is None:
+        baseline = Baseline()
+    # The engine-emitted rules (JTL000 parse errors, JTL001 unjustified
+    # suppressions) always run, so their entries are always in scope
+    # for staleness.
+    ran_rules = set(rules) | {"JTL000", "JTL001"}
+    res.findings, res.baselined, res.stale_baseline = baseline.split(
+        raw, covered_paths=covered, ran_rules=ran_rules)
+    return res

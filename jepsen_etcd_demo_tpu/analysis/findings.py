@@ -1,0 +1,82 @@
+"""Finding: the one record every jtlint rule (and the doc lint) emits.
+
+One findings format for the whole analysis layer (ISSUE 7): AST rules
+over the package, the KernelLimits doc lint (analysis/rules/limits_doc
+— the refactored tools/check_limits_doc.py core), and any future
+project-level check all produce ``Finding`` rows, so reporting,
+suppression accounting, and the baseline mechanism are written once.
+
+Fingerprints are LINE-DRIFT TOLERANT: they hash the rule id, the
+repo-relative path, and the whitespace-normalized source line — not the
+line number — plus an occurrence index to disambiguate identical lines.
+A baseline therefore survives unrelated edits above a finding, and goes
+stale exactly when the flagged code itself changes (which is when a
+human should re-look anyway).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass
+from typing import Iterable
+
+
+@dataclass
+class Finding:
+    rule: str              # rule id, e.g. "JTL103"
+    path: str              # repo-relative posix path
+    line: int              # 1-based
+    message: str           # what is wrong, one sentence
+    hint: str = ""         # how to fix it (the rule's fix-hint)
+    snippet: str = ""      # the flagged source line (fingerprint input)
+    fingerprint: str = ""  # filled by fingerprint_findings()
+    anchor: int = 0        # first line of the enclosing STATEMENT (0 =
+                           # same as line); a suppression comment above
+                           # the statement covers findings on its
+                           # continuation lines. Not serialized.
+
+    def text(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        out = f"{loc}: {self.rule} {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        if self.fingerprint:
+            out += f"\n    fingerprint: {self.fingerprint}"
+        return out
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "hint": self.hint,
+                "fingerprint": self.fingerprint}
+
+
+def _norm(snippet: str) -> str:
+    return " ".join(snippet.split())
+
+
+def fingerprint_findings(findings: Iterable[Finding]) -> list[Finding]:
+    """Assign stable fingerprints in place (and return the list).
+
+    sha1(rule | path | normalized snippet | occurrence)[:16], occurrence
+    counted among findings sharing all three other components in line
+    order — so two identical flagged lines in one file keep distinct,
+    stable identities."""
+    out = sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+    seen: dict[tuple, int] = {}
+    for f in out:
+        key = (f.rule, f.path, _norm(f.snippet))
+        occ = seen.get(key, 0)
+        seen[key] = occ + 1
+        raw = "|".join((f.rule, f.path, _norm(f.snippet), str(occ)))
+        f.fingerprint = hashlib.sha1(raw.encode()).hexdigest()[:16]
+    return out
+
+
+def format_text(findings: list[Finding]) -> str:
+    return "\n".join(f.text() for f in findings)
+
+
+def format_json(findings: list[Finding], **extra) -> str:
+    return json.dumps({"findings": [f.as_dict() for f in findings],
+                       **extra}, indent=2)

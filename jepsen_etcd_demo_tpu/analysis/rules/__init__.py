@@ -1,0 +1,24 @@
+"""jtlint rule suite: importing this package registers every rule.
+
+Rule id blocks (doc/analysis.md has the full reference):
+  JTL1xx — JAX kernel hygiene (ops/, parallel/, sched/, stream/, tune/)
+  JTL2xx — concurrency discipline (runner/, stream/, sched/, db/, web/,
+           clients/, control/)
+  JTL3xx — project-level lints (doc consistency)
+  JTL000 — reserved: unparseable file (emitted by the engine itself)
+
+Adding a rule = one module here with a ``@register``-ed Rule subclass,
+an import below, a fixture pair in tests/lint_fixtures/, and a doc
+section in doc/analysis.md (tests/test_lint.py enforces the last two).
+"""
+
+from . import donation          # noqa: F401
+from . import env_limits        # noqa: F401
+from . import event_loop        # noqa: F401
+from . import host_sync         # noqa: F401
+from . import instrument        # noqa: F401
+from . import jit_cache         # noqa: F401
+from . import limits_doc        # noqa: F401
+from . import lock_order        # noqa: F401
+from . import shared_state      # noqa: F401
+from . import traced_branch     # noqa: F401

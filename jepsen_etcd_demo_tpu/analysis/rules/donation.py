@@ -1,0 +1,242 @@
+"""JTL102 donation-read: donated buffers read after the donating call.
+
+``donate_argnums`` lets XLA alias an operand's buffer into the output
+(the chunked sweeps' frontier carry and the pallas resumable table ride
+on this — PR 2/PR 5). After the call the donated array is DELETED:
+touching it raises on strict backends and silently reads reused memory
+on others. Until ISSUE 7 the donation call sites were hand-audited
+per PR; this rule keeps them audited.
+
+Intra-module resolution (documented limit: cross-module donating
+callables — e.g. stream/engine.py calling wgl3's factory — resolve
+only in wgl3's own file):
+
+  * ``run = jax.jit(f, donate_argnums=(0,))`` — direct binding;
+  * factories: a function whose return resolves to a donating jit —
+    through ``instrument_kernel(...)`` wraps, nested ``def`` s,
+    ``_CACHE[key]`` stores, and one level of factory-calls-factory;
+  * call sites: ``run(carry, ...)`` and ``factory(...)(carry, ...)``.
+
+Flagged shapes: a donated operand read in a LATER statement before
+being rebound, and a donated operand inside a loop that the call
+statement does not rebind (the next iteration would pass a deleted
+buffer). The repo idiom — ``carry, part = run(carry, ...)`` — rebinds
+in the same statement and is clean.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..astutil import (ancestors, assigned_names, dotted, statement_of,
+                       walk_same_scope)
+from ..core import KERNEL_SCOPES, ModuleSource, Rule, register
+from ..findings import Finding
+
+
+def _donate_indices(call: ast.Call, mod: ModuleSource
+                    ) -> Optional[tuple[int, ...]]:
+    """The literal donate_argnums of a jax.jit call, else None."""
+    if not mod.imports.is_call_to(call, "jax.jit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) \
+                            and isinstance(e.value, int):
+                        out.append(e.value)
+                return tuple(out) or None
+    return None
+
+
+class _Resolver:
+    """Resolves expressions / function names to donated positions."""
+
+    def __init__(self, mod: ModuleSource):
+        self.mod = mod
+        # EVERY def gets scanned (fn_nodes); name-based RESOLUTION only
+        # trusts unique names — with duplicates (nested `run`/`launch`
+        # defs recur across factories, e.g. ops/wgl3_pallas.py) a bare
+        # name is ambiguous and resolving the wrong one would flag or
+        # clear the wrong call sites.
+        self.fn_nodes: list[ast.AST] = [
+            n for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        counts: dict[str, int] = {}
+        for n in self.fn_nodes:
+            counts[n.name] = counts.get(n.name, 0) + 1
+        self.fns: dict[str, ast.AST] = {
+            n.name: n for n in self.fn_nodes if counts[n.name] == 1}
+        self._memo: dict[str, Optional[tuple[int, ...]]] = {}
+
+    def expr(self, node: ast.AST, depth: int = 0
+             ) -> Optional[tuple[int, ...]]:
+        if depth > 6 or node is None:
+            return None
+        if isinstance(node, ast.Call):
+            d = _donate_indices(node, self.mod)
+            if d is not None:
+                return d
+            if self.mod.imports.is_call_to(
+                    node, "instrument_kernel", "obs.instrument_kernel") \
+                    and node.args:
+                return self.expr(node.args[-1], depth + 1)
+            # factory(...) — a call to a function that returns donating
+            if isinstance(node.func, ast.Name):
+                return self.function(node.func.id, depth + 1)
+            return None
+        if isinstance(node, ast.Name) and node.id in self.fns:
+            # a returned inner def
+            return self.function(node.id, depth + 1)
+        return None
+
+    def function(self, name: str, depth: int = 0
+                 ) -> Optional[tuple[int, ...]]:
+        if name in self._memo:
+            return self._memo[name]
+        self._memo[name] = None          # cycle guard
+        fn = self.fns.get(name)
+        if fn is None or depth > 6:
+            return None
+        result: Optional[tuple[int, ...]] = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                val = node.value
+                if isinstance(val, ast.Subscript):
+                    val = self._cache_store_value(fn, val) or val
+                result = self.expr(val, depth + 1)
+                if result is not None:
+                    break
+        self._memo[name] = result
+        return result
+
+    def _cache_store_value(self, fn, sub: ast.Subscript
+                           ) -> Optional[ast.AST]:
+        """`return _CACHE[key]` -> the value some `_CACHE[...] = X`
+        in the same function stored."""
+        base = dotted(sub.value)
+        if base is None:
+            return None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and dotted(t.value) == base:
+                        return node.value
+        return None
+
+
+@register
+class DonationReadRule(Rule):
+    id = "JTL102"
+    name = "donation-read"
+    scopes = KERNEL_SCOPES
+    rationale = (
+        "donate_argnums deletes the operand's buffer at the call; a "
+        "later read raises (strict backends) or reads reused memory "
+        "(silent corruption). The PR 2/PR 5 donation paths were "
+        "hand-audited; this keeps them audited.")
+    hint = ("rebind the donated operand from the call's result in the "
+            "same statement (`carry, part = run(carry, ...)`); if the "
+            "old buffer is genuinely needed, drop the donation or copy "
+            "first")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        resolver = _Resolver(mod)
+        for fn in resolver.fn_nodes:
+            yield from self._check_function(fn, resolver, mod)
+
+    def _check_function(self, fn, resolver: _Resolver,
+                        mod: ModuleSource) -> Iterator[Finding]:
+        # Same-scope walks only: nested defs are in resolver.fns and get
+        # their OWN pass — descending here would report their call
+        # sites twice under two fingerprints. (Known limit: a donating
+        # binding captured by closure into a nested def is not tracked.)
+        # Local donating bindings: run = <donating expr>
+        local: dict[str, tuple[int, ...]] = {}
+        for node in walk_same_scope(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                d = resolver.expr(node.value)
+                if d is not None:
+                    local[node.targets[0].id] = d
+        for node in walk_same_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            indices = self._call_donates(node, local, resolver)
+            if not indices:
+                continue
+            stmt = statement_of(node)
+            rebound = assigned_names(ast.Tuple(
+                elts=list(getattr(stmt, "targets", []))
+                if isinstance(stmt, ast.Assign) else [], ctx=ast.Store()))
+            for i in indices:
+                if i >= len(node.args):
+                    continue
+                name = dotted(node.args[i])
+                if name is None:
+                    continue   # a fresh expression: nothing to re-read
+                if name in rebound:
+                    continue
+                if self._in_loop_stmt(stmt, fn):
+                    yield mod.finding(
+                        self, node,
+                        f"donated operand `{name}` (position {i}) is "
+                        f"not rebound by the call statement inside a "
+                        f"loop — the next iteration passes a deleted "
+                        f"buffer")
+                    continue
+                read = self._later_read(stmt, name, fn)
+                if read is not None:
+                    yield mod.finding(
+                        self, read,
+                        f"donated operand `{name}` (donated at line "
+                        f"{node.lineno}) read after the donating call "
+                        f"— the buffer no longer exists")
+
+    def _call_donates(self, call: ast.Call, local: dict,
+                      resolver: _Resolver) -> Optional[tuple[int, ...]]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in local:
+                return local[f.id]
+            return None   # bare function NAME calls: only via binding
+        if isinstance(f, ast.Call):
+            return resolver.expr(f)
+        return None
+
+    def _in_loop_stmt(self, stmt: ast.stmt, fn) -> bool:
+        for a in ancestors(stmt):
+            if a is fn:
+                return False
+            if isinstance(a, (ast.For, ast.AsyncFor, ast.While)):
+                return True
+        return False
+
+    def _later_read(self, stmt: ast.stmt, name: str, fn
+                    ) -> Optional[ast.AST]:
+        """First Load of `name` in a statement after `stmt` in the same
+        (innermost) body list, before any rebinding statement."""
+        p = getattr(stmt, "jt_parent", None)
+        body = getattr(p, "body", None)
+        if not isinstance(body, list) or stmt not in body:
+            return None
+        after = body[body.index(stmt) + 1:]
+        for s in after:
+            for n in ast.walk(s):
+                if isinstance(n, (ast.Name, ast.Attribute)) \
+                        and isinstance(getattr(n, "ctx", None), ast.Load) \
+                        and dotted(n) == name:
+                    return n
+            if isinstance(s, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                tgts = (s.targets if isinstance(s, ast.Assign)
+                        else [s.target])
+                if any(name in assigned_names(t) for t in tgts):
+                    return None
+        return None

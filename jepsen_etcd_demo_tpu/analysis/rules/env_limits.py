@@ -1,0 +1,74 @@
+"""JTL106 raw-limit-env: JEPSEN_TPU_LIMIT_* reads outside ops/limits.py.
+
+``ops/limits.py`` is the single resolution point for every kernel knob
+(env > set_limits > tuned profile > default, with validation — PR 4's
+LimitsEnvError work). A raw ``os.environ["JEPSEN_TPU_LIMIT_..."]``
+anywhere else bypasses the whole ladder: no range validation, no tuned
+profile, no provenance, and the doc lint (JTL301) can't see it.
+Computed env-var names built via ``limits.env_var(field)`` are the
+sanctioned escape hatch (cli/main.py's --sweep-mode) and don't match.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import ModuleSource, Rule, register
+from ..findings import Finding
+
+_PREFIX = "JEPSEN_TPU_LIMIT"
+
+
+def _literal_env_key(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value.startswith(_PREFIX):
+        return node.value
+    return None
+
+
+@register
+class RawLimitEnvRule(Rule):
+    id = "JTL106"
+    name = "raw-limit-env"
+    scopes = None          # whole package; limits.py itself is exempt
+    rationale = (
+        "ops/limits.py is the one resolution point for kernel knobs "
+        "(validated env > set_limits > tuned profile > default, PR 4); "
+        "a raw env read bypasses validation, tuning and provenance.")
+    hint = ("read limits().<field> (ops/limits.py) instead; to pin a "
+            "field programmatically use set_limits(), to pin it for "
+            "subprocesses set the env via limits.env_var(field)")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        if mod.relpath.endswith("ops/limits.py"):
+            return
+        for node in ast.walk(mod.tree):
+            key, write = None, False
+            if isinstance(node, ast.Subscript):
+                base = mod.imports.resolve(node.value)
+                if base in ("os.environ",):
+                    key = _literal_env_key(node.slice)
+                    write = not isinstance(node.ctx, ast.Load)
+            elif isinstance(node, ast.Call):
+                origin = mod.imports.resolve(node.func)
+                if origin in ("os.getenv", "os.environ.get") and node.args:
+                    key = _literal_env_key(node.args[0])
+            if key is None:
+                continue
+            if write:
+                yield mod.finding(
+                    self, node,
+                    f"raw write of {key} with a hardcoded var name — "
+                    f"unvalidated, and the name silently desyncs if "
+                    f"the field is renamed",
+                    hint="compute the name via limits.env_var(field) "
+                         "(subprocess pins) or use set_limits() "
+                         "in-process — both stay on the resolution "
+                         "ladder")
+            else:
+                yield mod.finding(
+                    self, node,
+                    f"raw read of {key} outside ops/limits.py — "
+                    f"bypasses the limits resolution ladder "
+                    f"(validation, tuned profile, provenance)")

@@ -1,0 +1,119 @@
+"""JTL202 loop-bound-primitive: asyncio primitives crossing event loops.
+
+The ADVICE r5 bug class: an ``asyncio.Lock`` binds to the event loop
+that first awaits it; ``--test-count >= 2`` runs each test under its
+own ``asyncio.run``, so any primitive that SURVIVES a run (module
+global, cached in a long-lived dict, attribute of a long-lived object)
+raises ``"... is bound to a different event loop"`` in the second run
+— the EtcdDB install-lock / PORT_MAP incident. The shipped fix keys
+the cache by ``asyncio.get_running_loop()`` (db/etcd.py
+``_install_lock``), which this rule recognizes and accepts.
+
+Flagged: an asyncio primitive constructed OUTSIDE an async function
+(module level, ``__init__``, sync helpers) and stored somewhere that
+can outlive a loop — unless the store is a container keyed by the
+running loop. Construction inside an async function is accepted (the
+instance belongs to the loop that is running it). A primitive on a
+strictly per-run object is safe in practice — suppress with the
+lifetime argument inline (clients/fake_kv.py, runner/core.py do).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..astutil import ancestors, enclosing_function, statement_of
+from ..core import CONCURRENCY_SCOPES, ModuleSource, Rule, register
+from ..findings import Finding
+
+_PRIMITIVES = {"asyncio.Lock", "asyncio.Event", "asyncio.Condition",
+               "asyncio.Semaphore", "asyncio.BoundedSemaphore",
+               "asyncio.Queue", "asyncio.LifoQueue",
+               "asyncio.PriorityQueue"}
+_LOOP_GETTERS = ("get_running_loop", "get_event_loop")
+
+
+@register
+class LoopBoundPrimitiveRule(Rule):
+    id = "JTL202"
+    name = "loop-bound-primitive"
+    scopes = CONCURRENCY_SCOPES
+    rationale = (
+        "ADVICE r5 (EtcdDB install lock / PORT_MAP): an asyncio "
+        "primitive binds to the loop that first awaits it; surviving "
+        "into a second asyncio.run raises 'bound to a different event "
+        "loop' mid-test.")
+    hint = ("create the primitive inside the running loop, or key the "
+            "cache by asyncio.get_running_loop() (db/etcd.py "
+            "_install_lock); a strictly per-run instance may suppress "
+            "with its lifetime argument")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and mod.imports.resolve(node.func) in _PRIMITIVES):
+                continue
+            fn = enclosing_function(node)
+            if isinstance(fn, ast.AsyncFunctionDef):
+                continue          # created under the running loop
+            prim = mod.imports.resolve(node.func)
+            if self._loop_keyed_store(node, fn, mod):
+                continue
+            where = (f"in sync function {fn.name}()" if fn is not None
+                     else "at module scope")
+            yield mod.finding(
+                self, node,
+                f"{prim}() created {where} — binds to whichever loop "
+                f"first awaits it; if this object survives into a "
+                f"second asyncio.run it raises 'bound to a different "
+                f"event loop' (ADVICE r5 bug class)")
+
+    def _loop_keyed_store(self, prim: ast.Call, fn,
+                          mod: ModuleSource) -> bool:
+        """True when the primitive is stored into a container under a
+        key derived from the running loop — the sanctioned cache shape
+        (db/etcd.py _install_lock), or via .setdefault(loop, ...)."""
+        loop_names = self._loop_names(fn, mod)
+        stmt = statement_of(prim)
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Subscript) \
+                        and self._loop_derived(t.slice, loop_names, mod):
+                    return True
+        for a in ancestors(prim):
+            if isinstance(a, ast.Call) \
+                    and isinstance(a.func, ast.Attribute) \
+                    and a.func.attr == "setdefault" and a.args \
+                    and self._loop_derived(a.args[0], loop_names, mod):
+                return True
+            if isinstance(a, ast.stmt):
+                break
+        return False
+
+    def _loop_names(self, fn, mod: ModuleSource) -> set[str]:
+        """Names bound from asyncio.get_running_loop()/get_event_loop()
+        in the enclosing function."""
+        out: set[str] = set()
+        if fn is None:
+            return out
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                origin = mod.imports.resolve(node.value.func) or ""
+                if origin.rsplit(".", 1)[-1] in _LOOP_GETTERS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out.add(t.id)
+        return out
+
+    def _loop_derived(self, key: ast.AST, loop_names: set[str],
+                      mod: ModuleSource) -> bool:
+        for n in ast.walk(key):
+            if isinstance(n, ast.Name) and n.id in loop_names:
+                return True
+            if isinstance(n, ast.Call):
+                origin = mod.imports.resolve(n.func) or ""
+                if origin.rsplit(".", 1)[-1] in _LOOP_GETTERS:
+                    return True
+        return False

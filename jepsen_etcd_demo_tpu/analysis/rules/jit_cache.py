@@ -1,0 +1,131 @@
+"""JTL101 jit-cache-key: recompile storms from unstable jit caching.
+
+The corpus engine's whole speedup (PR 2: 5.18 s -> 0.36 s) is kernel
+reuse; one call site that re-jits per invocation or keys a kernel cache
+on per-run data silently re-traces/re-compiles every launch and the
+regression only shows up as wall clock. Three statically visible
+shapes:
+
+  * ``jax.jit(f)(x)`` — jit-and-call in one expression: the compiled
+    callable is discarded, so every execution pays tracing (and, cache
+    miss permitting, XLA compilation) again.
+  * a kernel-cache store (``_CACHE[key] = ...``) whose key contains
+    ``id(...)`` / ``time.*`` / ``random.*`` — per-process, per-run or
+    colliding-after-GC identities; the persistent compile cache can
+    never hit across processes on such keys.
+  * ``static_argnums``/``static_argnames`` passed a computed (non-
+    literal) value — the static set itself varying per call site is a
+    retrace hazard and defeats review of WHAT is being baked into the
+    compiled program.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..astutil import (CACHE_NAME_RE, call_args_source,
+                       enclosing_function)
+from ..core import KERNEL_SCOPES, ModuleSource, Rule, register
+from ..findings import Finding
+
+_BAD_KEY_ORIGINS = ("time.", "random.")
+
+
+def _is_literalish(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_literalish(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _is_literalish(node.operand)
+    return False
+
+
+@register
+class JitCacheKeyRule(Rule):
+    id = "JTL101"
+    name = "jit-cache-key"
+    scopes = KERNEL_SCOPES
+    rationale = (
+        "Recompile storms: PR 2's throughput win is kernel reuse; an "
+        "unstable jit-cache key or a jit-and-call re-traces per launch "
+        "and only shows up as wall clock.")
+    hint = ("cache the jitted callable (module _CACHE keyed on "
+            "(model.cache_key(), cfg, shapes) or functools.lru_cache); "
+            "keys must be content-derived, never id()/time/random; "
+            "static_argnums must be a literal")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # jax.jit(f)(...) — immediately-invoked jit.
+            if isinstance(node.func, ast.Call) \
+                    and mod.imports.is_call_to(node.func, "jax.jit"):
+                yield mod.finding(
+                    self, node,
+                    "jax.jit created and called in one expression — the "
+                    "compiled callable is discarded, every call pays "
+                    "tracing/compilation again")
+            if mod.imports.is_call_to(node, "jax.jit"):
+                for kw in node.keywords:
+                    if kw.arg in ("static_argnums", "static_argnames") \
+                            and not _is_literalish(kw.value):
+                        yield mod.finding(
+                            self, node,
+                            f"{kw.arg} is a computed expression "
+                            f"({call_args_source(kw.value, mod.text) or 'non-literal'}) "
+                            f"— per-call static sets are a retrace "
+                            f"hazard; spell the static argument "
+                            f"positions as a literal")
+        yield from self._cache_key_stores(mod)
+
+    def _cache_key_stores(self, mod: ModuleSource) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if not (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Name)
+                        and CACHE_NAME_RE.search(tgt.value.id)):
+                    continue
+                key_expr = self._key_expr(tgt.slice, node, mod)
+                for bad in self._unstable_parts(key_expr, mod):
+                    yield mod.finding(
+                        self, node,
+                        f"kernel cache {tgt.value.id} keyed on "
+                        f"{bad} — a per-run/per-process identity: the "
+                        f"cache can never hit across runs and may "
+                        f"collide after GC")
+
+    def _key_expr(self, key: ast.AST, store: ast.Assign,
+                  mod: ModuleSource) -> ast.AST:
+        """The key expression, following one level of local
+        `key = (...)` indirection — the repo's idiom."""
+        if not isinstance(key, ast.Name):
+            return key
+        fn = enclosing_function(store)
+        body = fn.body if fn is not None else mod.tree.body
+        best = None
+        for stmt in body:
+            if stmt.lineno >= store.lineno:
+                break
+            if isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == key.id
+                    for t in stmt.targets):
+                best = stmt.value
+        return best if best is not None else key
+
+    def _unstable_parts(self, expr: ast.AST,
+                        mod: ModuleSource) -> Iterator[str]:
+        for n in ast.walk(expr):
+            if not isinstance(n, ast.Call):
+                continue
+            origin = mod.imports.resolve(n.func)
+            if origin == "id":
+                yield "id(...)"
+            elif origin and any(origin.startswith(p)
+                                for p in _BAD_KEY_ORIGINS):
+                yield f"{origin}(...)"

@@ -1,0 +1,152 @@
+"""JTL201 lock-order: lock-acquisition-order cycles (deadlock shapes).
+
+Two code paths acquiring the same two locks in opposite orders is the
+classic static deadlock; with the recorder listener thread, the stream
+consumer, and the obs capture lock all live in one process (and the
+ROADMAP daemon multiplying threads), acquisition order is worth
+machine-checking.
+
+Per module: every ``with <lock>:`` nesting adds an edge outer->inner
+(``with a, b:`` adds a->b); a method calling a same-class sibling while
+holding a lock adds edges to the sibling's locks. Lock identity is the
+expression text qualified by the owning class (``StreamSession.self.
+_lock``) so two classes' unrelated ``self._lock`` attributes never
+alias. A cycle in the resulting graph — including a self-edge, which
+is a self-deadlock on a non-reentrant ``threading.Lock`` — is a
+finding naming the full cycle.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from ..astutil import (LOCKISH_RE, ancestors_same_scope, dotted,
+                       enclosing_class, walk_same_scope)
+from ..core import CONCURRENCY_SCOPES, ModuleSource, Rule, register
+from ..findings import Finding
+
+
+
+def _lock_id(expr: ast.AST, mod: ModuleSource) -> Optional[str]:
+    d = dotted(expr)
+    if d is None and isinstance(expr, ast.Call):
+        d = dotted(expr.func)     # with self._lock() factory style
+    if d is None or not LOCKISH_RE.search(d.split(".")[-1]):
+        return None
+    cls = enclosing_class(expr)
+    return f"{cls.name}.{d}" if cls is not None and d.startswith("self.") \
+        else d
+
+
+@register
+class LockOrderRule(Rule):
+    id = "JTL201"
+    name = "lock-order"
+    scopes = CONCURRENCY_SCOPES
+    rationale = (
+        "Opposite acquisition orders across threads deadlock; the "
+        "listener thread + stream consumer + obs capture lock already "
+        "share a process, and the ROADMAP daemon multiplies threads.")
+    hint = ("pick one global acquisition order and restructure the "
+            "out-of-order path (release-then-reacquire, or lift the "
+            "inner acquisition out)")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        edges: dict[tuple[str, str], ast.AST] = {}
+        class_locks: dict[tuple[str, str], set[str]] = {}  # (cls,meth)->locks
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls = enclosing_class(node)
+                if cls is not None:
+                    # Same-scope only: a with-lock inside a nested def
+                    # belongs to that callable, not to this method.
+                    class_locks[(cls.name, node.name)] = {
+                        lid for w in walk_same_scope(node)
+                        if isinstance(w, (ast.With, ast.AsyncWith))
+                        for item in w.items
+                        for lid in [_lock_id(item.context_expr, mod)]
+                        if lid is not None}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            ids = [(_lock_id(i.context_expr, mod), i.context_expr)
+                   for i in node.items]
+            ids = [(lid, e) for lid, e in ids if lid is not None]
+            # with a, b: -> a->b
+            for (outer, _), (inner, e) in zip(ids, ids[1:]):
+                edges.setdefault((outer, inner), e)
+            if not ids:
+                continue
+            # Held = enclosing withs in the SAME scope: a with inside a
+            # nested def is not under the outer function's locks (the
+            # callback runs later, possibly with nothing held).
+            held = [lid for a in ancestors_same_scope(node)
+                    if isinstance(a, (ast.With, ast.AsyncWith))
+                    for item in a.items
+                    for lid in [_lock_id(item.context_expr, mod)]
+                    if lid is not None]
+            for outer in held:
+                for inner, e in ids:
+                    edges.setdefault((outer, inner), e)
+            # same-class calls made while holding these locks
+            cls = enclosing_class(node)
+            if cls is None:
+                continue
+            for call in walk_same_scope(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                cd = dotted(call.func)
+                if cd is None or not cd.startswith("self."):
+                    continue
+                callee = cd.split(".", 1)[1]
+                for inner in class_locks.get((cls.name, callee), ()):
+                    for outer, _ in ids:
+                        # outer == inner IS the finding: a helper
+                        # re-acquiring the caller's non-reentrant lock.
+                        edges.setdefault((outer, inner), call)
+        yield from self._cycles(edges, mod)
+
+    def _cycles(self, edges: dict, mod: ModuleSource) -> Iterator[Finding]:
+        graph: dict[str, set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+        reported: set[tuple] = set()
+        for (a, b), site in sorted(edges.items(),
+                                   key=lambda kv: kv[1].lineno):
+            if a == b:
+                key = (a,)
+                if key not in reported:
+                    reported.add(key)
+                    yield mod.finding(
+                        self, site,
+                        f"lock {a} acquired while already held — "
+                        f"self-deadlock on a non-reentrant lock")
+                continue
+            path = self._find_path(graph, b, a)
+            if path is None:
+                continue
+            cycle = [a] + path          # path runs b..a, closing the loop
+            key = tuple(sorted(set(cycle)))
+            if key in reported:
+                continue
+            reported.add(key)
+            yield mod.finding(
+                self, site,
+                "lock acquisition order cycle: " + " -> ".join(cycle)
+                + " — two threads taking opposite ends deadlock")
+
+    def _find_path(self, graph: dict, src: str, dst: str
+                   ) -> Optional[list[str]]:
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            cur, path = stack.pop()
+            if cur == dst:
+                return path
+            for nxt in sorted(graph.get(cur, ())):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None

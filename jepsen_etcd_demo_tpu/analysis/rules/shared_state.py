@@ -1,0 +1,186 @@
+"""JTL203 unlocked-shared-state: thread/worker races on mutable attrs.
+
+A class that spawns ``threading.Thread(target=self._x)`` (the stream
+consumer, the recorder listener's downstream) has two sides mutating
+``self``: the thread body and the caller-facing methods. An attribute
+MUTATED on both sides without a lock is a data race — dict/list ops
+are atomic-ish under the GIL until they aren't (check-then-act,
+read-modify-write, iteration during mutation).
+
+Scope is deliberately mutation-vs-mutation: one side mutating while
+the other only reads is the GIL-tolerated pattern this codebase uses
+knowingly (StreamSession._falsified) and flagging reads would bury the
+signal. Recognized synchronization, per attribute:
+
+  * attr initialized to a thread-safe type (queue.*, threading.Event/
+    Lock/Condition/Semaphore, collections.deque) — exempt;
+  * every mutation (both sides) under a ``with <lock>:`` — exempt;
+  * mutation after ``self.<thread>.join()`` in the same method — the
+    thread is dead, exempt (StreamSession.finalize's shape).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..astutil import LOCKISH_RE, ancestors, dotted
+from ..core import CONCURRENCY_SCOPES, ModuleSource, Rule, register
+from ..findings import Finding
+
+_SAFE_TYPES = {"queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+               "queue.PriorityQueue", "threading.Event", "threading.Lock",
+               "threading.RLock", "threading.Condition",
+               "threading.Semaphore", "threading.BoundedSemaphore",
+               "collections.deque"}
+_MUTATORS = {"append", "appendleft", "add", "update", "pop", "popitem",
+             "popleft", "remove", "discard", "extend", "insert", "clear",
+             "setdefault", "__setitem__"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """`self.X...` -> "X" (the first attribute after self)."""
+    d = dotted(node)
+    if d and d.startswith("self.") and len(d.split(".")) >= 2:
+        return d.split(".")[1]
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, cls: ast.ClassDef, mod: ModuleSource):
+        self.cls = cls
+        self.mod = mod
+        self.methods = {n.name: n for n in cls.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        self.safe_attrs: set[str] = set()
+        self.thread_attrs: set[str] = set()     # self.X = Thread(...)
+        self.thread_targets: set[str] = set()   # method names
+        self._scan_init_and_threads()
+
+    def _scan_init_and_threads(self):
+        for meth in self.methods.values():
+            for node in ast.walk(meth):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                origin = self.mod.imports.resolve(node.value.func) or ""
+                tgt_attrs = [a for t in node.targets
+                             for a in [_self_attr(t)] if a]
+                if origin in _SAFE_TYPES:
+                    self.safe_attrs.update(tgt_attrs)
+                if origin in ("threading.Thread", "Thread"):
+                    self.thread_attrs.update(tgt_attrs)
+                    for kw in node.value.keywords:
+                        if kw.arg == "target":
+                            m = _self_attr(kw.value)
+                            if m:
+                                self.thread_targets.add(m)
+
+    def thread_side_methods(self) -> set[str]:
+        """Transitive closure of self.* calls from the thread targets."""
+        out = set(self.thread_targets)
+        frontier = list(out)
+        while frontier:
+            name = frontier.pop()
+            meth = self.methods.get(name)
+            if meth is None:
+                continue
+            for node in ast.walk(meth):
+                if isinstance(node, ast.Call):
+                    callee = _self_attr(node.func)
+                    if callee in self.methods and callee not in out:
+                        out.add(callee)
+                        frontier.append(callee)
+        return out
+
+    def mutations(self, meth) -> list[tuple[str, ast.AST, bool, bool]]:
+        """(attr, node, under_lock, after_join) per self-attr mutation."""
+        join_line = None
+        for node in ast.walk(meth):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join" \
+                    and _self_attr(node.func.value) in self.thread_attrs:
+                join_line = min(join_line or node.lineno, node.lineno)
+        out = []
+
+        def emit(attr: Optional[str], node: ast.AST):
+            if attr is None or attr in self.safe_attrs \
+                    or attr in self.thread_attrs:
+                return
+            under_lock = any(
+                isinstance(a, (ast.With, ast.AsyncWith)) and any(
+                    LOCKISH_RE.search((dotted(i.context_expr) or "")
+                                    .split(".")[-1])
+                    for i in a.items)
+                for a in ancestors(node))
+            after_join = join_line is not None and node.lineno > join_line
+            out.append((attr, node, under_lock, after_join))
+
+        for node in ast.walk(meth):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                tgts = (node.targets if isinstance(node, ast.Assign)
+                        else [node.target])
+                for t in tgts:
+                    base = t.value if isinstance(
+                        t, (ast.Subscript,)) else t
+                    emit(_self_attr(base), node)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                emit(_self_attr(node.func.value), node)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    base = t.value if isinstance(t, ast.Subscript) else t
+                    emit(_self_attr(base), node)
+        return out
+
+
+@register
+class UnlockedSharedStateRule(Rule):
+    id = "JTL203"
+    name = "unlocked-shared-state"
+    scopes = CONCURRENCY_SCOPES
+    rationale = (
+        "The recorder listener / StreamSession consumer share one "
+        "process with the event-loop workers; an attribute mutated on "
+        "both sides without a lock is a data race the GIL only "
+        "sometimes hides.")
+    hint = ("guard both sides with one threading.Lock, hand the data "
+            "across on a queue.Queue, or confine mutation to one side "
+            "(join() the thread before touching its state)")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(node, mod)
+
+    def _check_class(self, cls: ast.ClassDef,
+                     mod: ModuleSource) -> Iterator[Finding]:
+        info = _ClassInfo(cls, mod)
+        if not info.thread_targets:
+            return
+        thread_side = info.thread_side_methods()
+        t_mut: dict[str, list] = {}
+        o_mut: dict[str, list] = {}
+        for name, meth in info.methods.items():
+            if name == "__init__":
+                continue
+            bucket = t_mut if name in thread_side else o_mut
+            for attr, n, locked, after_join in info.mutations(meth):
+                if after_join:
+                    continue
+                bucket.setdefault(attr, []).append((n, locked, name))
+        for attr in sorted(set(t_mut) & set(o_mut)):
+            both = t_mut[attr] + o_mut[attr]
+            if all(locked for _, locked, _ in both):
+                continue
+            node, _, meth = o_mut[attr][0]
+            t_meth = t_mut[attr][0][2]
+            yield mod.finding(
+                self, node,
+                f"{cls.name}.{attr} mutated by worker-facing "
+                f"{meth}() AND by thread-side {t_meth}() (thread "
+                f"target: {', '.join(sorted(info.thread_targets))}) "
+                f"without a lock — a cross-thread data race")

@@ -1,0 +1,62 @@
+"""JTL104 traced-branch: Python control flow on traced values.
+
+``if jnp.any(x):`` inside a jitted function raises a
+ConcretizationTypeError at trace time — the friendly failure. The
+nasty variants are OUTSIDE jit: the branch silently forces a blocking
+device fetch per evaluation (a host sync the profiler attributes to
+nothing), and under ``vmap``/``shard_map`` tracing it fails only on
+the first data-dependent path. The WGL kernels express data-dependent
+control as ``lax.cond``/``jnp.where`` masks for exactly this reason
+(ops/wgl3.py's step functions).
+
+Heuristic: an ``if``/``while`` test that mentions a ``jax.numpy``
+name. Static configuration branches (``if cfg.k_slots > 16``) don't
+match; a genuinely wanted host branch on a fetched value should fetch
+explicitly (``bool(np.asarray(x))``) — which names the sync and falls
+under JTL103's bounded-fetch discipline instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import KERNEL_SCOPES, ModuleSource, Rule, register
+from ..findings import Finding
+
+
+@register
+class TracedBranchRule(Rule):
+    id = "JTL104"
+    name = "traced-branch"
+    scopes = KERNEL_SCOPES
+    rationale = (
+        "Python if/while on a traced value either breaks under jit "
+        "(ConcretizationTypeError) or silently host-syncs per "
+        "evaluation outside it; kernel code expresses data-dependent "
+        "control as lax.cond/where masks.")
+    hint = ("inside kernels use lax.cond / lax.while_loop / jnp.where "
+            "masks; on the host, fetch explicitly first "
+            "(bool(np.asarray(x))) so the sync is visible and bounded")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            jnp_name = self._jnp_use(node.test, mod)
+            if jnp_name:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                yield mod.finding(
+                    self, node,
+                    f"Python `{kind}` branches on a jax.numpy value "
+                    f"({jnp_name}) — trace-time error under jit, "
+                    f"hidden per-evaluation host sync outside it")
+
+    def _jnp_use(self, test: ast.AST, mod: ModuleSource) -> str:
+        for n in ast.walk(test):
+            if isinstance(n, (ast.Name, ast.Attribute)):
+                origin = mod.imports.resolve(n)
+                if origin and (origin == "jax.numpy"
+                               or origin.startswith("jax.numpy.")):
+                    return origin
+        return ""

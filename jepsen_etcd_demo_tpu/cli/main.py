@@ -220,6 +220,14 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--port", type=int, default=8080)
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--store", default="store")
+
+    # Stub for --help only: `lint` is intercepted in main() BEFORE this
+    # parser runs, so the jtlint path never imports the run/check stack
+    # (analysis/ is jax-free and must stay fast — tier-1 runs it).
+    sub.add_parser(
+        "lint", add_help=False,
+        help="jtlint: JAX kernel hygiene + concurrency static analysis "
+             "(doc/analysis.md; --strict gates tier-1)")
     return p
 
 
@@ -652,6 +660,13 @@ def _honor_platform_env() -> None:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["lint"]:
+        # Dispatch before argparse/jax/backend setup: the lint verb is
+        # pure AST analysis (analysis/cli.py owns its own argparse).
+        from ..analysis.cli import main as lint_main
+
+        return lint_main(argv[1:])
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")

@@ -59,6 +59,11 @@ class FakeKVStore:
         self.duplicate_delivery_prob = duplicate_delivery_prob
         self.partial_apply_prob = partial_apply_prob
         self.op_delay_s = op_delay_s
+        # jtlint: disable=JTL202 -- lifetime argument: a FakeKVStore is
+        # built per test iteration (compose.fake_test constructs a fresh
+        # one inside each cmd_test loop turn), so this lock never
+        # survives into a second asyncio.run. If the fake ever becomes
+        # long-lived, key it by running loop like db/etcd._install_lock.
         self.lock = asyncio.Lock()
 
     # -- fault hooks (driven by the fake nemesis) -------------------------

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import instrument_kernel
+
 
 def _pad_to(n: int, mult: int = 128) -> int:
     return max(mult, (n + mult - 1) // mult * mult)
@@ -50,7 +52,10 @@ def _closure_fn(n_pad: int):
         r, _ = jax.lax.scan(body, adj, None, length=steps)
         return r, jnp.diagonal(r) > 0.5
 
-    return jax.jit(closure)
+    # obs/ compile/execute attribution (PR 1 invariant, jtlint JTL105):
+    # the lru_cache IS this kernel's cache — one wrapper (one first-call
+    # flag) per padded size, like the WGL kernel caches.
+    return instrument_kernel("elle-closure", jax.jit(closure))
 
 
 def reach_and_cycles(adj: np.ndarray) -> tuple[np.ndarray, np.ndarray]:

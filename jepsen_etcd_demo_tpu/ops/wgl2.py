@@ -476,6 +476,10 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
             pos += 1
         c0, pre, out = window.pop()
         budget_check(c0)
+        # jtlint: disable=JTL103 -- THE InflightWindow resolution fetch:
+        # chunk N's flag resolves while chunks N+1..N+depth are already
+        # dispatched, so this round trip hides under real work (the
+        # pipelining contract this loop exists for).
         if bool(out.overflow):
             # Every later in-flight chunk chained off this overflowed
             # carry: discard the speculation, escalate, resume from the
@@ -500,10 +504,16 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
                 pre = _migrate_carry(pre, f_cap)
                 budget_check(c0)
                 out = dispatch(c0, pre)
+                # jtlint: disable=JTL103 -- escalation retry: the re-run
+                # chunk's overflow flag MUST resolve before the capacity
+                # decision; escalations are rare and already synchronous.
                 if not bool(out.overflow):
                     break
             carry = out
             pos = c0 // chunk + 1
+        # jtlint: disable=JTL103 -- same resolution fetch as the overflow
+        # flag above: one bounded fetch per RESOLVED chunk (pipeline-depth
+        # chunks stay in flight), and death must stop the dispatch loop.
         if bool(out.dead):
             # The first resolved dead chunk (earlier chunks resolved
             # clean). Later in-flight chunks are death-sticky no-ops —

@@ -478,6 +478,9 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
             carry, part = run(carry, *staged)
             cfgs_dev = part if cfgs_dev is None else cfgs_dev + part
             done += 1
+            # jtlint: disable=JTL103 -- bounded death poll: one fetch per
+            # sched_poll_chunks chunks (the [tunable] knob), not per
+            # iteration — the doc/perf.md early-exit contract.
             if done % poll == 0 and bool(np.asarray(carry.dead)):
                 break
     else:
@@ -499,6 +502,9 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
             # Early exit on death: one 1-byte fetch per chunk (~0.1 s on
             # a tunneled backend) vs minutes of dead chunks on wide
             # tables.
+            # jtlint: disable=JTL103 -- budgeted lane is synchronous BY
+            # CONTRACT: the budget check must see device time, so the
+            # per-chunk fetch is the bound on overshoot.
             if bool(np.asarray(carry.dead)):
                 break
     from .wgl import verdict

@@ -322,6 +322,9 @@ def check_steps3_long_sparse(rs: ReturnSteps, model: Model,
             carry, part = run(carry, *staged)
             parts_dev = part if parts_dev is None else parts_dev + part
             done += 1
+            # jtlint: disable=JTL103 -- bounded death poll: one fetch per
+            # sched_poll_chunks chunks (the [tunable] knob), not per
+            # iteration — same contract as the dense twin in wgl3.py.
             if done % poll == 0 and bool(np.asarray(carry.dead)):
                 break
     else:
@@ -340,6 +343,9 @@ def check_steps3_long_sparse(rs: ReturnSteps, model: Model,
                               jnp.asarray(rs.targets[sl]),
                               jnp.int32(c * chunk))
             parts_dev = part if parts_dev is None else parts_dev + part
+            # jtlint: disable=JTL103 -- budgeted lane: synchronous per-
+            # chunk fetch bounds budget overshoot to one chunk (the
+            # wgl3.py contract).
             if bool(np.asarray(carry.dead)):
                 break
 

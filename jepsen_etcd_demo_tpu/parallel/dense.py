@@ -123,14 +123,18 @@ def sharded_batch_checker_pallas(model: Model, cfg: DenseConfig, mesh: Mesh,
     if key in _CACHE:
         return _CACHE[key]
 
-    prep = jax.jit(
+    # Both halves wear instrument_kernel (PR 1 invariant, jtlint
+    # JTL105): prep is a real XLA program and the launcher wrapper is
+    # cached per (b_loc, r) by the lru_cache below — uninstrumented,
+    # the sharded pallas lane would be a telemetry blind spot.
+    prep = instrument_kernel("wgl3-pallas-sharded-prep", jax.jit(
         functools.partial(wgl3_pallas.prepare_pallas_batch, model, cfg),
         in_shardings=(NamedSharding(mesh, P(axis, None, None, None)),
                       NamedSharding(mesh, P(axis, None, None)),
                       NamedSharding(mesh, P(axis, None))),
         out_shardings=(NamedSharding(mesh, P(axis, None, None, None)),
                        NamedSharding(mesh, P(axis, None)),
-                       NamedSharding(mesh, P(axis))))
+                       NamedSharding(mesh, P(axis)))))
     if group > 1:
         launcher = wgl3_pallas.local_pallas_launcher_grouped(
             model, cfg, group, interpret=interpret)
@@ -152,7 +156,7 @@ def sharded_batch_checker_pallas(model: Model, cfg: DenseConfig, mesh: Mesh,
             sharded = shard_map(local, check_vma=False, **specs)
         except TypeError:  # older jax names it check_rep
             sharded = shard_map(local, check_rep=False, **specs)
-        return jax.jit(sharded)
+        return instrument_kernel("wgl3-pallas-sharded", jax.jit(sharded))
 
     def check(slot_tabs, slot_active, targets):
         b, r = targets.shape

@@ -53,7 +53,7 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 from ..models.base import Model
-from ..obs import record_check_result
+from ..obs import instrument_kernel, record_check_result
 from ..ops import wgl3
 from ..ops.encode import ReturnSteps
 from ..ops.limits import limits
@@ -384,7 +384,11 @@ def make_lattice_chunk_fn(model: Model, cfg: DenseConfig, mesh: Mesh,
         sharded = shard_map(run, check_vma=False, **specs)
     except TypeError:
         sharded = shard_map(run, check_rep=False, **specs)
-    return jax.jit(sharded), tiling
+    # obs/ compile/execute attribution (the PR 1 invariant, enforced by
+    # jtlint JTL105): this lane shipped uninstrumented in PR 3 — under
+    # virtual-device CI it IS the production wide-geometry path.
+    return instrument_kernel("wgl3-lattice-chunk", jax.jit(sharded)), \
+        tiling
 
 
 def cached_lattice_chunk(model: Model, cfg: DenseConfig, mesh: Mesh,
@@ -401,7 +405,8 @@ def _transitions_fn(model: Model, cfg: DenseConfig):
     key = ("lattice-trans", model.cache_key(), cfg)
     if key not in _CACHE:
         _, transitions = wgl3.make_step_fn3(model, cfg)
-        _CACHE[key] = jax.jit(jax.vmap(transitions))
+        _CACHE[key] = instrument_kernel("lattice-transitions",
+                                        jax.jit(jax.vmap(transitions)))
     return _CACHE[key]
 
 
@@ -461,6 +466,9 @@ def check_steps_lattice_long(rs: ReturnSteps, model: Model,
             table, dead, dead_step, maxf, trans,
             jnp.asarray(rs.targets[sl]), jnp.int32(c * chunk))
         cfgs_dev = part if cfgs_dev is None else cfgs_dev + part
+        # jtlint: disable=JTL103 -- per-chunk death fetch: chunk sizes here
+        # are large (>=128 scanned steps each), so the fetch amortizes; it
+        # is what bounds a falsified history's sweep to one extra chunk.
         if bool(np.asarray(dead)):
             break
     if cfgs_dev is None:

@@ -141,12 +141,7 @@ def check_corpus_multislice(encs: Sequence, model, mesh=None
             NamedSharding(mesh, P(axes, *(None,) * (a.ndim - 1))),
             lambda idx, a=a: a[idx])
         for a in (tabs, act, tgt))
-    check = wgl3.cached_batch_checker3(model, cfg)
-    out_spec = NamedSharding(mesh, P(axes))
-    fn = jax.jit(check, out_shardings={
-        "survived": out_spec, "overflow": out_spec,
-        "dead_step": out_spec, "max_frontier": out_spec,
-        "configs_explored": out_spec, "live_tile_pm": out_spec})
+    fn = _sharded_batch_checker(model, cfg, mesh)
     out = fn(*global_arrays)
     gathered = {k: np.asarray(multihost_utils.process_allgather(
         v, tiled=True)) for k, v in out.items()}
@@ -161,6 +156,36 @@ def check_corpus_multislice(encs: Sequence, model, mesh=None
         full_results[dense_idx[i]] = one
     kernels.add("wgl3-dense-multislice")
     return full_results, (kernels.pop() if len(kernels) == 1 else "mixed")
+
+
+_SHARDED_CACHE: dict = {}
+
+
+def _sharded_batch_checker(model, cfg, mesh):
+    """The multislice-sharded dense batch checker, cached per
+    (model, cfg, mesh) and wearing obs.instrument_kernel. Re-jitting
+    inside check_corpus_multislice per call both discarded jax's C++
+    fast path every corpus pass (a fresh jit wrapper re-traces) and
+    escaped compile/execute attribution (jtlint JTL101/JTL105)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..obs import instrument_kernel
+    from ..ops import wgl3
+    from .dense import _mesh_key
+
+    axes = tuple(mesh.axis_names)
+    key = (model.cache_key(), cfg, _mesh_key(mesh))
+    if key not in _SHARDED_CACHE:
+        check = wgl3.cached_batch_checker3(model, cfg)
+        out_spec = NamedSharding(mesh, P(axes))
+        _SHARDED_CACHE[key] = instrument_kernel(
+            "wgl3-dense-multislice",
+            jax.jit(check, out_shardings={
+                "survived": out_spec, "overflow": out_spec,
+                "dead_step": out_spec, "max_frontier": out_spec,
+                "configs_explored": out_spec, "live_tile_pm": out_spec}))
+    return _SHARDED_CACHE[key]
 
 
 # --- one-machine simulation / dryrun ---------------------------------------

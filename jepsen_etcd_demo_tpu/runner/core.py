@@ -42,6 +42,11 @@ class _RunState:
         self.recorder = recorder
         self.rng = rng
         self.in_flight = 0
+        # jtlint: disable=JTL202 -- lifetime argument: _RunState is
+        # constructed inside interpret_generators (already on the run's
+        # loop) and dies with the run; it can never see a second
+        # asyncio.run. ADVICE r5's bug was a primitive CACHED across
+        # runs (db/etcd.py), which this is not.
         self.wake = asyncio.Condition()
 
     async def notify(self):

@@ -1,0 +1,26 @@
+"""JTL102 negative fixture: the rebinding carry chain (the repo idiom),
+including the factory-through-cache/instrument_kernel resolution."""
+
+import jax
+from myobs import instrument_kernel
+
+_CACHE = {}
+
+
+def _chunk_fn(fn):
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def cached_chunk(fn, cfg):
+    key = ("chunk", cfg)
+    if key not in _CACHE:
+        _CACHE[key] = instrument_kernel("chunk", _chunk_fn(fn))
+    return _CACHE[key]
+
+
+def rebinding_chain(fn, cfg, carry, chunks):
+    run = cached_chunk(fn, cfg)
+    part = None
+    for c in chunks:
+        carry, part = run(carry, c)     # rebound in the call statement
+    return carry, part

@@ -1,0 +1,21 @@
+"""JTL102 positive fixture: donated operands read after donation."""
+
+import jax
+
+
+def make_step(fn):
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def read_after_donation(fn, carry, tabs):
+    run = make_step(fn)
+    out = run(carry, tabs)
+    return carry.sum() + out        # carry's buffer was donated above
+
+
+def loop_without_rebind(fn, carry, chunks):
+    run = make_step(fn)
+    out = None
+    for c in chunks:
+        out = run(carry, c)         # next iteration reads a dead buffer
+    return out

@@ -1,0 +1,13 @@
+"""JTL106 negative fixture: the sanctioned access shapes."""
+
+import os
+
+# Not a KernelLimits knob: other JEPSEN_TPU_* vars are fair game.
+telemetry = os.environ.get("JEPSEN_TPU_TELEMETRY", "1")
+
+
+def sanctioned(limits_mod):
+    # A computed var name via limits.env_var() — the --sweep-mode
+    # escape hatch (cli/main.py): the resolution ladder still applies.
+    var = limits_mod.env_var("sparse_mode")
+    return os.environ.get(var)

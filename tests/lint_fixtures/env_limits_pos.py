@@ -1,0 +1,7 @@
+"""JTL106 positive fixture: raw JEPSEN_TPU_LIMIT_* env reads."""
+
+import os
+
+chunk = int(os.environ["JEPSEN_TPU_LIMIT_LONG_SCAN_CHUNK"])
+poll = int(os.environ.get("JEPSEN_TPU_LIMIT_SCHED_POLL_CHUNKS", "4"))
+mode = os.getenv("JEPSEN_TPU_LIMIT_SPARSE_MODE")

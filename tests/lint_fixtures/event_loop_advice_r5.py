@@ -1,0 +1,37 @@
+"""ADVICE r5 regression fixture (ISSUE 7 satellite): the EtcdDB
+install-lock / PORT_MAP bug shape, reconstructed for JTL202.
+
+The incident: with JEPSEN_TPU_ETCD_PORT_MAP set (co-hosted nodes), the
+install serialization lock survived the first test's ``asyncio.run``;
+``--test-count >= 2`` then awaited it under the SECOND run's loop and
+asyncio raised "... is bound to a different event loop" mid-setup.
+Both surviving shapes are below: a module-level cache keyed by
+something that is NOT the running loop, and a primitive created in a
+(sync) ``__init__``. The shipped fix — the cache keyed by
+``asyncio.get_running_loop()`` — is the negative fixture
+(event_loop_neg.py) and live code (db/etcd.py ``_install_lock``).
+"""
+
+import asyncio
+
+_INSTALL_LOCKS: dict = {}
+
+
+def install_lock_for(directory):
+    # BUG SHAPE 1: cached per DIRECTORY — run 1's Lock is handed to
+    # run 2's loop.
+    lock = _INSTALL_LOCKS.get(directory)
+    if lock is None:
+        lock = _INSTALL_LOCKS[directory] = asyncio.Lock()
+    return lock
+
+
+class EtcdDBBugShape:
+    def __init__(self):
+        # BUG SHAPE 2: created in sync __init__ on an object that a
+        # caller may keep across test iterations.
+        self._install_lock = asyncio.Lock()
+
+    async def setup(self, node):
+        async with self._install_lock:
+            return node

@@ -1,0 +1,28 @@
+"""JTL202 negative fixture: the shipped ADVICE r5 fix shape (loop-keyed
+cache) and creation under the running loop."""
+
+import asyncio
+
+
+class EtcdDBFixedShape:
+    def __init__(self):
+        self._install_locks = {}
+
+    def _install_lock(self):
+        loop = asyncio.get_running_loop()
+        lock = self._install_locks.get(loop)
+        if lock is None:
+            # Keyed by the RUNNING loop: a second asyncio.run gets its
+            # own Lock (db/etcd.py's live fix).
+            lock = self._install_locks[loop] = asyncio.Lock()
+        return lock
+
+    async def setup(self, node):
+        async with self._install_lock():
+            return node
+
+
+async def created_under_loop():
+    q = asyncio.Queue()        # inside async def: belongs to this loop
+    await q.put(1)
+    return q

@@ -1,0 +1,27 @@
+"""JTL103 negative fixture: fetch-after-loop, numpy post-processing,
+and a justified suppressed bounded poll."""
+
+import numpy as np
+
+
+def packed_fetch(run, carry, chunks):
+    for c in chunks:
+        carry, part = run(carry, c)
+    return np.asarray(carry.dead)       # ONE fetch, after the loop
+
+
+def numpy_postprocess(rows):
+    out = []
+    for r in rows:
+        out.append(r.item())            # host numpy — no device hint
+    return out
+
+
+def bounded_poll(run, carry, chunks, poll):
+    for i, c in enumerate(chunks):
+        carry, part = run(carry, c)
+        # jtlint: disable=JTL103 -- bounded: one fetch per `poll` chunks,
+        # the documented early-exit contract.
+        if i % poll == 0 and bool(np.asarray(carry.dead)):
+            break
+    return carry

@@ -1,0 +1,18 @@
+"""JTL103 positive fixture: per-iteration device fetches in chunk loops."""
+
+import numpy as np
+
+
+def poll_every_chunk(run, carry, chunks):
+    for c in chunks:
+        carry, part = run(carry, c)
+        if bool(np.asarray(carry.dead)):    # unbounded per-chunk fetch
+            break
+    return carry
+
+
+def blocking_wait(run, xs):
+    outs = []
+    for x in xs:
+        outs.append(run(x).block_until_ready())
+    return outs

@@ -1,0 +1,24 @@
+"""JTL105 negative fixture: the sanctioned wrap shapes — wrap at the
+jit site, plain factory wrapped at its cache store, wrapped lru."""
+
+import functools
+
+import jax
+from myobs import instrument_kernel
+
+_CACHE = {}
+
+
+def _factory(fn):
+    return jax.jit(fn)              # plain factory: the caller wraps
+
+
+def cached(model_key, fn):
+    if model_key not in _CACHE:
+        _CACHE[model_key] = instrument_kernel("k", _factory(fn))
+    return _CACHE[model_key]
+
+
+@functools.lru_cache(maxsize=None)
+def lru_factory(n):
+    return instrument_kernel("lru", jax.jit(lambda a: a * n))

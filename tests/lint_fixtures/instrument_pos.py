@@ -1,0 +1,33 @@
+"""JTL105 positive fixture: jit caches without instrument_kernel."""
+
+import functools
+
+import jax
+
+_CACHE = {}
+
+module_level = jax.jit(lambda a: a - 1)
+
+
+def cache_store_bare(model_key, cfg):
+    if (model_key, cfg) not in _CACHE:
+        _CACHE[(model_key, cfg)] = jax.jit(lambda a: a + 1)
+    return _CACHE[(model_key, cfg)]
+
+
+@functools.lru_cache(maxsize=None)
+def lru_factory(n):
+    # the lru_cache IS the kernel cache: no later wrap point exists.
+    return jax.jit(lambda a: a * n)
+
+
+def _make_chunk_fn(fn):
+    return jax.jit(fn), 128         # plain factory: exempt HERE...
+
+
+def cached_chunk(fn, cfg):
+    if ("chunk", cfg) not in _CACHE:
+        # ...but the store of its bare-jit result flags (the pre-fix
+        # parallel/lattice.py shape: neither site wraps).
+        _CACHE[("chunk", cfg)] = _make_chunk_fn(fn)
+    return _CACHE[("chunk", cfg)]

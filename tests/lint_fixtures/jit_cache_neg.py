@@ -1,0 +1,17 @@
+"""JTL101 negative fixture: the sanctioned caching idioms."""
+
+import jax
+from myobs import instrument_kernel
+
+_CACHE = {}
+
+
+def cached(model_key, cfg):
+    key = (model_key, cfg)
+    if key not in _CACHE:
+        _CACHE[key] = instrument_kernel("k", jax.jit(lambda a: a + 1))
+    return _CACHE[key]
+
+
+def literal_static(fn):
+    return jax.jit(fn, static_argnums=(0, 1))

@@ -1,0 +1,28 @@
+"""JTL101 positive fixture: every unstable jit-caching shape.
+
+Parsed by tests/test_lint.py, never imported or executed.
+"""
+
+import time
+
+import jax
+
+_CACHE = {}
+
+
+def hot_call(x):
+    # jit-and-call in one expression: compiled callable discarded.
+    return jax.jit(lambda a: a + 1)(x)
+
+
+def cache_by_identity(model, cfg):
+    # id() is per-process (and reusable after GC); time is per-run.
+    key = (id(model), cfg, time.monotonic())
+    if key not in _CACHE:
+        _CACHE[key] = lambda a: a * 2
+    return _CACHE[key]
+
+
+def computed_static(fn, positions):
+    # a computed static set: per-call retrace hazard.
+    return jax.jit(fn, static_argnums=tuple(positions))

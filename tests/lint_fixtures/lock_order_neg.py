@@ -1,0 +1,45 @@
+"""JTL201 negative fixture: one global acquisition order."""
+
+import threading
+
+
+class Consistent:
+    def __init__(self):
+        self._src_lock = threading.Lock()
+        self._dst_lock = threading.Lock()
+
+    def deposit(self):
+        with self._src_lock:
+            with self._dst_lock:
+                pass
+
+    def audit(self):
+        with self._src_lock:
+            with self._dst_lock:   # same order everywhere
+                pass
+
+    def cheap(self):
+        with self._dst_lock:       # inner alone is fine
+            pass
+
+
+class DeferredCallback:
+    """A with-lock inside a nested def is NOT nested under the outer
+    lock: the callback runs later, with nothing held."""
+
+    def __init__(self, pool):
+        self._src_lock = __import__("threading").Lock()
+        self._dst_lock = __import__("threading").Lock()
+        self._pool = pool
+
+    def schedule(self):
+        with self._dst_lock:
+            def task():
+                with self._src_lock:   # runs on the pool, dst NOT held
+                    pass
+            self._pool.submit(task)
+
+    def direct(self):
+        with self._src_lock:
+            with self._dst_lock:       # the only real order: src -> dst
+                pass

@@ -1,0 +1,33 @@
+"""JTL201 positive fixture: opposite acquisition orders + a
+self-deadlock through a same-class helper call."""
+
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._src_lock = threading.Lock()
+        self._dst_lock = threading.Lock()
+
+    def deposit(self):
+        with self._src_lock:
+            with self._dst_lock:
+                pass
+
+    def withdraw(self):
+        with self._dst_lock:
+            with self._src_lock:   # opposite order: deadlock pair
+                pass
+
+
+class Reentrant:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            self.helper()          # helper re-acquires: self-deadlock
+
+    def helper(self):
+        with self._lock:
+            pass

@@ -1,0 +1,32 @@
+"""JTL203 negative fixture: every recognized synchronization shape —
+queue hand-off, lock on both sides, mutate-after-join."""
+
+import queue
+import threading
+
+
+class Disciplined:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._lock = threading.Lock()
+        self._stats = {}
+        self._done = False
+        self._thread = threading.Thread(target=self._consume)
+        self._thread.start()
+
+    def _consume(self):
+        item = self._q.get()
+        with self._lock:
+            self._stats["n"] = item
+
+    def record(self, v):
+        self._q.put(v)              # thread-safe hand-off
+
+    def bump(self):
+        with self._lock:
+            self._stats["m"] = 1    # locked on both sides
+
+    def finalize(self):
+        self._thread.join()
+        self._done = True
+        self._stats["done"] = True  # the thread is dead: no race

@@ -1,0 +1,17 @@
+"""JTL203 positive fixture: one attr mutated by the consumer thread AND
+by a caller-facing method, no lock."""
+
+import threading
+
+
+class Racy:
+    def __init__(self):
+        self._stats = {}
+        self._thread = threading.Thread(target=self._consume)
+        self._thread.start()
+
+    def _consume(self):
+        self._stats["n"] = self._stats.get("n", 0) + 1
+
+    def record(self, k, v):
+        self._stats[k] = v      # races _consume's read-modify-write

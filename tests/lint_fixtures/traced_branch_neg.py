@@ -1,0 +1,18 @@
+"""JTL104 negative fixture: static-config branches and an explicit
+fetch-then-branch (the sanctioned host pattern)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def static_branch(cfg):
+    if cfg.k_slots > 16:
+        return jnp.zeros((4,))
+    return jnp.ones((4,))
+
+
+def explicit_fetch_branch(x):
+    any_set = bool(np.asarray(jnp.any(x)))   # named, visible host sync
+    if any_set:
+        return 1
+    return 0

@@ -1,0 +1,11 @@
+"""JTL104 positive fixture: Python control flow on traced values."""
+
+import jax.numpy as jnp
+
+
+def branch_on_traced(x):
+    if jnp.any(x > 3):
+        return x
+    while jnp.all(x < 5):
+        x = x + 1
+    return x

@@ -75,6 +75,65 @@ def test_missing_lane_is_skipped_not_failed():
     assert res["regressions"] == []
 
 
+def test_lane_dropped_from_new_record_fails_by_name(tmp_path, capsys):
+    """ISSUE 7 satellite: a lane the baseline measures but the candidate
+    lacks is a FAILURE naming the lane (a lane crash / schema break),
+    not a silent skip — and never a KeyError traceback."""
+    old, new = _record(1000.0), _record(1000.0)
+    del new["detail"]["streaming"]
+    res = bench_compare.compare(old, new, threshold_pct=10.0)
+    assert res["comparable"] is True
+    assert set(res["missing"]) == {"streaming_speedup",
+                                   "streaming_overlap"}
+    by_lane = {r["lane"]: r for r in res["lanes"]}
+    assert by_lane["streaming_speedup"].get("missing") is True
+    assert by_lane["streaming_speedup"].get("skipped") is None
+    # The CLI exits nonzero with the named-lane message on stderr.
+    po, pn = tmp_path / "old.json", tmp_path / "new.json"
+    po.write_text(json.dumps(old))
+    pn.write_text(json.dumps(new))
+    assert bench_compare.main([str(po), str(pn)]) == 1
+    captured = capsys.readouterr()
+    assert "streaming_speedup" in captured.err
+    assert "missing from" in captured.err
+    assert "MISSING" in captured.out
+
+
+def test_missing_lane_and_regression_both_reported(tmp_path, capsys):
+    """One run reports BOTH failure classes — a dropped lane must not
+    hide a concurrent threshold regression behind a second CI trip
+    (review finding)."""
+    old, new = _record(1000.0), _record(700.0)
+    del new["detail"]["streaming"]
+    po, pn = tmp_path / "o.json", tmp_path / "n.json"
+    po.write_text(json.dumps(old))
+    pn.write_text(json.dumps(new))
+    assert bench_compare.main([str(po), str(pn),
+                               "--threshold-pct", "10"]) == 1
+    err = capsys.readouterr().err
+    assert "streaming_speedup" in err and "regressed" in err
+
+
+def test_zero_valued_lane_dropped_still_fails():
+    """A baseline lane recorded at 0 (overlap_ratio can legitimately be
+    0) still counts as MEASURED: the candidate dropping it is a
+    missing-lane failure, not a skip (review finding)."""
+    old, new = _record(1000.0), _record(1000.0)
+    old["detail"]["streaming"]["overlap_ratio"] = 0.0
+    del new["detail"]["streaming"]
+    res = bench_compare.compare(old, new)
+    assert "streaming_overlap" in res["missing"]
+
+
+def test_long_history_lane_dropped_also_fails(tmp_path):
+    """The inversion-derived long lanes get the same missing-lane
+    treatment as the fixed table."""
+    old, new = _record(1000.0), _record(1000.0)
+    new["detail"]["long_history"] = [{"ops": 1000, "kernel_s": 0.5}]
+    res = bench_compare.compare(old, new)
+    assert res["missing"] == ["long_10000_eps"]
+
+
 def test_degraded_record_not_comparable():
     """A dead-tunnel round (value 0 / degraded) must not read as a 100%
     regression — BENCH_r05's record is exactly this shape."""

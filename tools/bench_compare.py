@@ -17,8 +17,12 @@ parsed record and compares as degraded), or the raw bench line itself.
 Lanes (all higher-is-better events/s or ratios): the top-level
 throughput + vs_baseline, the corpus_sched / sparse / tuned / streaming
 lane rates, the long-history lanes keyed by op count, and cache /
-padding health. A lane missing from EITHER record is reported as
-skipped, never a failure (older rounds predate newer lanes). A DEGRADED
+padding health. A lane absent from the OLD record is reported as
+skipped, never a failure (older rounds predate newer lanes) — but a
+lane the old record HAS and the new record LACKS means the candidate
+bench dropped a lane (a lane crash, a schema break): that exits
+nonzero with a message NAMING the lane, never a silent skip or a
+KeyError traceback. A DEGRADED
 record (`degraded: true` or `value == 0` / backend none) is not a
 perf measurement at all: the comparison is reported as not-comparable
 and exits 0 — a dead TPU tunnel must not read as a 100% regression.
@@ -107,9 +111,13 @@ def compare(old: dict, new: dict,
 
     Returns {"comparable": bool, "reason": str|None,
              "lanes": [{lane, old, new, delta_pct, regression}],
-             "regressions": [lane...], "threshold_pct": float}."""
+             "regressions": [lane...], "missing": [lane...],
+             "threshold_pct": float} — `missing` names lanes the old
+    record measures but the new record lacks (a dropped lane is a
+    failure, not a skip)."""
     out: dict = {"comparable": True, "reason": None, "lanes": [],
-                 "regressions": [], "threshold_pct": threshold_pct}
+                 "regressions": [], "missing": [],
+                 "threshold_pct": threshold_pct}
     for rec, name in ((old, "old"), (new, "new")):
         if is_degraded(rec):
             out["comparable"] = False
@@ -123,7 +131,15 @@ def compare(old: dict, new: dict,
     pairs += [(lane, old_long.get(lane), new_long.get(lane))
               for lane in sorted(set(old_long) | set(new_long))]
     for lane, o, n in pairs:
-        if o is None or n is None or o == 0:
+        if o is not None and n is None:
+            # The baseline RECORDS this lane (a 0 measurement counts —
+            # overlap can legitimately be 0); the candidate dropped it.
+            out["lanes"].append({"lane": lane, "old": round(o, 4),
+                                 "new": None, "delta_pct": None,
+                                 "regression": False, "missing": True})
+            out["missing"].append(lane)
+            continue
+        if o is None or o == 0:
             out["lanes"].append({"lane": lane, "old": o, "new": n,
                                  "delta_pct": None, "regression": False,
                                  "skipped": True})
@@ -169,16 +185,28 @@ def main(argv=None) -> int:
                 if r.get("skipped"):
                     print(f"{r['lane']:<{w}}  (skipped: absent in one "
                           f"record)")
+                elif r.get("missing"):
+                    print(f"{r['lane']:<{w}}  {r['old']:>12g} -> "
+                          f"(MISSING from new record)")
                 else:
                     flag = "  << REGRESSION" if r["regression"] else ""
                     print(f"{r['lane']:<{w}}  {r['old']:>12g} -> "
                           f"{r['new']:>12g}  {r['delta_pct']:+7.2f}%{flag}")
     if not res["comparable"]:
         return 0
+    # Report EVERY failure class in one run — a missing lane must not
+    # hide a concurrent threshold regression behind a second CI trip.
+    if res["missing"]:
+        print(f"FAIL: {len(res['missing'])} lane(s) present in "
+              f"{args.old} but missing from {args.new}: "
+              f"{', '.join(res['missing'])} — the candidate bench "
+              f"dropped a lane (lane crash / schema break)",
+              file=sys.stderr)
     if res["regressions"]:
         print(f"FAIL: {len(res['regressions'])} lane(s) regressed more "
               f"than {args.threshold_pct:g}%: "
               f"{', '.join(res['regressions'])}", file=sys.stderr)
+    if res["missing"] or res["regressions"]:
         return 1
     print(f"ok: no lane regressed more than {args.threshold_pct:g}%")
     return 0

@@ -2,17 +2,12 @@
 """Lint: every `KernelLimits` field must be documented in doc/perf.md —
 with its provenance tag and safe range.
 
-PR 2 added four tuning knobs and PR 3 five more; a knob that exists only
-as a dataclass field is invisible to operators (the env override
-`JEPSEN_TPU_LIMIT_<FIELD>` is derived from the field name, so the doc
-table is the only place a human can discover it). ISSUE 4 raises the
-bar: the autotuner (tune/) searches each field inside its safe range and
-respects its `[worker]`/`[arch]`/`[tunable]` kind, so the doc row must
-now ALSO carry the tag and the range — and both must MATCH the dataclass
-metadata (ops/limits.py field_meta), or the documented search bounds and
-the enforced ones drift apart. Wired into tier-1
-(tests/test_limits_doc.py) so a new knob cannot land undocumented or
-mis-documented.
+ISSUE 7 moved the core onto the shared jtlint rule-runner
+(jepsen_etcd_demo_tpu/analysis/rules/limits_doc.py, rule JTL301), so
+doc lint and code lint share ONE findings format and ONE baseline
+mechanism — `jepsen-tpu lint` runs this check automatically as a
+project rule. This file stays as the historic CLI entry point and
+importable API (tests/test_limits_doc.py pins both):
 
 Usage: python tools/check_limits_doc.py  (exit 1 + every problem).
 Importable: `missing_fields()` returns undocumented field names;
@@ -27,65 +22,30 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 DOC = REPO / "doc" / "perf.md"
 
+sys.path.insert(0, str(REPO))
+
+from jepsen_etcd_demo_tpu.analysis.rules import limits_doc as _core  # noqa: E402
+
 
 def field_metadata() -> dict[str, dict]:
-    sys.path.insert(0, str(REPO))
-    from jepsen_etcd_demo_tpu.ops.limits import field_meta
-
-    return field_meta()
+    return _core.field_metadata()
 
 
 def range_text(meta: dict) -> str:
-    lo, hi = meta["range"]
-    return f"{lo}..{hi}"
+    return _core.range_text(meta)
 
 
 def missing_fields(doc_path: Path = DOC) -> list[str]:
     """KernelLimits field names not mentioned (as `field` code spans) in
     the perf doc."""
-    text = doc_path.read_text(encoding="utf-8")
-    return [name for name in field_metadata() if f"`{name}`" not in text]
+    return _core.missing_fields(doc_path)
 
 
 def doc_errors(doc_path: Path = DOC) -> list[str]:
     """Every documentation problem: a field absent from the doc, or a
     field whose doc row (the table line naming it) lacks — or
     contradicts — its `[kind]` tag or `lo..hi` safe range."""
-    text = doc_path.read_text(encoding="utf-8")
-    lines = text.splitlines()
-    errors: list[str] = []
-    for name, meta in field_metadata().items():
-        span = f"`{name}`"
-        rows = [ln for ln in lines if span in ln and ln.lstrip().startswith("|")]
-        if span not in text or not rows:
-            errors.append(f"{name}: no table row in doc/perf.md "
-                          f"(env JEPSEN_TPU_LIMIT_{name.upper()})")
-            continue
-        # A field may appear in several tables (the probe-group map, the
-        # reference); it passes when SOME row carries both its tag and
-        # its range — the reference row. The range must fill a WHOLE
-        # table cell: a bare substring test would let `1..80` satisfy a
-        # wanted `1..8` (prefix drift the lint exists to catch).
-        want_tag = f"[{meta['kind']}]"
-        want_cell = f"| {range_text(meta)} |"
-        cells = [" ".join(r.split()) for r in rows]
-        if any(want_tag in r and want_cell in r for r in cells):
-            continue
-        if not any(want_tag in r for r in cells):
-            errors.append(f"{name}: no table row carries its provenance "
-                          f"tag {want_tag} (tags: "
-                          f"[worker]/[arch]/[tunable])")
-        if not any(want_cell in r for r in cells):
-            errors.append(f"{name}: no table row carries its safe range "
-                          f"`{range_text(meta)}` as a whole cell "
-                          f"(ops/limits.py field_meta is the source of "
-                          f"truth)")
-        if any(want_tag in r for r in cells) \
-                and any(want_cell in r for r in cells):
-            errors.append(f"{name}: tag {want_tag} and range "
-                          f"`{range_text(meta)}` never appear in the "
-                          f"SAME row")
-    return errors
+    return _core.doc_errors(doc_path)
 
 
 def main() -> int:
