@@ -953,50 +953,48 @@ def bench_100k(model) -> dict:
 
 def _backend_alive(timeout_s: float = 240.0,
                    platforms: str | None = None) -> tuple[bool, str]:
-    """Probe the default JAX backend in a SUBPROCESS with a hard timeout:
-    a wedged remote-TPU tunnel hangs backend init indefinitely and
-    un-interruptibly from within the process (observed live: a mid-round
-    tunnel outage turned every backend touch into a forever-hang). The
-    bench must fail FAST with a diagnosable line, not silently eat the
-    driver's whole budget. Returns (ok, reason): a timeout and a fast
-    crash are DIFFERENT failures and the reason says which (with the
-    probe's stderr tail for the crash case). The probe enables the same
-    persistent compile cache the bench uses, so on a healthy machine it
-    costs one trivial cached compile (~1-2 s warm; ~20-40 s only the
-    very first time ever — 240 s bounds that with margin)."""
-    import subprocess
+    """Probe the default JAX backend in a SUBPROCESS with a hard
+    timeout. ISSUE 8 promoted the probe itself into the reusable
+    backend-health layer (obs/health.py probe_backend — the state
+    machine the runner / stream / sched drive and /healthz exposes);
+    this wrapper keeps the bench's historical (ok, reason) shape. The
+    wedged-vs-crash distinction rides the reason text (a timeout reason
+    names the wedged tunnel), which _drive_supervisor maps back onto
+    the state machine."""
+    from jepsen_etcd_demo_tpu.obs.health import probe_backend
 
-    code = ("from jepsen_etcd_demo_tpu.cli.main import "
-            "_honor_platform_env, enable_compilation_cache; "
-            # JAX_PLATFORMS must steer the PROBE too (the sitecustomize
-            # pre-import otherwise dials the default tunnel even under
-            # JAX_PLATFORMS=cpu — the exact trap cli/main works around).
-            "_honor_platform_env(); enable_compilation_cache(); "
-            "import numpy, jax, jax.numpy as jnp; "
-            "numpy.asarray(jax.jit(lambda a: a + 1)(jnp.zeros(4))); "
-            "print('BACKEND_OK')")
-    env = dict(os.environ)
-    if platforms is not None:
-        env["JAX_PLATFORMS"] = platforms
-    try:
-        out = subprocess.run([sys.executable, "-c", code],
-                             capture_output=True, text=True,
-                             env=env, timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        return False, (f"trivial jit round trip exceeded {timeout_s:.0f}s "
-                       f"— remote TPU tunnel down/wedged?")
-    except OSError as e:
-        return False, f"could not spawn the probe: {e}"
-    if "BACKEND_OK" in out.stdout:
-        return True, ""
-    return False, (f"probe exited {out.returncode} without completing a "
-                   f"trivial jit; stderr tail: {out.stderr[-500:]}")
+    ok, reason, _timed_out = probe_backend(timeout_s=timeout_s,
+                                           platforms=platforms)
+    return ok, reason
+
+
+def _drive_supervisor(ok: bool, reason: str) -> dict:
+    """Fold one probe outcome into the process backend supervisor
+    (obs/health.py) and return its snapshot — the bench record's
+    `health` field, captured at probe time so a degraded CPU rerun's
+    later successes can't repaint the default backend healthy in the
+    record."""
+    from jepsen_etcd_demo_tpu.obs import health
+
+    sup = health.get_supervisor()
+    if ok:
+        sup.note_ok(source="bench.probe")
+    else:
+        # The timeout reason carries the wedged-tunnel marker phrase
+        # (health.TIMEOUT_MARKER — the same constant probe_backend
+        # composes the reason with, so the classification can't desync
+        # from the wording); a fast crash walks the consecutive-failure
+        # thresholds instead.
+        sup.note_failure(reason, source="bench.probe",
+                         wedged=health.TIMEOUT_MARKER in reason)
+    return sup.snapshot()
 
 
 def main():
     from jepsen_etcd_demo_tpu import obs
 
     ok, reason = _backend_alive()
+    health_rec = _drive_supervisor(ok, reason)
     degraded = False
     if not ok:
         # Degraded-mode fallback (VERDICT r5): a dead TPU tunnel used to
@@ -1028,6 +1026,7 @@ def main():
                 # Which tuning profile the run INTENDED to use (ISSUE 4:
                 # tools/print_profile.py prints the full resolved view).
                 "profile": _profile_record(),
+                "health": health_rec,
                 "degraded": True,
                 "backend": "none",
                 "detail": {"probe": {"default": reason,
@@ -1116,6 +1115,7 @@ def main():
             "cache_hit_rate": 0.0,
             "sweep": obs.sweep_stats(cap.metrics),
             "profile": _profile_record(),
+            "health": health_rec,
             "degraded": True,
             "backend": "cpu",
             "detail": {"probe": {"default": reason}},
@@ -1188,6 +1188,10 @@ def main():
         # The tuning profile this round resolved (ISSUE 4): hash +
         # non-default fields with provenance; detail.tuned measures it.
         "profile": _profile_record(),
+        # The backend supervisor's state at probe time (obs/health.py,
+        # ISSUE 8): healthy here; the degraded records above carry the
+        # degraded/wedged snapshot with last-transition provenance.
+        "health": health_rec,
         "degraded": degraded,
         "backend": "cpu" if degraded else jax.default_backend(),
         "detail": detail,

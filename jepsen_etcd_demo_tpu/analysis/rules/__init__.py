@@ -20,5 +20,6 @@ from . import instrument        # noqa: F401
 from . import jit_cache         # noqa: F401
 from . import limits_doc        # noqa: F401
 from . import lock_order        # noqa: F401
+from . import metric_name       # noqa: F401
 from . import shared_state      # noqa: F401
 from . import traced_branch     # noqa: F401

@@ -1,0 +1,81 @@
+"""JTL107 computed-metric-name: metric names must be string literals.
+
+The obs registry happily creates an instrument per distinct name, and
+PR 8's Prometheus exporter (obs/export.py) turns every name into a
+scrape-visible series — so a name BUILT at the call site
+(``m.counter(f"runner.ops_{op.value}")``) is a label-cardinality
+explosion waiting for the first unbounded value: registry memory grows
+with workload data, /metrics output grows without bound, and the
+pre-registration contract ("zeros permitted, never absent") can't
+cover names that don't exist until traffic invents them.
+
+Legitimate *bounded* families (per-kernel histograms where the member
+set is the fixed set of instrument_kernel call sites, per-knob tune
+gauges) carry a justified inline suppression — the justification must
+make the boundedness argument — and the exporter folds them into ONE
+labeled Prometheus family (export.LABELED_FAMILIES) rather than N
+names.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import ModuleSource, Rule, register
+from ..findings import Finding
+
+_METHODS = ("counter", "gauge", "histogram")
+
+
+def _builder_kind(node: ast.AST) -> str:
+    """Non-empty iff the name is BUILT at the call site. A plain Name /
+    constant passes: iterating a module-level literal tuple (the
+    capture() pre-registration loops) is bounded by construction, and
+    the builder shapes are the ones that splice workload data in."""
+    if isinstance(node, ast.JoinedStr):
+        return "an f-string"
+    if isinstance(node, ast.BinOp):
+        return "string concatenation/formatting"
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr == "format":
+        return "a .format() call"
+    return ""
+
+
+@register
+class ComputedMetricNameRule(Rule):
+    id = "JTL107"
+    name = "computed-metric-name"
+    scopes = None          # metrics are emitted from every layer
+    rationale = (
+        "a metric name built at the call site (f-string / + / .format) "
+        "is unbounded cardinality: the registry allocates per distinct "
+        "name and the Prometheus exporter (obs/export.py) publishes "
+        "every one as a scrape series — one unbounded interpolated "
+        "value and /metrics grows with workload data")
+    hint = ("use a string-literal metric name; for a genuinely BOUNDED "
+            "family (fixed kernel/knob sets) suppress with the "
+            "boundedness argument and register the family in "
+            "obs/export.py LABELED_FAMILIES so it exports as one "
+            "labeled series")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METHODS and node.args):
+                continue
+            recv = node.func.value
+            if isinstance(recv, ast.Name) and recv.id in mod.imports.names:
+                # A module-level function that happens to share a method
+                # name (np.histogram(...)) — not a registry instrument.
+                continue
+            kind = _builder_kind(node.args[0])
+            if not kind:
+                continue
+            yield mod.finding(
+                self, node,
+                f".{node.func.attr}() name built from {kind} — metric "
+                f"names must be string literals (unbounded series "
+                f"cardinality otherwise)")

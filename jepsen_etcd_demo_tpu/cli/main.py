@@ -145,6 +145,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(FIFO violation)")
     t.add_argument("--duplicate-delivery-prob", type=float, default=0.0,
                    help="[fake] queue dequeues deliver without removing")
+    t.add_argument("--live-port", type=positive_int, default=None,
+                   metavar="PORT",
+                   help="serve the live observability plane from THIS "
+                        "process while the test runs: /live (SSE "
+                        "in-flight view), /metrics (Prometheus), "
+                        "/healthz (backend supervisor) plus the normal "
+                        "store index on 127.0.0.1:PORT")
     _add_sweep_mode_flag(t)
 
     a = sub.add_parser("analyze", help="re-check a stored history")
@@ -216,7 +223,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="results store root (locates the persistent "
                         "compile cache the probes warm)")
 
-    s = sub.add_parser("serve", help="serve the results store over http")
+    s = sub.add_parser(
+        "serve",
+        help="serve the results store over http (plus /live, /metrics, "
+             "/healthz — live data needs the runner in-process: "
+             "`jepsen-tpu test --live-port`)")
     s.add_argument("--port", type=int, default=8080)
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--store", default="store")
@@ -325,18 +336,40 @@ def _test_opts(args) -> dict:
 def cmd_test(args) -> int:
     enable_compilation_cache(args.store)
     _apply_sweep_mode(args)
+    live_server = None
+    if getattr(args, "live_port", None):
+        # The live observability plane (web/server.py, ISSUE 8) only
+        # shows a run in flight when it shares the runner's process —
+        # serve it for the duration of the test loop.
+        import threading
+
+        from http.server import ThreadingHTTPServer
+
+        from ..web.server import make_handler
+
+        live_server = ThreadingHTTPServer(("127.0.0.1", args.live_port),
+                                          make_handler(args.store))
+        threading.Thread(target=live_server.serve_forever,
+                         name="live-plane", daemon=True).start()
+        print(f"# live plane on http://127.0.0.1:{args.live_port}/live "
+              f"(/metrics, /healthz)", file=sys.stderr)
     rc = 0
-    for i in range(args.test_count):
-        opts = _test_opts(args)
-        opts["seed"] = args.seed + i
-        test = fake_test(opts) if args.fake else etcd_test(opts)
-        result = asyncio.run(run_test(test))
-        print(json.dumps({"valid": result.get("valid"),
-                          "op_count": result.get("op_count"),
-                          "run_seconds": round(
-                              result.get("run_seconds", 0), 2)}))
-        if result.get("valid") is not True:
-            rc = 1
+    try:
+        for i in range(args.test_count):
+            opts = _test_opts(args)
+            opts["seed"] = args.seed + i
+            test = fake_test(opts) if args.fake else etcd_test(opts)
+            result = asyncio.run(run_test(test))
+            print(json.dumps({"valid": result.get("valid"),
+                              "op_count": result.get("op_count"),
+                              "run_seconds": round(
+                                  result.get("run_seconds", 0), 2)}))
+            if result.get("valid") is not True:
+                rc = 1
+    finally:
+        if live_server is not None:
+            live_server.shutdown()
+            live_server.server_close()
     return rc
 
 

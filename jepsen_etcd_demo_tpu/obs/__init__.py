@@ -27,6 +27,16 @@ Env vars:
   JEPSEN_TPU_JAX_TRACE=1   additionally capture a jax.profiler trace of
                            the check phase into <run_dir>/jax_trace/
                            (view with tensorboard/xprof)
+  JEPSEN_TPU_KERNEL_COST=0 disable the per-kernel XLA cost_analysis /
+                           device-memory capture on first calls
+                           (kernel_phases flops/bytes stay zero)
+
+Live export (obs/export.py): `obs.subscribe()` streams span/event/
+metric records as they are appended (the web layer's /live SSE feed),
+`obs.render_prometheus(...)` renders a registry snapshot as Prometheus
+text for /metrics. Backend health (obs/health.py):
+`health.get_supervisor()` is the process-wide healthy/degraded/wedged
+state machine behind /healthz and the bench record.
 
 Well-known metric keys (pre-registered at zero by capture(), so they
 are never absent from metrics.json or the bench's kernel_phases):
@@ -52,9 +62,13 @@ from typing import Callable, Iterator, Optional
 
 from .metrics import MetricsRegistry, read_metrics
 from .trace import Tracer, read_jsonl
+from . import export                               # noqa: E402
+from . import health                               # noqa: E402
+from .export import render_prometheus, subscribe   # noqa: F401
 
 TELEMETRY_FILE = "telemetry.jsonl"
 METRICS_FILE = "metrics.json"
+KERNEL_COST_ENV = "JEPSEN_TPU_KERNEL_COST"
 
 # The bench/e2e contract keys: pre-registered at zero on every capture.
 PHASE_COUNTERS = ("wgl.compile_s", "wgl.execute_s", "encode.encode_s")
@@ -80,6 +94,17 @@ SWEEP_GAUGE = "wgl.live_tile_ratio"
 # registered so every run's metrics.json carries them (zeros permitted,
 # never absent; a post-hoc run simply records zeros).
 STREAM_GAUGES = ("stream.overlap_ratio", "stream.watermark_lag")
+# Deep kernel attribution (ISSUE 8): XLA cost_analysis totals captured
+# by instrument_kernel at lower time, plus the device-memory high-water
+# mark — behind kernel_phases' flops / bytes / device_mem_peak fields.
+# Tracer truncation (trace.dropped_records) rides along so a truncated
+# telemetry.jsonl is visible in metrics too, not only the footer.
+COST_COUNTERS = ("wgl.flops", "wgl.bytes_accessed",
+                 "trace.dropped_records")
+COST_GAUGE = "wgl.device_mem_peak"
+# Backend health supervisor (obs/health.py): 0 healthy / 1 degraded /
+# 2 wedged, set on every transition.
+HEALTH_GAUGE = "health.state"
 
 _NULL_TRACER = Tracer(enabled=False)
 _NULL_METRICS = MetricsRegistry(enabled=False)
@@ -96,12 +121,23 @@ class Capture:
         self.tracer = Tracer(enabled=enabled)
         self.metrics = MetricsRegistry(enabled=enabled)
         if enabled:
-            for name in PHASE_COUNTERS + SCHED_COUNTERS + SWEEP_COUNTERS:
+            for name in PHASE_COUNTERS + SCHED_COUNTERS + SWEEP_COUNTERS \
+                    + COST_COUNTERS:
                 self.metrics.counter(name)
             self.metrics.gauge(PHASE_GAUGE)
             self.metrics.gauge(SWEEP_GAUGE)
+            self.metrics.gauge(COST_GAUGE)
+            self.metrics.gauge(HEALTH_GAUGE)
             for name in STREAM_GAUGES:
                 self.metrics.gauge(name)
+            # Live-export wiring (obs/export.py): appended trace records
+            # stream to bus subscribers in exact append order, and a
+            # dropped record increments trace.dropped_records the moment
+            # it happens (the tracer's meta/footer carry the final
+            # count; the metric makes truncation visible live).
+            self.tracer.listener = export.bus_publish
+            self.tracer.drop_counter = \
+                self.metrics.counter("trace.dropped_records")
 
     def write(self) -> None:
         if not self.enabled or self.out_dir is None:
@@ -137,6 +173,12 @@ def get_metrics() -> MetricsRegistry:
     return stack[-1].metrics if stack else _NULL_METRICS
 
 
+def capture_active() -> bool:
+    """True while some capture is installed (a run is in flight) — the
+    /healthz `run_in_flight` field."""
+    return bool(_stack)
+
+
 @contextmanager
 def capture(out_dir: Optional[str | Path] = None) -> Iterator[Capture]:
     """Install a fresh tracer+registry as the active telemetry sinks;
@@ -160,6 +202,53 @@ def capture(out_dir: Optional[str | Path] = None) -> Iterator[Capture]:
 
 # -- kernel phase attribution ----------------------------------------------
 
+def kernel_cost_enabled() -> bool:
+    return os.environ.get(KERNEL_COST_ENV, "1").lower() \
+        not in ("0", "false", "no", "off")
+
+
+def _capture_kernel_cost(name: str, fn: Callable, args, kwargs,
+                         m: MetricsRegistry) -> None:
+    """Deep attribution for one about-to-compile kernel geometry: lower
+    the jitted callable (tracing only — no XLA compile, no execution,
+    donation-safe because nothing runs) and fold its
+    ``cost_analysis()`` flops / bytes-accessed estimates into the
+    registry, then note the backend's device-memory high-water mark.
+    Pure observability: ANY failure (a non-jit callable, a backend
+    without cost analysis, a CPU without memory_stats) is swallowed and
+    the pre-registered zeros stand."""
+    try:
+        lowered = fn.lower(*args, **kwargs)
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):   # older jax: one per device
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0) or 0.0)
+        nbytes = float(ca.get("bytes accessed", 0.0) or 0.0)
+        if flops > 0:
+            m.counter("wgl.flops").add(flops)
+            # jtlint: disable=JTL107 -- bounded family: kernel names are
+            # the fixed static set of instrument_kernel call sites; the
+            # exporter folds them into one labeled Prometheus family.
+            m.gauge(f"wgl.kernel_flops.{name}").set(flops)
+        if nbytes > 0:
+            m.counter("wgl.bytes_accessed").add(nbytes)
+            # jtlint: disable=JTL107 -- bounded family: kernel names are
+            # a fixed static set (same argument as wgl.kernel_flops).
+            m.gauge(f"wgl.kernel_bytes.{name}").set(nbytes)
+    except Exception:
+        pass
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = float(stats.get("peak_bytes_in_use",
+                               stats.get("bytes_in_use", 0)) or 0)
+        if peak > 0:
+            m.gauge(COST_GAUGE).set(peak)
+    except Exception:
+        pass
+
+
 def instrument_kernel(name: str, fn: Callable) -> Callable:
     """Wrap a jit-compiled kernel callable for compile/execute
     attribution. The FIRST call of a jitted function runs tracing + XLA
@@ -178,20 +267,31 @@ def instrument_kernel(name: str, fn: Callable) -> Callable:
     state = {"first": True}
 
     def wrapped(*args, **kwargs):
+        first = state["first"]
+        m = get_metrics()
+        if first and m.enabled and kernel_cost_enabled():
+            # Deep attribution BEFORE the call (donated operands are
+            # still alive): XLA cost_analysis flops/bytes + device
+            # memory peak, outside the timed region so compile_s keeps
+            # meaning "the first call's wall".
+            _capture_kernel_cost(name, fn, args, kwargs, m)
         t0 = time.monotonic()
         out = fn(*args, **kwargs)
         dt = time.monotonic() - t0
-        m = get_metrics()
-        if state["first"]:
+        if first:
             state["first"] = False
             m.counter("wgl.compile_s").add(dt)
             m.counter("wgl.compile_calls").add(1)
+            # jtlint: disable=JTL107 -- bounded family: kernel names are
+            # the fixed static set of instrument_kernel call sites.
             m.histogram(f"wgl.compile_s.{name}").observe(dt)
             get_tracer().event("wgl.compile", kernel=name,
                                seconds=round(dt, 6))
         else:
             m.counter("wgl.execute_s").add(dt)
             m.counter("wgl.execute_calls").add(1)
+            # jtlint: disable=JTL107 -- bounded family: kernel names are
+            # the fixed static set of instrument_kernel call sites.
             m.histogram(f"wgl.execute_s.{name}").observe(dt)
         return out
 
@@ -230,6 +330,9 @@ def record_check_result(res: dict) -> None:
     if isinstance(sweep, dict):
         mode = sweep.get("mode")
         if mode in ("sparse", "dense", "mixed"):
+            # jtlint: disable=JTL107 -- bounded family: mode is checked
+            # against the closed {sparse, dense, mixed} set on the line
+            # above; all three names are pre-registered by capture().
             m.counter(f"wgl.sweep_checks_{mode}").add(1)
         for key in ("steps_sparse", "steps_dense"):
             try:
@@ -237,6 +340,9 @@ def record_check_result(res: dict) -> None:
             except (TypeError, ValueError):
                 v = 0
             if v > 0:
+                # jtlint: disable=JTL107 -- bounded family: key iterates
+                # the closed two-element tuple above; both names are
+                # pre-registered by capture().
                 m.counter(f"wgl.sweep_{key}").add(v)
     elif ratio >= 0:
         # A dense batched launch: no sweep record, but the measured
@@ -263,9 +369,15 @@ def kernel_phases(metrics: Optional[MetricsRegistry] = None) -> dict:
     timing field is zero — the contract is "zeros permitted, never
     absent". `profile_hash` identifies the tuning profile the process
     resolved (ISSUE 4: every bench record names its profile, the
-    degraded path included — "default" when none applies)."""
+    degraded path included — "default" when none applies). ISSUE 8
+    grew the deep-attribution fields: `flops` / `bytes` (summed XLA
+    cost_analysis estimates over every kernel geometry compiled under
+    the capture) and `device_mem_peak` (the backend allocator's
+    peak-bytes-in-use high-water mark) — zeros on backends that report
+    neither, never absent."""
     out = {"compile_s": 0.0, "execute_s": 0.0, "encode_s": 0.0,
-           "frontier_peak": 0, "profile_hash": active_profile_hash()}
+           "frontier_peak": 0, "flops": 0.0, "bytes": 0.0,
+           "device_mem_peak": 0, "profile_hash": active_profile_hash()}
     if metrics is None or not metrics.enabled:
         return out
     snap = metrics.snapshot()
@@ -278,9 +390,14 @@ def kernel_phases(metrics: Optional[MetricsRegistry] = None) -> dict:
     out["compile_s"] = counter_value("wgl.compile_s")
     out["execute_s"] = counter_value("wgl.execute_s")
     out["encode_s"] = counter_value("encode.encode_s")
+    out["flops"] = counter_value("wgl.flops")
+    out["bytes"] = counter_value("wgl.bytes_accessed")
     fp = snap.get(PHASE_GAUGE)
     if fp and fp.get("max") is not None:
         out["frontier_peak"] = int(fp["max"])
+    mem = snap.get(COST_GAUGE)
+    if mem and mem.get("max") is not None:
+        out["device_mem_peak"] = int(mem["max"])
     return out
 
 

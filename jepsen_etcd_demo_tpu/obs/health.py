@@ -1,0 +1,297 @@
+"""Backend health supervisor: healthy -> degraded -> wedged, and back.
+
+BENCH_r05 recorded the incident this module exists for: a wedged remote
+TPU tunnel turned every backend touch into an uninterruptible hang, and
+the only thing that caught it was the bench's ad-hoc trivial-jit probe
+in a subprocess. ROADMAP item 1 (checking-as-a-service) needs that
+probe as a *reusable state machine* the daemon can attach its CPU
+failover to — this is it.
+
+State machine (one supervisor per process, :func:`get_supervisor`):
+
+  healthy --[consecutive failures >= fail_degraded]--> degraded
+  degraded --[consecutive failures >= fail_wedged]--> wedged
+  * --[probe TIMEOUT]--> wedged          (a hang IS the wedged signature)
+  * --[any success]--> healthy           (recovery is immediate: the
+                                          backend either completes a
+                                          trivial jit or it doesn't)
+
+Signals come from two directions:
+
+  * **passive** — the hot paths report outcomes they already have:
+    every successful kernel dispatch in stream/engine.py's consumer and
+    sched/engine.py's bucket launcher is a free health proof
+    (:meth:`~BackendSupervisor.note_ok`, a few ns), and a dispatch
+    exception is a failure (:meth:`~BackendSupervisor.note_failure`).
+  * **active** — :meth:`~BackendSupervisor.maybe_probe` runs the
+    trivial-jit subprocess probe (:func:`probe_backend`, the exact
+    probe bench.py ships) when `probe_interval_s` has elapsed,
+    rate-limited so the runner check phase / stream consumer can call
+    it every pass for free. A fresh supervisor starts its interval
+    clock at construction, so short-lived test processes never pay the
+    subprocess.
+
+Transitions are recorded as obs events (`health.transition`) and the
+`health.state` gauge (0 healthy / 1 degraded / 2 wedged) when a capture
+is active, and carry last-transition provenance (when, why, which
+caller) — exposed verbatim by `/healthz` (web/server.py) and stamped
+into every bench record.
+
+Env knobs (doc/telemetry.md "Backend health"):
+  JEPSEN_TPU_HEALTH_PROBE_TIMEOUT_S   subprocess probe timeout (240)
+  JEPSEN_TPU_HEALTH_PROBE_INTERVAL_S  active-probe rate limit (300)
+  JEPSEN_TPU_HEALTH_FAIL_DEGRADED     consecutive failures -> degraded (1)
+  JEPSEN_TPU_HEALTH_FAIL_WEDGED       consecutive failures -> wedged (3)
+  JEPSEN_TPU_HEALTH_PROBE=0           disable ACTIVE probing entirely
+                                      (passive signals still drive the
+                                      state machine)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+WEDGED = "wedged"
+# State -> numeric level: the health.state gauge and the /metrics
+# jepsen_tpu_health_state series share this one mapping.
+STATE_LEVEL = {HEALTHY: 0, DEGRADED: 1, WEDGED: 2}
+_STATE_LEVEL = STATE_LEVEL
+
+PROBE_TIMEOUT_S = 240.0
+PROBE_INTERVAL_S = 300.0
+# The probe-timeout reason's marker phrase. Single source of truth for
+# the wedged-tunnel signature: probe_backend composes its timeout
+# reason with it, and consumers that only have the reason STRING (the
+# bench's monkeypatch-stable (ok, reason) probe wrapper) classify by
+# it — editing the wording here cannot desync them.
+TIMEOUT_MARKER = "remote TPU tunnel down/wedged?"
+
+
+def _env_float(var: str, default: float) -> float:
+    try:
+        return float(os.environ.get(var, ""))
+    except ValueError:
+        return default
+
+
+def _env_int(var: str, default: int) -> int:
+    try:
+        return int(os.environ.get(var, ""))
+    except ValueError:
+        return default
+
+
+def probe_backend(timeout_s: float = PROBE_TIMEOUT_S,
+                  platforms: Optional[str] = None
+                  ) -> tuple[bool, str, bool]:
+    """Probe the default JAX backend in a SUBPROCESS with a hard
+    timeout: a wedged remote-TPU tunnel hangs backend init indefinitely
+    and un-interruptibly from within the process (observed live,
+    BENCH_r05), so the probe must be killable from outside. Returns
+    (ok, reason, timed_out): a timeout and a fast crash are DIFFERENT
+    failures — a timeout is the wedged signature, a crash is a
+    diagnosable error (reason carries the stderr tail). The probe
+    enables the same persistent compile cache production runs use, so
+    on a healthy machine it costs one trivial cached compile (~1-2 s
+    warm; ~20-40 s only the very first time ever)."""
+    import subprocess
+
+    code = ("from jepsen_etcd_demo_tpu.cli.main import "
+            "_honor_platform_env, enable_compilation_cache; "
+            # JAX_PLATFORMS must steer the PROBE too (the sitecustomize
+            # pre-import otherwise dials the default tunnel even under
+            # JAX_PLATFORMS=cpu — the exact trap cli/main works around).
+            "_honor_platform_env(); enable_compilation_cache(); "
+            "import numpy, jax, jax.numpy as jnp; "
+            "numpy.asarray(jax.jit(lambda a: a + 1)(jnp.zeros(4))); "
+            "print('BACKEND_OK')")
+    env = dict(os.environ)
+    if platforms is not None:
+        env["JAX_PLATFORMS"] = platforms
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             env=env, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, (f"trivial jit round trip exceeded {timeout_s:.0f}s "
+                       f"— {TIMEOUT_MARKER}"), True
+    except OSError as e:
+        return False, f"could not spawn the probe: {e}", False
+    if "BACKEND_OK" in out.stdout:
+        return True, "", False
+    return False, (f"probe exited {out.returncode} without completing a "
+                   f"trivial jit; stderr tail: {out.stderr[-500:]}"), False
+
+
+class BackendSupervisor:
+    """The healthy/degraded/wedged state machine. Thread-safe: passive
+    notes come from the stream consumer thread, the asyncio event loop,
+    and sched's caller concurrently."""
+
+    def __init__(self, probe: Optional[Callable] = None,
+                 fail_degraded: Optional[int] = None,
+                 fail_wedged: Optional[int] = None,
+                 probe_timeout_s: Optional[float] = None,
+                 probe_interval_s: Optional[float] = None):
+        self.fail_degraded = fail_degraded if fail_degraded is not None \
+            else max(1, _env_int("JEPSEN_TPU_HEALTH_FAIL_DEGRADED", 1))
+        self.fail_wedged = fail_wedged if fail_wedged is not None \
+            else max(self.fail_degraded,
+                     _env_int("JEPSEN_TPU_HEALTH_FAIL_WEDGED", 3))
+        self.probe_timeout_s = probe_timeout_s if probe_timeout_s is not None \
+            else _env_float("JEPSEN_TPU_HEALTH_PROBE_TIMEOUT_S",
+                            PROBE_TIMEOUT_S)
+        self.probe_interval_s = probe_interval_s \
+            if probe_interval_s is not None \
+            else _env_float("JEPSEN_TPU_HEALTH_PROBE_INTERVAL_S",
+                            PROBE_INTERVAL_S)
+        self._probe = probe or (
+            lambda: probe_backend(timeout_s=self.probe_timeout_s))
+        self._lock = threading.Lock()
+        self.state = HEALTHY
+        self._since_wall = time.time()
+        self._consecutive_failures = 0
+        self._ok_total = 0
+        self._fail_total = 0
+        self._probes_run = 0
+        self._last_failure_reason: Optional[str] = None
+        self._last_transition: Optional[dict] = None
+        # The interval clock starts NOW: a fresh supervisor never
+        # active-probes until probe_interval_s has elapsed, so
+        # short-lived processes (the tier-1 suite) pay nothing.
+        self._last_probe_mono = time.monotonic()
+
+    # -- signals ----------------------------------------------------------
+
+    def note_ok(self, source: str = "passive") -> None:
+        """A backend interaction succeeded (a kernel dispatch, a probe).
+        Recovery is immediate: any success proves the backend answers."""
+        with self._lock:
+            self._ok_total += 1
+            self._consecutive_failures = 0
+            if self.state != HEALTHY:
+                self._transition(HEALTHY, f"backend interaction succeeded "
+                                          f"({source})", source)
+
+    def note_failure(self, reason: str, source: str = "passive",
+                     wedged: bool = False) -> None:
+        """A backend interaction failed. `wedged=True` (a probe timeout
+        — the hung-tunnel signature) escalates straight to wedged;
+        otherwise consecutive failures walk the thresholds."""
+        with self._lock:
+            self._fail_total += 1
+            self._consecutive_failures += 1
+            self._last_failure_reason = reason
+            if wedged:
+                if self.state != WEDGED:
+                    self._transition(WEDGED, reason, source)
+                return
+            n = self._consecutive_failures
+            if n >= self.fail_wedged and self.state != WEDGED:
+                self._transition(
+                    WEDGED, f"{n} consecutive failures "
+                            f"(>= fail_wedged={self.fail_wedged}): "
+                            f"{reason}", source)
+            elif n >= self.fail_degraded and self.state == HEALTHY:
+                self._transition(
+                    DEGRADED, f"{n} consecutive failure(s) "
+                              f"(>= fail_degraded={self.fail_degraded}): "
+                              f"{reason}", source)
+
+    def probe(self, source: str = "probe") -> bool:
+        """Run the trivial-jit probe NOW and fold the outcome in."""
+        with self._lock:
+            self._probes_run += 1
+            self._last_probe_mono = time.monotonic()
+        ok, reason, timed_out = self._probe()
+        if ok:
+            self.note_ok(source=f"{source}:probe-ok")
+        else:
+            self.note_failure(reason, source=source, wedged=timed_out)
+        return ok
+
+    def maybe_probe(self, source: str = "periodic") -> Optional[bool]:
+        """Rate-limited active probe: runs only when probe_interval_s
+        has elapsed since the last probe (or construction) and active
+        probing isn't disabled (JEPSEN_TPU_HEALTH_PROBE=0). Returns the
+        probe outcome, or None when skipped — the shape the runner
+        check phase / stream consumer call on every pass (the interval
+        check comes first, so the common skip path is one lock + one
+        clock read)."""
+        with self._lock:
+            if time.monotonic() - self._last_probe_mono \
+                    < self.probe_interval_s:
+                return None
+        if os.environ.get("JEPSEN_TPU_HEALTH_PROBE", "1").lower() \
+                in ("0", "false", "no", "off"):
+            return None
+        return self.probe(source=source)
+
+    # -- state ------------------------------------------------------------
+
+    def _transition(self, to: str, reason: str, source: str) -> None:
+        """Record a state change (caller holds the lock)."""
+        frm = self.state
+        self.state = to
+        self._since_wall = time.time()
+        self._last_transition = {
+            "from": frm, "to": to, "reason": reason, "source": source,
+            "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        # Observability of the observer: transitions land in the active
+        # capture as an event + gauge (no-ops outside a capture).
+        from . import get_metrics, get_tracer
+
+        get_tracer().event("health.transition", **self._last_transition)
+        get_metrics().gauge("health.state").set(_STATE_LEVEL[to])
+
+    def snapshot(self) -> dict:
+        """The /healthz + bench-record view: current state with
+        last-transition provenance and signal counters."""
+        with self._lock:
+            return {
+                "state": self.state,
+                "since": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime(self._since_wall)),
+                "consecutive_failures": self._consecutive_failures,
+                "ok_total": self._ok_total,
+                "fail_total": self._fail_total,
+                "probes_run": self._probes_run,
+                "last_failure": self._last_failure_reason,
+                "last_transition": dict(self._last_transition)
+                if self._last_transition else None,
+                "thresholds": {"fail_degraded": self.fail_degraded,
+                               "fail_wedged": self.fail_wedged,
+                               "probe_timeout_s": self.probe_timeout_s,
+                               "probe_interval_s": self.probe_interval_s},
+            }
+
+
+_supervisor_lock = threading.Lock()
+_supervisor: Optional[BackendSupervisor] = None
+
+
+def get_supervisor() -> BackendSupervisor:
+    """The process-wide supervisor (created on first use — env knobs
+    are read then)."""
+    global _supervisor
+    with _supervisor_lock:
+        if _supervisor is None:
+            _supervisor = BackendSupervisor()
+        return _supervisor
+
+
+def reset_supervisor(sup: Optional[BackendSupervisor] = None
+                     ) -> Optional[BackendSupervisor]:
+    """Swap (or clear) the process supervisor; returns the previous one.
+    Tests install fake-probe supervisors through this."""
+    global _supervisor
+    with _supervisor_lock:
+        prev, _supervisor = _supervisor, sup
+        return prev

@@ -4,9 +4,10 @@ The aggregate half of the telemetry subsystem (the tracer in
 obs/trace.py is the per-occurrence half): bounded-memory running
 aggregates, serialized per run as `metrics.json`. Every instrument is a
 fixed-size record — a counter is one float, a gauge tracks
-last/min/max, a histogram tracks count/sum/min/max — so instrumenting
-hot paths (per-op dispatch, per-kernel-launch) costs one lock + a few
-float ops and can never grow with workload size.
+last/min/max, a histogram tracks count/sum/min/max plus a fixed
+log-bucket sketch for quantiles — so instrumenting hot paths (per-op
+dispatch, per-kernel-launch) costs one lock + a few float ops and can
+never grow with workload size.
 
 Naming convention (dotted, lowercase): `<layer>.<what>[_<unit>]`, e.g.
 `wgl.compile_s`, `runner.ops_ok`, `encode.event_bytes`. The suffix
@@ -19,37 +20,65 @@ snapshot() schema (metrics.json is {"metrics": snapshot(), ...}):
   gauge     {"type": "gauge", "last": f|null, "min": f|null, "max": f|null,
              "n": int}
   histogram {"type": "histogram", "count": int, "sum": f, "min": f|null,
-             "max": f|null, "avg": f|null}
+             "max": f|null, "avg": f|null,
+             "p50": f|null, "p95": f|null, "p99": f|null}
+
+The quantiles come from a fixed-geometry log-bucket sketch (base 1.1,
+so ~5% relative error): observations land in bucket
+floor(log(v)/log(1.1)), clamped to a bounded index range, so the
+sketch's memory is bounded by the VALUE RANGE (a few hundred buckets at
+most), never by the observation count. p* keys are additive — every
+pre-quantile consumer of count/sum/min/max/avg keeps working.
+
+Every instrument also notes its name in the registry's dirty set on
+update; `drain_dirty()` hands the live-export bus (obs/export.py) the
+changed-since-last-drain subset without a full snapshot per tick.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import threading
 from pathlib import Path
 from typing import Optional
 
+# Log-bucket geometry for histogram quantiles: base 1.1 gives ~±4.9%
+# relative error; indices clamped so memory stays bounded for any input
+# (index 400 covers up to ~5e16, -400 down to ~2e-17).
+_LN_BASE = math.log(1.1)
+_BUCKET_LO, _BUCKET_HI = -400, 400
+QUANTILES = (0.5, 0.95, 0.99)
+
 
 class Counter:
-    __slots__ = ("_lock", "value")
+    __slots__ = ("_lock", "_dirty", "name", "value")
 
-    def __init__(self, lock: threading.Lock):
+    def __init__(self, lock: threading.Lock, name: str = "",
+                 dirty: Optional[set] = None):
         self._lock = lock
+        self._dirty = dirty
+        self.name = name
         self.value = 0.0
 
     def add(self, n: float = 1.0) -> None:
         with self._lock:
             self.value += n
+            if self._dirty is not None:
+                self._dirty.add(self.name)
 
     def snapshot(self) -> dict:
         return {"type": "counter", "value": self.value}
 
 
 class Gauge:
-    __slots__ = ("_lock", "last", "min", "max", "n")
+    __slots__ = ("_lock", "_dirty", "name", "last", "min", "max", "n")
 
-    def __init__(self, lock: threading.Lock):
+    def __init__(self, lock: threading.Lock, name: str = "",
+                 dirty: Optional[set] = None):
         self._lock = lock
+        self._dirty = dirty
+        self.name = name
         self.last: Optional[float] = None
         self.min: Optional[float] = None
         self.max: Optional[float] = None
@@ -62,6 +91,8 @@ class Gauge:
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
             self.n += 1
+            if self._dirty is not None:
+                self._dirty.add(self.name)
 
     def snapshot(self) -> dict:
         return {"type": "gauge", "last": self.last, "min": self.min,
@@ -69,14 +100,20 @@ class Gauge:
 
 
 class Histogram:
-    __slots__ = ("_lock", "count", "sum", "min", "max")
+    __slots__ = ("_lock", "_dirty", "name", "count", "sum", "min", "max",
+                 "_buckets", "_nonpos")
 
-    def __init__(self, lock: threading.Lock):
+    def __init__(self, lock: threading.Lock, name: str = "",
+                 dirty: Optional[set] = None):
         self._lock = lock
+        self._dirty = dirty
+        self.name = name
         self.count = 0
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        self._buckets: dict[int, int] = {}
+        self._nonpos = 0
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -85,11 +122,42 @@ class Histogram:
             self.sum += v
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
+            if v > 0.0:
+                i = int(math.floor(math.log(v) / _LN_BASE))
+                i = min(_BUCKET_HI, max(_BUCKET_LO, i))
+                self._buckets[i] = self._buckets.get(i, 0) + 1
+            else:
+                self._nonpos += 1
+            if self._dirty is not None:
+                self._dirty.add(self.name)
+
+    def _quantile(self, q: float) -> Optional[float]:
+        """Sketch estimate for quantile q (caller holds the lock)."""
+        if self.count == 0:
+            return None
+        target = max(1, math.ceil(q * self.count))
+        cum = self._nonpos
+        if cum >= target:
+            # The quantile falls among the <=0 observations; min is the
+            # best (and only) order statistic kept for them.
+            return self.min
+        for i in sorted(self._buckets):
+            cum += self._buckets[i]
+            if cum >= target:
+                rep = math.exp((i + 0.5) * _LN_BASE)   # geometric mid
+                rep = max(rep, self.min) if self.min is not None else rep
+                rep = min(rep, self.max) if self.max is not None else rep
+                return rep
+        return self.max
 
     def snapshot(self) -> dict:
-        return {"type": "histogram", "count": self.count,
-                "sum": self.sum, "min": self.min, "max": self.max,
-                "avg": (self.sum / self.count) if self.count else None}
+        with self._lock:
+            out = {"type": "histogram", "count": self.count,
+                   "sum": self.sum, "min": self.min, "max": self.max,
+                   "avg": (self.sum / self.count) if self.count else None}
+            for q in QUANTILES:
+                out[f"p{int(q * 100)}"] = self._quantile(q)
+        return out
 
 
 class _NullInstrument:
@@ -114,6 +182,7 @@ class MetricsRegistry:
         self.enabled = enabled
         self._lock = threading.Lock()
         self._metrics: dict[str, object] = {}
+        self._dirty: set[str] = set()
 
     def _get(self, name: str, cls):
         if not self.enabled:
@@ -121,7 +190,9 @@ class MetricsRegistry:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = self._metrics[name] = cls(self._lock)
+                m = self._metrics[name] = cls(self._lock, name=name,
+                                              dirty=self._dirty)
+                self._dirty.add(name)
             elif not isinstance(m, cls):
                 raise TypeError(
                     f"metric {name!r} already registered as "
@@ -143,6 +214,16 @@ class MetricsRegistry:
         with self._lock:
             items = list(self._metrics.items())
         return {name: m.snapshot() for name, m in sorted(items)}
+
+    def drain_dirty(self) -> dict[str, dict]:
+        """Snapshot of every instrument updated since the last drain,
+        clearing the dirty set — the live-export bus's incremental view
+        (obs/export.py publishes these as `metric` records)."""
+        with self._lock:
+            names = [n for n in self._dirty if n in self._metrics]
+            insts = [self._metrics[n] for n in names]
+            self._dirty.clear()
+        return {n: m.snapshot() for n, m in zip(names, insts)}
 
     def value(self, name: str, default: float = 0.0) -> float:
         """Scalar view for consumers that just want a number: a counter's

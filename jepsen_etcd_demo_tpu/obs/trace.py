@@ -26,6 +26,8 @@ Record schema (one JSON object per line, completion order):
    "t0_ns": n, "t1_ns": n, "status": "ok"|"error", "attrs": {...}}
   {"kind": "event", "id": n, "span": n|null, "name": str,
    "t_ns": n, "attrs": {...}}
+  {"kind": "footer", "truncated": true, "records": n, "dropped": n}
+     (only when max_records truncated the capture)
 
 t*_ns are offsets from the tracer's birth (the meta anchor), so files
 are small and diffable; span ids are unique within one tracer.
@@ -79,6 +81,14 @@ class Tracer:
             contextvars.ContextVar("jepsen_tpu_span", default=None)
         self._t0_ns = time.monotonic_ns()
         self._wall_start = datetime.now(timezone.utc).isoformat()
+        # Live-export hooks (obs/export.py / obs.capture): `listener`
+        # receives each appended record (called under the tracer lock,
+        # so subscribers observe exact append order); `drop_counter` is
+        # the pre-registered trace.dropped_records metric, incremented
+        # the moment a record is dropped so truncation surfaces live,
+        # not only in the final artifact.
+        self.listener: Optional[object] = None
+        self.drop_counter: Optional[object] = None
 
     # -- recording --------------------------------------------------------
 
@@ -89,8 +99,16 @@ class Tracer:
         with self._lock:
             if len(self._records) >= self.max_records:
                 self._dropped += 1
-                return
-            self._records.append(rec)
+                drop = self.drop_counter
+                lst = None
+            else:
+                self._records.append(rec)
+                drop = None
+                lst = self.listener
+            if drop is not None:
+                drop.add(1)
+            if lst is not None:
+                lst(rec)
 
     @contextmanager
     def span(self, name: str, **attrs: Any) -> Iterator[SpanHandle]:
@@ -150,6 +168,14 @@ class Tracer:
                 "dropped": dropped}
         lines = [json.dumps(meta)]
         lines.extend(json.dumps(r, default=str) for r in recs)
+        if dropped:
+            # Truncation footer: a tail reader (or a consumer that never
+            # parses the meta line) still learns the file is INCOMPLETE
+            # — the telemetry page renders this as a warning banner
+            # instead of presenting a truncated span tree as complete.
+            lines.append(json.dumps({"kind": "footer", "truncated": True,
+                                     "records": len(recs),
+                                     "dropped": dropped}))
         return "\n".join(lines) + "\n"
 
     def write(self, path: str | Path) -> None:

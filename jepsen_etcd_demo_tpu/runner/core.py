@@ -113,6 +113,9 @@ async def _worker(test: dict, gen: Gen, state: _RunState,
             if not is_nemesis:
                 # Counters + latency histogram, not per-op spans: client
                 # ops are the hot path (rate * concurrency per second).
+                # jtlint: disable=JTL107 -- bounded family: completion
+                # .type is the closed jepsen op-type set {ok, fail,
+                # info} (ops/op.py), three names total.
                 metrics.counter(f"runner.ops_{completion.type}").add(1)
                 metrics.histogram("runner.op_latency_s").observe(
                     time.monotonic() - t_op)
@@ -390,6 +393,13 @@ async def _run_test_inner(test: dict, store) -> dict:
     from ..sched import enable_persistent_cache
 
     enable_persistent_cache(test.get("store_root"))
+    # Backend health (obs/health.py): the check phase periodically
+    # drives the supervisor's active probe (rate-limited — a fresh
+    # process never pays the subprocess inside its first interval), and
+    # a completed check is a passive health proof. The supervisor's
+    # state feeds /healthz and the bench record.
+    supervisor = obs.health.get_supervisor()
+    supervisor.maybe_probe(source="runner.check")
     with tracer.span("check") as sp, \
             obs.maybe_jax_trace(store.path if store else None):
         if session is not None:
@@ -405,6 +415,8 @@ async def _run_test_inner(test: dict, store) -> dict:
                   if checker is not None else {"valid": True})
         sp.set(valid=str(result.get("valid")),
                profile=obs.active_profile_hash())
+        if checker is not None:
+            supervisor.note_ok(source="runner.check")
     result.setdefault("op_count",
                       sum(1 for o in history if o.type == INVOKE))
     result["run_seconds"] = run_s

@@ -183,6 +183,12 @@ def check_corpus(encs: Sequence, model=None, f_cap: int = 256
 
     with obs.get_tracer().span("sched.check_corpus",
                                histories=len(encs)) as sp:
+        # Backend health (obs/health.py): corpus dispatch is one of the
+        # supervisor's periodic drivers — rate-limited active probe on
+        # entry (a no-op inside the probe interval), passive ok/failure
+        # notes from the launch drain below.
+        supervisor = obs.health.get_supervisor()
+        supervisor.maybe_probe(source="sched.dispatch")
         results: list[Any] = [None] * len(encs)
         kernels: set[str] = set()
 
@@ -258,10 +264,21 @@ def check_corpus(encs: Sequence, model=None, f_cap: int = 256
                         sum(s.n_steps for s in part_steps), b, r)
                     kernels.add(name)
             for part, part_steps, dev in pending:
-                out = wgl3.unpack_np(np.asarray(dev)[:len(part)])
+                try:
+                    fetched = np.asarray(dev)
+                except Exception as e:
+                    # The drain fetch is where a dead backend finally
+                    # surfaces for async launches — tell the supervisor
+                    # before propagating.
+                    supervisor.note_failure(f"{type(e).__name__}: {e}",
+                                            source="sched.dispatch")
+                    raise
+                out = wgl3.unpack_np(fetched[:len(part)])
                 for i, one in zip(part, wgl3.assemble_batch_results(
                         out, part_steps, cfg)):
                     results[i] = one
+            if pending:
+                supervisor.note_ok(source="sched.dispatch")
 
         if general_idx:
             _check_general(encs, general_idx, model, results, kernels,

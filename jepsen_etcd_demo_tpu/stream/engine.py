@@ -268,6 +268,10 @@ class KeyStream:
                 jnp.asarray(tgt), jnp.int32(self.real_dispatched))
         self.dispatch_s += time.monotonic() - t0
         self.last_flush = t0
+        # A successful chunk dispatch is a free backend-health proof
+        # (obs/health.py): the consumer thread is one of the supervisor's
+        # passive signal sources.
+        obs.health.get_supervisor().note_ok(source="stream.dispatch")
         self.parts = part if self.parts is None else self.parts + part
         self.steps_done += chunk
         self.real_dispatched += real
@@ -437,9 +441,20 @@ class StreamSession:
                 self._broken = f"{type(e).__name__}: {e}"
                 log.exception("streaming check crashed; falling back "
                               "to post-hoc")
+                # An unexplained dispatch-path crash is a backend health
+                # signal (a wedged tunnel surfaces as arbitrary jax
+                # errors here); the supervisor decides whether it
+                # accumulates to degraded/wedged.
+                obs.health.get_supervisor().note_failure(
+                    self._broken, source="stream.consumer")
             finally:
                 self._encode_s += time.monotonic() - t0
                 self._fed += 1
+                # Rate-limited active probe from the consumer thread —
+                # the long-running-daemon hook (no-op inside the first
+                # probe interval, so short runs never pay it).
+                obs.health.get_supervisor().maybe_probe(
+                    source="stream.consumer")
         # not reached
 
     def _feed_one(self, op: Op, live: bool) -> None:

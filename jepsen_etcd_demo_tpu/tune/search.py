@@ -163,7 +163,11 @@ def _search_knob(probe, knob: str, chosen: dict[str, int],
         winner = default       # noise guard: the default keeps ties
     record["chosen"] = winner
     met = obs.get_metrics()
+    # jtlint: disable=JTL107 -- bounded family: knob iterates the fixed
+    # tunable-field set of ops/limits.py field_meta(); exported as one
+    # labeled Prometheus family (obs/export.py LABELED_FAMILIES).
     met.gauge(f"tune.probe_s.{knob}").set(record["seconds"])
+    # jtlint: disable=JTL107 -- bounded family: same knob set as above.
     met.gauge(f"tune.chosen.{knob}").set(winner)
     met.counter("tune.measurements").add(measured)
     return record

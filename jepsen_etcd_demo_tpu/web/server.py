@@ -5,16 +5,34 @@ The reference serves its store with ring/jetty + a directory browser
 on http.server: an index of runs with verdicts, static file serving of
 each run dir (charts, timelines, logs, history), and a per-run telemetry
 page (/telemetry/<run>) rendering the span tree + metric table the
-harness records in telemetry.jsonl / metrics.json (obs/)."""
+harness records in telemetry.jsonl / metrics.json (obs/).
+
+Live observability plane (ISSUE 8) — three process-level endpoints on
+top of the per-run artifacts:
+
+  /metrics     Prometheus text exposition of the ACTIVE capture's
+               registry (obs/export.py) + backend health series
+  /healthz     the backend supervisor's state as JSON (obs/health.py);
+               HTTP 503 when wedged so load balancers see it
+  /live        an in-flight-run page fed by Server-Sent Events from
+               /live/events (the obs subscription bus): span tree, op
+               throughput, nemesis events, stream gauges, health
+
+These observe the SERVING PROCESS — they show a run in flight when the
+server shares the process with the runner (`jepsen-tpu test
+--live-port N`, or the future checking-as-a-service daemon)."""
 
 from __future__ import annotations
 
 import html
+import json
 import urllib.parse
 from functools import partial
 from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
 
-from ..obs import METRICS_FILE, TELEMETRY_FILE, read_jsonl, read_metrics
+from .. import obs
+from ..obs import (METRICS_FILE, TELEMETRY_FILE, export, health,
+                   read_jsonl, read_metrics)
 from ..store import Store
 
 
@@ -143,6 +161,8 @@ def _index_html(store: Store) -> str:
         "<title>jepsen-tpu store</title>"
         "<style>body{font-family:sans-serif}td{padding:4px 12px}</style>"
         "</head><body><h2>test runs</h2>"
+        "<p><a href='/live'>live</a> · <a href='/metrics'>metrics</a> · "
+        "<a href='/healthz'>healthz</a></p>"
         f"<table><tr><th>run</th><th>valid</th><th>detail</th>"
         f"<th>check eps</th><th>pad waste</th>"
         f"<th>sweep</th><th>live tiles</th>"
@@ -261,6 +281,61 @@ def _span_tree_html(records: list[dict]) -> str:
     return f"<ul class='tree'>{render(None)}</ul>"
 
 
+def _kernel_attribution_html(metrics: dict) -> str:
+    """Per-kernel deep-attribution table (ISSUE 8): every kernel
+    geometry the run compiled, with its compile/execute wall (the
+    wgl.compile_s.<k>/wgl.execute_s.<k> histograms) and the XLA
+    cost_analysis estimates captured at lower time
+    (wgl.kernel_flops/kernel_bytes gauges). Empty string when the run
+    recorded no per-kernel series (pre-ISSUE-8 artifacts)."""
+    kernels: dict[str, dict] = {}
+
+    def fold(prefix: str, field: str, value_of):
+        for name, rec in metrics.items():
+            if name.startswith(prefix + "."):
+                kernels.setdefault(name[len(prefix) + 1:], {})[field] = \
+                    value_of(rec)
+
+    fold("wgl.compile_s", "compiles", lambda r: r.get("count", 0))
+    fold("wgl.compile_s", "compile_s", lambda r: r.get("sum", 0.0))
+    fold("wgl.execute_s", "calls", lambda r: r.get("count", 0))
+    fold("wgl.execute_s", "execute_s", lambda r: r.get("sum", 0.0))
+    fold("wgl.execute_s", "p95_s", lambda r: r.get("p95"))
+    fold("wgl.kernel_flops", "flops", lambda r: r.get("last"))
+    fold("wgl.kernel_bytes", "bytes", lambda r: r.get("last"))
+    if not kernels:
+        return ""
+
+    def num(v, unit="") -> str:
+        if not isinstance(v, (int, float)):
+            return ""
+        if v >= 1e9:
+            return f"{v / 1e9:,.2f}G{unit}"
+        if v >= 1e6:
+            return f"{v / 1e6:,.2f}M{unit}"
+        if v >= 1e3:
+            return f"{v / 1e3:,.2f}k{unit}"
+        return f"{v:,.4g}{unit}"
+
+    rows = []
+    for k in sorted(kernels):
+        r = kernels[k]
+        rows.append(
+            f"<tr><td><code>{html.escape(k)}</code></td>"
+            f"<td>{r.get('compiles', 0)}</td>"
+            f"<td>{r.get('compile_s', 0.0):.3f}</td>"
+            f"<td>{r.get('calls', 0)}</td>"
+            f"<td>{r.get('execute_s', 0.0):.3f}</td>"
+            f"<td>{num(r.get('p95_s'), 's')}</td>"
+            f"<td>{num(r.get('flops'))}</td>"
+            f"<td>{num(r.get('bytes'), 'B')}</td></tr>")
+    return ("<h3>kernel attribution</h3>"
+            "<table><tr><th>kernel</th><th>compiles</th>"
+            "<th>compile s</th><th>calls</th><th>execute s</th>"
+            "<th>p95 call</th><th>flops/call</th><th>bytes/call</th></tr>"
+            f"{''.join(rows)}</table>")
+
+
 def _metrics_table_html(metrics: dict) -> str:
     rows = []
     for name, rec in sorted(metrics.items()):
@@ -274,6 +349,9 @@ def _metrics_table_html(metrics: dict) -> str:
             val = (f"n {rec.get('count', 0)}, sum {rec.get('sum', 0):.6g}, "
                    f"min {rec.get('min')}, max {rec.get('max')}, "
                    f"avg {round(rec['avg'], 6) if rec.get('avg') is not None else None}")
+            if rec.get("p50") is not None:
+                val += (f", p50 {rec['p50']:.4g} / p95 "
+                        f"{rec.get('p95'):.4g} / p99 {rec.get('p99'):.4g}")
         rows.append(f"<tr><td><code>{html.escape(name)}</code></td>"
                     f"<td>{kind}</td><td>{html.escape(val)}</td></tr>")
     return (f"<table><tr><th>metric</th><th>type</th><th>value</th></tr>"
@@ -299,7 +377,9 @@ def _telemetry_html(store: Store, rel: str) -> str | None:
         "ul.tree,ul.tree ul{list-style:none;border-left:1px solid #ccc;"
         "padding-left:1.2em;margin:2px 0}"
         ".t{color:#2a6db0}.a{color:#888;font-size:90%}"
-        ".err{color:#d43a2a}.ev{color:#555}</style></head><body>",
+        ".err{color:#d43a2a}.ev{color:#555}"
+        ".warn{color:#b05a00;background:#fff3e0;border:1px solid #e9a820;"
+        "padding:8px;font-weight:bold}</style></head><body>",
         f"<h2>telemetry — {html.escape(rel)}</h2>",
         f"<p><a href='/'>index</a> · "
         f"<a href='{urllib.parse.quote(f'/files/{rel}/')}'>run files</a></p>",
@@ -308,18 +388,28 @@ def _telemetry_html(store: Store, rel: str) -> str | None:
     if tele.exists():
         records = read_jsonl(tele)
         meta = next((r for r in records if r.get("kind") == "meta"), {})
+        footer = next((r for r in records if r.get("kind") == "footer"), {})
         n_spans = sum(1 for r in records if r.get("kind") == "span")
         n_events = sum(1 for r in records if r.get("kind") == "event")
+        dropped = int(meta.get("dropped") or footer.get("dropped") or 0)
+        if dropped:
+            # Truncation is a first-class warning, not a footnote: a
+            # truncated span tree must never read as a complete one.
+            parts.append(
+                f"<p class='warn'>&#9888; telemetry TRUNCATED: {dropped} "
+                f"record(s) dropped after the tracer's max_records cap "
+                f"— the span tree below is incomplete</p>")
         parts.append(
             f"<h3>span tree</h3><p class='a'>{n_spans} spans, "
             f"{n_events} events; started {html.escape(str(meta.get('wall_start', '?')))}"
-            f"{'; DROPPED ' + str(meta['dropped']) + ' records' if meta.get('dropped') else ''}"
             f"</p>")
         parts.append(_span_tree_html(records))
     if metr.exists():
         try:
+            metrics = read_metrics(metr)
+            parts.append(_kernel_attribution_html(metrics))
             parts.append("<h3>metrics</h3>")
-            parts.append(_metrics_table_html(read_metrics(metr)))
+            parts.append(_metrics_table_html(metrics))
         except Exception as e:   # a torn metrics.json must not 500 the page
             parts.append(f"<p class='err'>metrics.json unreadable: "
                          f"{html.escape(str(e))}</p>")
@@ -327,25 +417,232 @@ def _telemetry_html(store: Store, rel: str) -> str | None:
     return "".join(parts)
 
 
+# -- live observability plane (ISSUE 8) ------------------------------------
+
+def _metrics_text() -> str:
+    """The /metrics payload: the active capture's registry as
+    Prometheus text (empty registry outside a run), plus the process
+    series — up and the backend supervisor's state (both as a level
+    gauge and a labeled info series)."""
+    reg = obs.get_metrics()
+    snap = reg.snapshot() if getattr(reg, "enabled", False) else {}
+    # The supervisor IS the authority on health: drop the capture's
+    # pre-registered health.state gauge so the exposition carries
+    # exactly one jepsen_tpu_health_state family (a duplicate TYPE line
+    # would make the whole scrape invalid).
+    snap.pop("health.state", None)
+    hs = health.get_supervisor().snapshot()
+    level = health.STATE_LEVEL.get(hs["state"], -1)
+    extra = [
+        "# TYPE jepsen_tpu_up gauge",
+        "jepsen_tpu_up 1",
+        "# TYPE jepsen_tpu_health_state gauge",
+        f"jepsen_tpu_health_state {level}",
+        "# TYPE jepsen_tpu_health_info gauge",
+        f'jepsen_tpu_health_info{{state='
+        f'"{export.sanitize_label_value(hs["state"])}"}} 1',
+        "# TYPE jepsen_tpu_run_in_flight gauge",
+        f"jepsen_tpu_run_in_flight {int(obs.capture_active())}",
+    ]
+    return export.render_prometheus(snap, extra_lines=extra)
+
+
+def _healthz() -> tuple[int, dict]:
+    """(status code, body) for /healthz: the supervisor snapshot with
+    last-transition provenance. 503 when wedged — a load balancer (or
+    the future daemon's failover watcher) can act on the code alone."""
+    hs = health.get_supervisor().snapshot()
+    body = {"status": hs["state"], **hs,
+            "run_in_flight": obs.capture_active(),
+            "telemetry_enabled": obs.telemetry_enabled()}
+    return (503 if hs["state"] == health.WEDGED else 200), body
+
+
+_LIVE_PAGE = """<!doctype html><html><head><meta charset='utf-8'>
+<title>jepsen-tpu live</title>
+<style>body{font-family:sans-serif;margin:2em}
+#health{padding:8px;font-weight:bold;display:inline-block}
+.healthy{background:#e2f5e5;color:#2a9d43}
+.degraded{background:#fff3e0;color:#b05a00}
+.wedged{background:#fde3e0;color:#d43a2a}
+table{border-collapse:collapse}td,th{padding:2px 10px;
+border-bottom:1px solid #eee;text-align:left}
+ul.tree,ul.tree ul{list-style:none;border-left:1px solid #ccc;
+padding-left:1.2em;margin:2px 0}
+.t{color:#2a6db0}.a{color:#888;font-size:90%}.ev{color:#555}
+#idle{color:#888}</style></head><body>
+<h2>live run <span id='health'>connecting&hellip;</span></h2>
+<p><a href='/'>index</a> &middot; <a href='/metrics'>metrics</a>
+&middot; <a href='/healthz'>healthz</a></p>
+<p id='idle' hidden>no run in flight in the serving process &mdash;
+start one with <code>jepsen-tpu test &hellip; --live-port</code></p>
+<table id='stats'><tr>
+<th>ops ok</th><th>ops/s</th><th>ops fail</th><th>stream overlap</th>
+<th>watermark lag</th><th>frontier peak</th></tr><tr>
+<td id='ok'>0</td><td id='rate'>&ndash;</td><td id='fail'>0</td>
+<td id='overlap'>&ndash;</td><td id='lag'>&ndash;</td>
+<td id='frontier'>&ndash;</td></tr></table>
+<h3>nemesis / events</h3><ul id='events'></ul>
+<h3>span tree</h3><ul class='tree' id='spans'></ul>
+<script>
+const spans = {}, waiting = {}, seenIds = new Set();
+let okPrev = null, okPrevT = null;
+function el(id){return document.getElementById(id);}
+function met(name, m){
+  if (name === 'runner.ops_ok'){
+    const now = Date.now();
+    if (okPrev !== null && now > okPrevT)
+      el('rate').textContent = ((m.value - okPrev) * 1000 /
+                                (now - okPrevT)).toFixed(1);
+    okPrev = m.value; okPrevT = now;
+    el('ok').textContent = m.value;
+  } else if (name === 'runner.ops_fail') el('fail').textContent = m.value;
+  else if (name === 'stream.overlap_ratio' && m.last !== null)
+    el('overlap').textContent = (100 * m.last).toFixed(0) + '%';
+  else if (name === 'stream.watermark_lag' && m.last !== null)
+    el('lag').textContent = m.last;
+  else if (name === 'wgl.frontier_peak' && m.max !== null)
+    el('frontier').textContent = m.max;
+  else if (name === 'health.state') setHealth(m.last);
+}
+function setHealth(v){
+  const s = typeof v === 'string' ? v
+          : ['healthy', 'degraded', 'wedged'][v] || '?';
+  const h = el('health'); h.textContent = s; h.className = s;
+}
+function addSpan(r){
+  const li = document.createElement('li');
+  const ms = ((r.t1_ns - r.t0_ns) / 1e6).toFixed(1);
+  li.innerHTML = '<b></b> <span class=t>' + ms + ' ms</span>';
+  li.querySelector('b').textContent = r.name;
+  const ul = document.createElement('ul'); li.appendChild(ul);
+  spans[r.id] = ul;
+  // Spans stream in COMPLETION order, so children precede their
+  // parent: adopt any that already rendered at the root (appendChild
+  // moves them), and if our own parent is still open, render at the
+  // root now and wait to be adopted ourselves.
+  for (const c of waiting[r.id] || []) ul.appendChild(c);
+  delete waiting[r.id];
+  if (r.parent === null || spans[r.parent]) {
+    (spans[r.parent] || el('spans')).appendChild(li);
+  } else {
+    el('spans').appendChild(li);
+    (waiting[r.parent] = waiting[r.parent] || []).push(li);
+  }
+}
+function addRecord(r){
+  if (r.id !== undefined){
+    if (seenIds.has(r.id)) return;  // init tail / live queue overlap
+    seenIds.add(r.id);
+  }
+  r.kind === 'span' ? addSpan(r) : addEvent(r);
+}
+function addEvent(r){
+  const li = document.createElement('li');
+  li.className = 'ev';
+  li.textContent = '⚡ ' + r.name + ' ' + JSON.stringify(r.attrs);
+  el('events').appendChild(li);
+  if (el('events').children.length > 50)
+    el('events').removeChild(el('events').firstChild);
+}
+const es = new EventSource('/live/events');
+es.addEventListener('init', e => {
+  const d = JSON.parse(e.data);
+  setHealth(d.health.state);
+  el('idle').hidden = d.run_in_flight;
+  for (const [n, m] of Object.entries(d.metrics)) met(n, m);
+  for (const r of d.records) addRecord(r);
+});
+es.addEventListener('span', e => addRecord(JSON.parse(e.data)));
+es.addEventListener('event', e => addRecord(JSON.parse(e.data)));
+es.addEventListener('metric', e => {
+  const d = JSON.parse(e.data); met(d.name, d.metric);
+});
+</script></body></html>"""
+
+
 class StoreHandler(SimpleHTTPRequestHandler):
     """/ -> run index; /telemetry/<run> -> span tree + metric table;
-    /files/... -> static serving rooted at the store."""
+    /metrics, /healthz, /live, /live/events -> the serving process's
+    live observability plane; /files/... -> static serving rooted at
+    the store."""
 
     def __init__(self, *args, store_root: str = "store", **kw):
         self.store = Store(store_root)
         super().__init__(*args, directory=str(store_root), **kw)
 
     def _send_html(self, body: str, status: int = 200) -> None:
-        payload = body.encode()
+        self._send_payload(body.encode(), "text/html; charset=utf-8",
+                           status)
+
+    def _send_payload(self, payload: bytes, ctype: str,
+                      status: int = 200) -> None:
         self.send_response(status)
-        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)
 
+    def _serve_sse(self) -> None:
+        """/live/events: subscribe to the obs bus and stream records as
+        Server-Sent Events until the client disconnects. Opens with an
+        `init` event (current metrics snapshot, health, the tracer's
+        buffered records so the span tree starts populated); then spans/
+        events arrive in append order and coalesced `metric` records a
+        few times per second (the bus's pump). A 1 s heartbeat detects
+        dead clients promptly."""
+        sub = obs.subscribe()
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            reg = obs.get_metrics()
+            tracer = obs.get_tracer()
+            init = {
+                "run_in_flight": obs.capture_active(),
+                "health": health.get_supervisor().snapshot(),
+                "metrics": reg.snapshot()
+                if getattr(reg, "enabled", False) else {},
+                # The most recent already-recorded trace tail — enough
+                # to seed the page without replaying a whole long run.
+                "records": tracer.records()[-500:]
+                if tracer.enabled else [],
+            }
+            self.wfile.write(export.sse_message(init, event="init"))
+            self.wfile.flush()
+            while True:
+                rec = sub.get(timeout=1.0)
+                if rec is None:
+                    self.wfile.write(b": ping\n\n")
+                else:
+                    self.wfile.write(
+                        export.sse_message(rec, event=rec.get("kind")))
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass   # client went away — the normal way an SSE stream ends
+        finally:
+            sub.close()
+
     def do_GET(self):
         if self.path in ("/", "/index.html"):
             self._send_html(_index_html(self.store))
+            return
+        if self.path.rstrip("/") == "/metrics":
+            self._send_payload(_metrics_text().encode(),
+                               export.PROM_CONTENT_TYPE)
+            return
+        if self.path.rstrip("/") == "/healthz":
+            status, body = _healthz()
+            self._send_payload(
+                (json.dumps(body, indent=2) + "\n").encode(),
+                "application/json; charset=utf-8", status)
+            return
+        if self.path.rstrip("/") == "/live":
+            self._send_html(_LIVE_PAGE)
+            return
+        if self.path.rstrip("/") == "/live/events":
+            self._serve_sse()
             return
         if self.path.startswith("/telemetry/"):
             rel = urllib.parse.unquote(
@@ -376,7 +673,8 @@ def make_handler(store_root: str):
 def serve(store_root: str = "store", host: str = "127.0.0.1",
           port: int = 8080):
     httpd = ThreadingHTTPServer((host, port), make_handler(store_root))
-    print(f"serving {store_root} on http://{host}:{port}")
+    print(f"serving {store_root} on http://{host}:{port} "
+          f"(/live, /metrics, /healthz)")
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:

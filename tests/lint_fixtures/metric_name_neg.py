@@ -1,0 +1,13 @@
+"""JTL107 negative fixture: literal names + the justified-bounded shape."""
+
+
+def emit(metrics, kernel_name):
+    metrics.counter("runner.ops_ok").add(1)
+    metrics.gauge("stream.overlap_ratio").set(0.5)
+    metrics.histogram("runner.op_latency_s").observe(0.01)
+    # jtlint: disable=JTL107 -- bounded family: kernel names are the
+    # fixed static set of instrument_kernel call sites; exported as one
+    # labeled Prometheus family (obs/export.py LABELED_FAMILIES).
+    metrics.histogram(f"wgl.compile_s.{kernel_name}").observe(0.5)
+    # A non-metric method with a computed arg is out of scope.
+    metrics.lookup(f"whatever.{kernel_name}")

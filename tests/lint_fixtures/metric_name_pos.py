@@ -1,0 +1,7 @@
+"""JTL107 positive fixture: metric names built at the call site."""
+
+
+def emit(metrics, kind, knob, idx):
+    metrics.counter(f"runner.ops_{kind}").add(1)
+    metrics.gauge("tune.chosen." + knob).set(1.0)
+    metrics.histogram("wgl.exec_{}".format(idx)).observe(0.5)

@@ -15,11 +15,18 @@ import bench_compare  # noqa: E402
 
 
 def _record(eps: float, sched_eps: float = 5000.0,
-            stream_speedup: float = 1.4) -> dict:
+            stream_speedup: float = 1.4, flops: float = 2.0e9,
+            health: str = "healthy") -> dict:
     return {
         "metric": "wgl_check_throughput", "value": eps,
         "unit": "history-events/sec", "vs_baseline": 12.0,
         "cache_hit_rate": 1.0,
+        "kernel_phases": {"compile_s": 1.0, "execute_s": 2.0,
+                          "encode_s": 0.5, "frontier_peak": 64,
+                          "flops": flops, "bytes": 4.0e8,
+                          "device_mem_peak": 0,
+                          "profile_hash": "default"},
+        "health": {"state": health, "last_transition": None},
         "degraded": False, "backend": "cpu",
         "detail": {
             "corpus_sched": {"events_per_sec": sched_eps},
@@ -132,6 +139,59 @@ def test_long_history_lane_dropped_also_fails(tmp_path):
     new["detail"]["long_history"] = [{"ops": 1000, "kernel_s": 0.5}]
     res = bench_compare.compare(old, new)
     assert res["missing"] == ["long_10000_eps"]
+
+
+def test_flops_bytes_lanes_are_informational_only():
+    """ISSUE 8 satellite: the kernel_phases deep-attribution fields
+    compare as INFORMATIONAL lanes — deltas reported, never gated. A
+    50% flops drop alone must exit 0."""
+    res = bench_compare.compare(_record(1000.0),
+                                _record(1000.0, flops=1.0e9),
+                                threshold_pct=10.0)
+    assert res["comparable"] is True and res["regressions"] == []
+    by_lane = {r["lane"]: r for r in res["lanes"]}
+    assert by_lane["kernel_flops"]["informational"] is True
+    assert by_lane["kernel_flops"]["delta_pct"] == -50.0
+    assert by_lane["kernel_flops"]["regression"] is False
+    assert by_lane["kernel_bytes"]["delta_pct"] == 0.0
+    # device_mem_peak is 0 on CPU records: skipped, not divided by.
+    assert by_lane["device_mem_peak"].get("skipped") is True
+
+
+def test_flops_absent_in_old_record_skips_silently():
+    """Pre-ISSUE-8 records have no flops field — the informational lane
+    skips without joining `missing` (it is not a measured perf lane)."""
+    old = _record(1000.0)
+    del old["kernel_phases"]
+    res = bench_compare.compare(old, _record(1000.0))
+    by_lane = {r["lane"]: r for r in res["lanes"]}
+    assert by_lane["kernel_flops"].get("skipped") is True
+    assert "kernel_flops" not in res["missing"]
+
+
+def test_health_state_difference_not_comparable(tmp_path, capsys):
+    """ISSUE 8 satellite: records taken under different supervisor
+    states (healthy vs degraded) measure different machines — reported
+    not-comparable with BOTH states named, exit 0 (the degraded-record
+    contract)."""
+    res = bench_compare.compare(_record(1000.0),
+                                _record(400.0, health="degraded"))
+    assert res["comparable"] is False
+    assert "healthy" in res["reason"] and "degraded" in res["reason"]
+    po, pn = tmp_path / "o.json", tmp_path / "n.json"
+    po.write_text(json.dumps(_record(1000.0)))
+    pn.write_text(json.dumps(_record(400.0, health="degraded")))
+    assert bench_compare.main([str(po), str(pn)]) == 0
+    assert "not comparable" in capsys.readouterr().out
+
+
+def test_health_absent_in_one_record_still_compares():
+    """A pre-ISSUE-8 record without the health stamp compares exactly
+    as before — the gate needs BOTH states to disagree."""
+    old = _record(1000.0)
+    del old["health"]
+    res = bench_compare.compare(old, _record(950.0, health="healthy"))
+    assert res["comparable"] is True
 
 
 def test_degraded_record_not_comparable():

@@ -36,7 +36,7 @@ def test_sched_corpus_lane_contract():
     assert lane["cache_hit_rate"] == 1.0
     assert set(lane["kernel_phases"]) == {
         "compile_s", "execute_s", "encode_s", "frontier_peak",
-        "profile_hash"}
+        "flops", "bytes", "device_mem_peak", "profile_hash"}
 
 
 def test_bench_error_path_reports_degraded_contract_fields(monkeypatch,
@@ -46,6 +46,9 @@ def test_bench_error_path_reports_degraded_contract_fields(monkeypatch,
     record — every contract field present as zeros, degraded true,
     backend "none", and the probe diagnosis in error/detail — instead of
     rc 1 with a bare value-0 line."""
+    from jepsen_etcd_demo_tpu.obs import health
+
+    health.reset_supervisor()   # fresh state machine for this process
     monkeypatch.setattr(bench, "_backend_alive",
                         lambda *a, **k: (False, "probe stubbed"))
     assert bench.main() == 0
@@ -54,7 +57,8 @@ def test_bench_error_path_reports_degraded_contract_fields(monkeypatch,
     phases = dict(out["kernel_phases"])
     profile_hash = phases.pop("profile_hash")
     assert phases == {"compile_s": 0.0, "execute_s": 0.0,
-                      "encode_s": 0.0, "frontier_peak": 0}
+                      "encode_s": 0.0, "frontier_peak": 0,
+                      "flops": 0.0, "bytes": 0.0, "device_mem_peak": 0}
     assert out["padding_waste"] == 0.0
     assert out["cache_hit_rate"] == 0.0
     assert out["sweep"]["live_tile_ratio"] == 0.0
@@ -69,6 +73,12 @@ def test_bench_error_path_reports_degraded_contract_fields(monkeypatch,
     assert out["backend"] == "none"
     assert "probe stubbed" in out["error"]
     assert out["detail"]["probe"]["default"] == "probe stubbed"
+    # ISSUE 8: the record carries the backend supervisor's state — one
+    # fast-crash probe failure is `degraded` (fail_degraded=1), with
+    # the transition's provenance naming the bench probe.
+    assert out["health"]["state"] == "degraded"
+    assert out["health"]["last_transition"]["source"] == "bench.probe"
+    assert "probe stubbed" in out["health"]["last_transition"]["reason"]
 
 
 def test_tuned_lane_contract(tmp_path, monkeypatch):
@@ -132,6 +142,9 @@ def test_bench_jit_timeout_probe_routes_through_degraded_record(
     probe failure — full contract record, backend "none", the timeout
     diagnosis in error AND detail.probe — never rc 1 with a bare
     value-0 line."""
+    from jepsen_etcd_demo_tpu.obs import health
+
+    health.reset_supervisor()
     timeout_reason = ("trivial jit round trip exceeded 240s — remote "
                       "TPU tunnel down/wedged?")
     monkeypatch.setattr(bench, "_backend_alive",
@@ -142,6 +155,10 @@ def test_bench_jit_timeout_probe_routes_through_degraded_record(
     assert out["backend"] == "none"
     assert "exceeded 240s" in out["error"]
     assert out["detail"]["probe"]["default"] == timeout_reason
+    # ISSUE 8: a probe TIMEOUT is the wedged-tunnel signature — the
+    # supervisor escalates straight to `wedged` and the record says so.
+    assert out["health"]["state"] == "wedged"
+    assert out["health"]["last_transition"]["to"] == "wedged"
     for key in ("kernel_phases", "padding_waste", "cache_hit_rate",
                 "sweep", "profile"):
         assert key in out, key

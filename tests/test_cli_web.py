@@ -140,6 +140,50 @@ class TestExitContract:
         assert log1.count("=== valid:") == 1
         assert log2.count("=== valid:") == 1
 
+    def test_live_port_serves_plane_during_run(self, tmp_path):
+        """ISSUE 8 acceptance: with a run in flight under --live-port,
+        the SAME process serves /metrics (Prometheus text with
+        jepsen_tpu_* series and run_in_flight 1) and /healthz — and the
+        server is gone once the run ends."""
+        import socket
+        import time as time_mod
+        import urllib.error
+        import urllib.request
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        seen = {}
+
+        def poll():
+            deadline = time_mod.monotonic() + 20
+            while time_mod.monotonic() < deadline and "metrics" not in seen:
+                try:
+                    body = urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=2).read().decode()
+                    if "jepsen_tpu_run_in_flight 1" in body:
+                        seen["metrics"] = body
+                        seen["healthz"] = json.load(urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/healthz", timeout=2))
+                except (urllib.error.URLError, OSError, ValueError):
+                    pass
+                time_mod.sleep(0.05)
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        rc = _run_cli(tmp_path, "--live-port", str(port), time_limit="2.5")
+        poller.join(timeout=25)
+        assert rc == 0
+        assert "metrics" in seen, "live plane never answered mid-run"
+        assert "jepsen_tpu_up 1" in seen["metrics"]
+        assert "jepsen_tpu_runner_ops_ok" in seen["metrics"]
+        assert seen["healthz"]["run_in_flight"] is True
+        # Shut down with the test loop: the port no longer answers.
+        with pytest.raises((urllib.error.URLError, OSError)):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                                   timeout=2)
+
 
 class TestAnalyze:
     def test_analyze_roundtrip_agrees(self, tmp_path, capsys):

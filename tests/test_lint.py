@@ -40,6 +40,7 @@ GOLDEN = [
     ("JTL104", "traced_branch_pos.py", [7, 9], "traced_branch_neg.py"),
     ("JTL105", "instrument_pos.py", [9, 14, 21, 32], "instrument_neg.py"),
     ("JTL106", "env_limits_pos.py", [5, 6, 7], "env_limits_neg.py"),
+    ("JTL107", "metric_name_pos.py", [5, 6, 7], "metric_name_neg.py"),
     ("JTL201", "lock_order_pos.py", [14, 29], "lock_order_neg.py"),
     ("JTL202", "event_loop_advice_r5.py", [25, 33], "event_loop_neg.py"),
     ("JTL203", "shared_state_pos.py", [17], "shared_state_neg.py"),

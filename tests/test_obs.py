@@ -102,8 +102,17 @@ class TestMetrics:
         assert snap["c"] == {"type": "counter", "value": 3.5}
         assert snap["g"] == {"type": "gauge", "last": 7.0, "min": -1.0,
                              "max": 7.0, "n": 3}
-        assert snap["h"] == {"type": "histogram", "count": 2, "sum": 4.0,
-                             "min": 1.0, "max": 3.0, "avg": 2.0}
+        # The pre-quantile consumer view is unchanged (ISSUE 8 keeps
+        # snapshot() backward-compatible)...
+        h = snap["h"]
+        assert {k: h[k] for k in ("type", "count", "sum", "min", "max",
+                                  "avg")} \
+            == {"type": "histogram", "count": 2, "sum": 4.0,
+                "min": 1.0, "max": 3.0, "avg": 2.0}
+        # ...and the log-bucket sketch adds quantile estimates (~5%
+        # relative error, clamped into [min, max]).
+        assert 1.0 <= h["p50"] <= 1.1
+        assert 2.85 <= h["p95"] <= 3.0 and 2.85 <= h["p99"] <= 3.0
         path = tmp_path / "metrics.json"
         m.write(path)
         assert read_metrics(path) == snap
@@ -168,7 +177,8 @@ class TestCaptureStack:
         assert phases.pop("profile_hash") == obs.active_profile_hash()
         assert phases == {
             "compile_s": 0.0, "execute_s": 0.0, "encode_s": 0.0,
-            "frontier_peak": 0}
+            "frontier_peak": 0, "flops": 0.0, "bytes": 0.0,
+            "device_mem_peak": 0}
 
 
 class TestKernelAttribution:
@@ -257,7 +267,8 @@ class TestEndToEndArtifacts:
                 reg.gauge(name).set(rec["max"])
         phases = obs.kernel_phases(reg)
         assert set(phases) == {"compile_s", "execute_s", "encode_s",
-                               "frontier_peak", "profile_hash"}
+                               "frontier_peak", "flops", "bytes",
+                               "device_mem_peak", "profile_hash"}
         assert phases["frontier_peak"] >= 1
 
     def test_telemetry_disabled_run_writes_no_artifacts(self, tmp_path,
@@ -291,4 +302,5 @@ def test_bench_error_path_always_emits_kernel_phases(monkeypatch, capsys):
     phases = dict(out["kernel_phases"])
     assert isinstance(phases.pop("profile_hash"), str)
     assert phases == {"compile_s": 0.0, "execute_s": 0.0,
-                      "encode_s": 0.0, "frontier_peak": 0}
+                      "encode_s": 0.0, "frontier_peak": 0,
+                      "flops": 0.0, "bytes": 0.0, "device_mem_peak": 0}

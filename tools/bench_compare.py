@@ -26,6 +26,11 @@ KeyError traceback. A DEGRADED
 record (`degraded: true` or `value == 0` / backend none) is not a
 perf measurement at all: the comparison is reported as not-comparable
 and exits 0 — a dead TPU tunnel must not read as a 100% regression.
+Likewise two records whose backend-health states differ (`healthy` vs
+`degraded`/`wedged`, the ISSUE 8 supervisor stamp): not-comparable,
+with both states named. The kernel_phases deep-attribution fields
+(flops / bytes / device_mem_peak) are compared as INFORMATIONAL lanes
+— deltas printed, never regression-gated (gating stays on events/s).
 
 Importable: `load_record(path)`, `compare(old, new, threshold_pct)` —
 `tests/test_bench_compare.py` smokes both plus the exit-code contract.
@@ -56,6 +61,15 @@ LANES: list[tuple[str, tuple]] = [
 ]
 # Long-history lanes: seconds, LOWER is better — handled via inversion.
 LONG_LANES_PATH = ("detail", "long_history")
+# Deep-attribution lanes (ISSUE 8): the kernel_phases cost_analysis
+# totals. INFORMATIONAL — a flops delta explains a throughput move
+# (did the work change, or the speed?) but is not itself a regression;
+# gating stays on events/s exactly as before.
+INFO_LANES: list[tuple[str, tuple]] = [
+    ("kernel_flops", ("kernel_phases", "flops")),
+    ("kernel_bytes", ("kernel_phases", "bytes")),
+    ("device_mem_peak", ("kernel_phases", "device_mem_peak")),
+]
 
 
 def load_record(path: str | Path) -> dict:
@@ -125,6 +139,19 @@ def compare(old: dict, new: dict,
                              f"({rec.get('error') or rec.get('backend') or 'value 0'}); "
                              f"not a perf measurement")
             return out
+    # Backend-health gate (ISSUE 8): records taken under DIFFERENT
+    # supervisor states (healthy vs degraded/wedged) measure different
+    # machines — same contract as the degraded gate, with the states
+    # named. Absent health fields (pre-ISSUE-8 rounds) compare as
+    # before.
+    old_state = (old.get("health") or {}).get("state")
+    new_state = (new.get("health") or {}).get("state")
+    if old_state and new_state and old_state != new_state:
+        out["comparable"] = False
+        out["reason"] = (f"backend health differs: old record ran "
+                         f"{old_state}, new record ran {new_state}; "
+                         f"not a like-for-like perf measurement")
+        return out
     pairs = [(lane, _dig(old, path), _dig(new, path))
              for lane, path in LANES]
     old_long, new_long = _long_lanes(old), _long_lanes(new)
@@ -152,6 +179,20 @@ def compare(old: dict, new: dict,
                              "regression": reg})
         if reg:
             out["regressions"].append(lane)
+    # Informational lanes: deltas reported, never gated (a flops move
+    # explains a throughput move; it is not itself one). Absent fields
+    # (pre-ISSUE-8 records) skip silently in either direction.
+    for lane, path in INFO_LANES:
+        o, n = _dig(old, path), _dig(new, path)
+        if o is None or n is None or o == 0:
+            out["lanes"].append({"lane": lane, "old": o, "new": n,
+                                 "delta_pct": None, "regression": False,
+                                 "skipped": True, "informational": True})
+            continue
+        out["lanes"].append({"lane": lane, "old": round(o, 4),
+                             "new": round(n, 4),
+                             "delta_pct": round((n - o) / o * 100.0, 2),
+                             "regression": False, "informational": True})
     return out
 
 
@@ -190,6 +231,8 @@ def main(argv=None) -> int:
                           f"(MISSING from new record)")
                 else:
                     flag = "  << REGRESSION" if r["regression"] else ""
+                    if r.get("informational"):
+                        flag = "  (informational)"
                     print(f"{r['lane']:<{w}}  {r['old']:>12g} -> "
                           f"{r['new']:>12g}  {r['delta_pct']:+7.2f}%{flag}")
     if not res["comparable"]:
