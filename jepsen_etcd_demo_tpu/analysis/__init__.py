@@ -24,11 +24,15 @@ from .core import (CONCURRENCY_SCOPES, KERNEL_SCOPES, ModuleSource,
                    ProjectRule, Rule, all_rules, resolve_rules)
 from .engine import LintResult, find_repo_root, run_lint
 from .findings import Finding, fingerprint_findings, format_json, \
-    format_text
+    format_sarif, format_text
+from .flow import (CONTRACTS_FILE, FlowIndex, extract_contracts,
+                   render_contracts)
 
 __all__ = [
     "Baseline", "DEFAULT_BASELINE", "CONCURRENCY_SCOPES",
     "KERNEL_SCOPES", "ModuleSource", "ProjectRule", "Rule", "all_rules",
     "resolve_rules", "LintResult", "find_repo_root", "run_lint",
-    "Finding", "fingerprint_findings", "format_json", "format_text",
+    "Finding", "fingerprint_findings", "format_json", "format_sarif",
+    "format_text", "CONTRACTS_FILE", "FlowIndex", "extract_contracts",
+    "render_contracts",
 ]

@@ -61,7 +61,8 @@ class Baseline:
 
     def split(self, findings: Iterable[Finding],
               covered_paths: Optional[set[str]] = None,
-              ran_rules: Optional[set[str]] = None
+              ran_rules: Optional[set[str]] = None,
+              missing_paths: Optional[set[str]] = None
               ) -> tuple[list[Finding], list[Finding], list[str]]:
         """(new, baselined, stale-fingerprints): findings not covered by
         the baseline, findings it accepts, and entries no longer
@@ -72,7 +73,14 @@ class Baseline:
         this run executed): a partial run — ``lint --strict <subdir>``
         or ``--rules JTL101`` — must not report entries for unscanned
         files / un-run rules as "fixed" (nor let --write-baseline prune
-        them). None = everything was in scope."""
+        them). None = everything was in scope.
+
+        `missing_paths` are entry paths that no longer EXIST on disk (a
+        file deleted outright). Fingerprint staleness alone never
+        catches those — the deleted file is no longer scanned, so its
+        entries looked permanently out of scope and accreted forever.
+        Deletion is global truth: such entries are stale regardless of
+        the scanned-path / ran-rule scoping."""
         new: list[Finding] = []
         baselined: list[Finding] = []
         seen: set[str] = set()
@@ -82,11 +90,14 @@ class Baseline:
                 seen.add(f.fingerprint)
             else:
                 new.append(f)
+        missing = missing_paths or set()
         stale = [fp for fp, ent in self.entries.items()
                  if fp not in seen
-                 and (covered_paths is None
-                      or ent.get("path") in covered_paths)
-                 and (ran_rules is None or ent.get("rule") in ran_rules)]
+                 and (ent.get("path") in missing
+                      or ((covered_paths is None
+                           or ent.get("path") in covered_paths)
+                          and (ran_rules is None
+                               or ent.get("rule") in ran_rules)))]
         return new, baselined, stale
 
     def extend(self, findings: Iterable[Finding],

@@ -11,6 +11,7 @@ so the tier-1 wiring stays well under its 5 s budget.
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 from pathlib import Path
 from typing import Optional, Sequence
@@ -18,7 +19,7 @@ from typing import Optional, Sequence
 from .baseline import Baseline, DEFAULT_BASELINE
 from .core import PACKAGE_NAME, resolve_rules
 from .engine import find_repo_root, run_lint
-from .findings import format_json, format_text
+from .findings import format_json, format_sarif, format_text
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -33,7 +34,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="exit 1 on any unbaselined finding or stale "
                         "baseline entry (the tier-1 gate)")
     p.add_argument("--json", action="store_true",
-                   help="emit one JSON object instead of text")
+                   help="emit one JSON object instead of text "
+                        "(alias for --format json)")
+    p.add_argument("--format", default=None, metavar="FMT",
+                   choices=("text", "json", "sarif"),
+                   help="report format: text (default), json, or sarif "
+                        "(SARIF 2.1.0 — CI PR annotation)")
+    p.add_argument("--changed", default=None, metavar="REF",
+                   help="incremental mode: lint only Python files "
+                        "changed vs the git base REF (plus untracked). "
+                        "Project/flow rules still run full-project "
+                        "whenever a changed file dirties the package's "
+                        "contract graph, and are skipped otherwise")
+    p.add_argument("--contracts", action="store_true",
+                   help="print the extracted kernel-contract spec "
+                        "(contracts.json content) and exit")
+    p.add_argument("--write-contracts", action="store_true",
+                   help="regenerate <repo-root>/contracts.json from the "
+                        "tree and exit (the JTL406 sync gate's fix)")
     p.add_argument("--rules", default=None, metavar="IDS",
                    help="comma-separated rule ids/names to run "
                         "(default: all)")
@@ -65,6 +83,40 @@ def _list_rules(rules) -> str:
     return "\n".join(out)
 
 
+def _git_changed_files(root: Path, ref: str
+                       ) -> Optional[tuple[list[str], list[Path]]]:
+    """(all changed relpaths, existing changed Python files) vs `ref`
+    (working tree diff + untracked). The RAW list keeps deletions and
+    non-.py changes — the package-dirty decision must see a deleted
+    kernel module or an edited contracts.json/doc file even though
+    there is nothing to module-lint in them. None = git unavailable/
+    failed (caller falls back to a full-project lint rather than a
+    silent partial one)."""
+    def run(*cmd: str) -> Optional[list[str]]:
+        try:
+            out = subprocess.run(cmd, cwd=root, capture_output=True,
+                                 text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if out.returncode != 0:
+            return None
+        return [ln for ln in out.stdout.split("\0") if ln.strip()]
+
+    # --relative: paths come back relative to `root` (the lint root),
+    # not the git toplevel — in a monorepo where the project is nested
+    # inside a larger repo, toplevel-relative paths would never resolve
+    # under root and every change would be silently dropped.
+    diff = run("git", "diff", "--name-only", "--relative", "-z", ref)
+    if diff is None:
+        return None
+    untracked = run("git", "ls-files", "--others", "--exclude-standard",
+                    "-z") or []
+    raw = list(dict.fromkeys(diff + untracked))
+    files = [root / rel for rel in raw
+             if (root / rel).suffix == ".py" and (root / rel).is_file()]
+    return raw, files
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     try:
@@ -74,6 +126,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 2
     if args.list_rules:
         print(_list_rules(rules))
+        return 0
+    fmt = args.format or ("json" if args.json else "text")
+    if args.contracts or args.write_contracts:
+        from .flow.contracts import (CONTRACTS_FILE, extract_contracts,
+                                     render_contracts)
+
+        root = find_repo_root(Path(args.paths[0]) if args.paths
+                              else Path(__file__))
+        text = render_contracts(extract_contracts(root))
+        if args.write_contracts:
+            out = root / CONTRACTS_FILE
+            out.write_text(text, encoding="utf-8")
+            print(f"contracts: wrote {out}")
+        else:
+            print(text, end="")
         return 0
     if args.no_baseline and args.write_baseline:
         # Writing "ignore the baseline" INTO the checked-in baseline
@@ -99,6 +166,54 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   f"from {root}; pass explicit paths", file=sys.stderr)
             return 2
 
+    project_rules = not args.no_project_rules
+    changed_no_modules = False
+    if args.changed is not None:
+        changed = _git_changed_files(root, args.changed)
+        if changed is None:
+            # A bad ref / missing git must not silently lint nothing —
+            # fall back to the full run the CI gate expects.
+            print(f"warning: git diff vs {args.changed!r} failed; "
+                  f"falling back to a full lint", file=sys.stderr)
+        else:
+            raw, py_files = changed
+            scope = [p.resolve() for p in paths]
+            sel = [f for f in py_files
+                   if any(s == f.resolve() or s in f.resolve().parents
+                          for s in scope)]
+            # Contract-graph dirtiness judges the RAW change list —
+            # deleted package modules and non-.py inputs the project
+            # rules read (contracts.json for JTL406, doc/ for JTL301)
+            # must re-trigger the full-project pass even though there
+            # is no surviving .py file to module-lint.
+            dirty = any(
+                rel.split("/")[0] in (PACKAGE_NAME, "doc")
+                or rel == "contracts.json" for rel in raw)
+            if not sel and not (dirty and project_rules):
+                # The quiet no-op must still honor the output contract:
+                # a CI consumer parsing --format json/sarif gets an
+                # empty findings document, never prose on stdout.
+                if fmt == "json":
+                    print(format_json([], files=0, suppressed=0,
+                                      baselined=0, stale_baseline=[],
+                                      strict=args.strict, ok=True))
+                elif fmt == "sarif":
+                    print(format_sarif([], rules))
+                else:
+                    print(f"jtlint: nothing changed vs {args.changed} "
+                          f"under {', '.join(str(p) for p in paths)} — "
+                          f"nothing to lint")
+                return 0
+            paths = sel
+            changed_no_modules = not sel
+            if project_rules and not dirty:
+                # Project/flow rules read the whole contract graph; when
+                # no changed file touches it, their full-project pass is
+                # provably unchanged — skip it. ANY package/doc/
+                # contracts change dirties the graph and falls back to
+                # the full flow pass.
+                project_rules = False
+
     # One loading path for --baseline and the repo default: a corrupt /
     # wrong-version baseline must be the documented exit-2 usage error
     # on BOTH (the default path is the tier-1 invocation), never a raw
@@ -117,10 +232,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 2
 
     res = run_lint(paths, rules=rules, root=root, baseline=baseline,
-                   project_rules=not args.no_project_rules)
-    if res.files == 0:
+                   project_rules=project_rules)
+    if res.files == 0 and not changed_no_modules:
         # Nothing scanned can never read as a clean lint (a green that
-        # checked nothing is the worst CI outcome).
+        # checked nothing is the worst CI outcome). Exception: a
+        # --changed run whose only changes are project-rule inputs
+        # (contracts.json, doc/, a deleted module) legitimately scans
+        # zero modules — the project rules above were the point.
         print(f"error: no Python files found under "
               f"{', '.join(str(p) for p in paths)}", file=sys.stderr)
         return 2
@@ -139,12 +257,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               f"-> {path} — add a justification note per entry")
         return 0
 
-    if args.json:
+    if fmt == "json":
         print(format_json(
             res.findings, files=res.files,
             suppressed=len(res.suppressed), baselined=len(res.baselined),
             stale_baseline=res.stale_baseline, strict=args.strict,
             ok=res.ok()))
+    elif fmt == "sarif":
+        print(format_sarif(res.findings, rules))
     else:
         if res.findings:
             print(format_text(res.findings))

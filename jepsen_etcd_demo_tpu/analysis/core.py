@@ -21,7 +21,9 @@ ids comma-separate; ``disable=all`` silences every rule for that line.
 from __future__ import annotations
 
 import ast
+import io
 import re
+import tokenize
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Iterator, Optional
@@ -58,25 +60,57 @@ class ModuleSource:
     # line -> (rule ids, has a ` -- justification`); see load().
     suppressions: dict[int, tuple[set[str], bool]] = field(
         default_factory=dict)
+    # line -> the justification text after ` -- ` (the human argument).
+    # Captured by the ONE suppression grammar (_SUPPRESS_RE) so the
+    # stale-suppression ledger (engine/tools/lint_report.py) can never
+    # drift from what the engine considers justified.
+    suppression_notes: dict[int, str] = field(default_factory=dict)
+    # line -> real COMMENT text on that line (from the tokenizer, so
+    # comment syntax QUOTED inside strings/docstrings — the suppression
+    # examples in this very module, the jtflow grammar in
+    # analysis/flow/facts.py — never parses as a live directive, while
+    # a real trailing comment after a multiline string's closing quote
+    # still does).
+    comments: dict[int, str] = field(default_factory=dict, repr=False)
+    # Lazy flat ast.walk snapshot: the flow extractors (analysis/flow/)
+    # make many typed passes over each module; walking the generator
+    # per pass was the dominant cost of the whole lint run. Cached here
+    # so it also amortizes across run_lint invocations (ModuleSource
+    # objects are parse-cached process-wide, flow/index.py).
+    _walked: Optional[list] = field(default=None, repr=False)
+
+    def walk_nodes(self) -> list:
+        if self._walked is None:
+            self._walked = list(ast.walk(self.tree))
+        return self._walked
 
     @classmethod
     def load(cls, path: Path, root: Path) -> "ModuleSource":
         text = path.read_text(encoding="utf-8")
         tree = parse_module(text, filename=str(path))
         lines = text.splitlines()
+        comments = _comment_lines(text)
         # line -> (rule ids, has a `--` justification). Only JUSTIFIED
         # suppressions suppress (the engine reports bare ones as JTL001
         # — "a suppression is an argument, not an off switch" is
-        # enforced here, not just in a test).
+        # enforced here, not just in a test). Directives parse from
+        # REAL comment tokens only: `# jtlint:` quoted inside a
+        # docstring example is prose, not a directive — it must neither
+        # suppress nor count as stale.
         sup: dict[int, tuple[set[str], bool]] = {}
-        for i, ln in enumerate(lines, start=1):
+        notes: dict[int, str] = {}
+        for i, ln in sorted(comments.items()):
             m = _SUPPRESS_RE.search(ln)
             if m:
                 ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
-                sup[i] = (ids, bool(m.group(2) and m.group(2).strip()))
+                note = (m.group(2) or "").strip()
+                sup[i] = (ids, bool(note))
+                if note:
+                    notes[i] = note
         return cls(path=path, relpath=_relpath(path, root), text=text,
                    tree=tree, imports=ImportMap(tree),
-                   scope=_scope_of(path), lines=lines, suppressions=sup)
+                   scope=_scope_of(path), lines=lines, suppressions=sup,
+                   suppression_notes=notes, comments=comments)
 
     def line(self, n: int) -> str:
         return self.lines[n - 1] if 1 <= n <= len(self.lines) else ""
@@ -90,18 +124,24 @@ class ModuleSource:
             # long_scan_poll chunks is the documented fail-fast contract.
             if bool(np.asarray(carry.dead)):
         """
+        return self.suppression_line(rule_id, line) is not None
+
+    def suppression_line(self, rule_id: str, line: int) -> Optional[int]:
+        """The comment line whose justified disable covers a finding at
+        `line`, or None — the engine uses the matched line for the
+        unused-suppression accounting behind tools/lint_report.py."""
         def hit(n: int) -> bool:
             ids, justified = self.suppressions.get(n, (set(), False))
             return justified and (rule_id in ids or "all" in ids)
 
         if hit(line):
-            return True
+            return line
         n = line - 1
         while n >= 1 and self.line(n).lstrip().startswith("#"):
             if hit(n):
-                return True
+                return n
             n -= 1
-        return False
+        return None
 
     def finding(self, rule: "Rule", node_or_line, message: str,
                 hint: Optional[str] = None) -> Finding:
@@ -119,6 +159,22 @@ class ModuleSource:
                        message=message,
                        hint=rule.hint if hint is None else hint,
                        snippet=self.line(line), anchor=anchor)
+
+
+def _comment_lines(text: str) -> dict[int, str]:
+    """line -> comment text, from the tokenizer (never from strings).
+    Falls back to a plain line scan if tokenization fails — the text
+    already parsed as a module, so that path is near-unreachable."""
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for i, ln in enumerate(text.splitlines(), start=1):
+            if "#" in ln:
+                out[i] = ln[ln.index("#"):]
+    return out
 
 
 def _scope_of(path: Path) -> Optional[str]:
@@ -163,12 +219,16 @@ class Rule:
 
 class ProjectRule(Rule):
     """A rule that runs once per invocation against the repo root
-    instead of per module (e.g. the KernelLimits doc lint)."""
+    instead of per module (the KernelLimits doc lint, the JTL4xx flow
+    rules). `ctx` — when the engine provides one — is the shared
+    ProjectContext carrying the already-parsed modules and the lazily
+    built cross-module FlowIndex, so every project rule rides ONE parse
+    of the tree instead of re-reading it per rule."""
 
     def check(self, mod: ModuleSource) -> Iterator[Finding]:
         return iter(())
 
-    def check_project(self, root: Path) -> list[Finding]:
+    def check_project(self, root: Path, ctx=None) -> list[Finding]:
         raise NotImplementedError
 
     def covered_paths(self, root: Path) -> list[str]:

@@ -33,6 +33,10 @@ class LintResult:
     stale_baseline: list[str] = field(default_factory=list)
     files: int = 0
     parse_errors: list[Finding] = field(default_factory=list)
+    # Justified suppression comments that suppressed NOTHING this run
+    # (every id they name was executed): stale suppressions, surfaced by
+    # tools/lint_report.py. {path, line, ids, justification} records.
+    unused_suppressions: list[dict] = field(default_factory=list)
 
     @property
     def all_findings(self) -> list[Finding]:
@@ -86,6 +90,37 @@ def find_repo_root(start: Path) -> Path:
     return cur
 
 
+class ProjectContext:
+    """Shared state for one lint invocation, handed to every
+    ProjectRule: the modules this run already parsed (parse once, share
+    the AST across all rules) and the lazily built cross-module
+    FlowIndex the JTL4xx rules + contracts extraction all ride — built
+    at most ONCE per invocation, seeded with the scanned modules so the
+    flow pass re-parses nothing."""
+
+    def __init__(self, root: Path, modules: dict[str, ModuleSource]):
+        self.root = Path(root)
+        self.modules = modules
+        self._flow = None
+
+    def flow_index(self):
+        if self._flow is None:
+            from .flow.index import FlowIndex
+
+            self._flow = FlowIndex.build(self.root,
+                                         preloaded=self.modules)
+        return self._flow
+
+    def module_for(self, relpath: str) -> Optional[ModuleSource]:
+        """A parsed module by repo-relative path — from this run's scan
+        or, for project-rule findings on unscanned files, the flow
+        index (without forcing one to exist)."""
+        mod = self.modules.get(relpath)
+        if mod is None and self._flow is not None:
+            mod = self._flow.modules.get(relpath)
+        return mod
+
+
 def run_lint(paths: Sequence[Path | str],
              rules: Optional[dict[str, Rule]] = None,
              root: Optional[Path] = None,
@@ -94,9 +129,11 @@ def run_lint(paths: Sequence[Path | str],
     """Lint `paths` (files or directories) and return a LintResult.
 
     `rules` defaults to the full registry; pass a subset for targeted
-    runs (fixture tests). Project-level rules (the doc lint) run once
-    against `root` unless disabled — they are skipped automatically
-    when `rules` was narrowed to exclude them."""
+    runs (fixture tests). Project-level rules (the doc lint, the flow
+    rules) run once against `root` unless disabled — they are skipped
+    automatically when `rules` was narrowed to exclude them."""
+    from .flow.index import load_module_cached
+
     paths = [Path(p) for p in paths]
     if root is None:
         root = find_repo_root(paths[0] if paths else Path.cwd())
@@ -104,6 +141,19 @@ def run_lint(paths: Sequence[Path | str],
     res = LintResult()
     raw: list[Finding] = []
     sup_raw: list[tuple[Finding, ModuleSource]] = []
+    mods: dict[str, ModuleSource] = {}
+    # relpath -> suppression-comment lines that suppressed something.
+    used_sup: dict[str, set[int]] = {}
+
+    def suppress(mod: ModuleSource, f: Finding) -> bool:
+        hit = mod.suppression_line(f.rule, f.line)
+        if hit is None and f.anchor and f.anchor != f.line:
+            hit = mod.suppression_line(f.rule, f.anchor)
+        if hit is None:
+            return False
+        used_sup.setdefault(mod.relpath, set()).add(hit)
+        sup_raw.append((f, mod))
+        return True
 
     module_rules = [r for r in rules.values()
                     if not isinstance(r, ProjectRule)]
@@ -112,7 +162,7 @@ def run_lint(paths: Sequence[Path | str],
         res.files += 1
         covered.add(_relpath(path, root))
         try:
-            mod = ModuleSource.load(path, root)
+            mod = load_module_cached(path, root)
         except (SyntaxError, UnicodeDecodeError, OSError) as e:
             lineno = getattr(e, "lineno", 1) or 1
             # Repo-relative like every finding: the fingerprint must be
@@ -125,6 +175,7 @@ def run_lint(paths: Sequence[Path | str],
             res.parse_errors.append(pe)
             raw.append(pe)
             continue
+        mods[mod.relpath] = mod
         # Unjustified suppression comments are findings themselves
         # (JTL001) and do NOT suppress — including stale bare disables
         # on lines where no rule fires anymore.
@@ -143,17 +194,20 @@ def run_lint(paths: Sequence[Path | str],
             if not rule.applies_to(mod):
                 continue
             for f in rule.check(mod):
-                if mod.suppressed(f.rule, f.line) or (
-                        f.anchor and f.anchor != f.line
-                        and mod.suppressed(f.rule, f.anchor)):
-                    sup_raw.append((f, mod))
-                else:
+                if not suppress(mod, f):
                     raw.append(f)
 
+    ctx = ProjectContext(root, mods)
     if project_rules:
         for rule in rules.values():
             if isinstance(rule, ProjectRule):
-                raw.extend(rule.check_project(root))
+                for f in rule.check_project(root, ctx):
+                    # Project-rule findings (the flow rules land on
+                    # module lines) honor the same inline-suppression
+                    # contract as module rules.
+                    fmod = ctx.module_for(f.path)
+                    if fmod is None or not suppress(fmod, f):
+                        raw.append(f)
                 covered.update(rule.covered_paths(root))
 
     # ONE fingerprint pass over kept + suppressed findings together:
@@ -166,8 +220,38 @@ def run_lint(paths: Sequence[Path | str],
         baseline = Baseline()
     # The engine-emitted rules (JTL000 parse errors, JTL001 unjustified
     # suppressions) always run, so their entries are always in scope
-    # for staleness.
-    ran_rules = set(rules) | {"JTL000", "JTL001"}
+    # for staleness. Project rules count as "ran" only when they
+    # actually did — a project_rules=False run (the --changed
+    # clean-graph fast path) must not judge JTL3xx/4xx baseline entries
+    # or suppressions it never re-derived.
+    ran_rules = {rid for rid, r in rules.items()
+                 if project_rules or not isinstance(r, ProjectRule)} \
+        | {"JTL000", "JTL001"}
+    # A baseline entry whose file was deleted outright would never go
+    # stale by fingerprint alone (the path is no longer scanned);
+    # deletion is global truth, so such entries always prune.
+    missing = {ent.get("path") for ent in baseline.entries.values()
+               if ent.get("path") and not (root / ent["path"]).exists()}
     res.findings, res.baselined, res.stale_baseline = baseline.split(
-        raw, covered_paths=covered, ran_rules=ran_rules)
+        raw, covered_paths=covered, ran_rules=ran_rules,
+        missing_paths=missing)
+    # Stale-suppression accounting: a justified disable that suppressed
+    # nothing, counted only when every rule it names actually ran (a
+    # --rules-narrowed or project_rules=False run must not report other
+    # rules' suppressions as stale; `disable=all` is checkable only
+    # when the WHOLE registry executed).
+    full_run = ran_rules >= set(all_rules())
+    for rel, mod in sorted(mods.items()):
+        used = used_sup.get(rel, set())
+        for ln, (ids, justified) in sorted(mod.suppressions.items()):
+            if not justified or ln in used:
+                continue
+            if "all" in ids:
+                if not full_run:
+                    continue
+            elif not ids <= ran_rules:
+                continue
+            res.unused_suppressions.append({
+                "path": rel, "line": ln, "ids": sorted(ids),
+                "justification": mod.suppression_notes.get(ln, "")})
     return res

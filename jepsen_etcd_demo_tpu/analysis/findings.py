@@ -80,3 +80,51 @@ def format_text(findings: list[Finding]) -> str:
 def format_json(findings: list[Finding], **extra) -> str:
     return json.dumps({"findings": [f.as_dict() for f in findings],
                        **extra}, indent=2)
+
+
+def format_sarif(findings: list[Finding], rules: dict) -> str:
+    """SARIF 2.1.0 — the format CI annotators (GitHub code scanning)
+    ingest to pin findings onto PR diffs. One run, one driver; the
+    stable jtlint fingerprint rides along as a partial fingerprint so
+    annotations dedupe across pushes the same way the baseline does."""
+    rule_ids = sorted({f.rule for f in findings})
+    driver_rules = []
+    for rid in rule_ids:
+        r = rules.get(rid)
+        desc = getattr(r, "rationale", "") or rid
+        driver_rules.append({
+            "id": rid,
+            "name": getattr(r, "name", rid) or rid,
+            "shortDescription": {"text": getattr(r, "name", rid) or rid},
+            "fullDescription": {"text": desc},
+            "help": {"text": getattr(r, "hint", "") or desc},
+        })
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message
+                        + (f"\nhint: {f.hint}" if f.hint else "")},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, f.line)},
+                }}],
+            "partialFingerprints": {"jtlint/v1": f.fingerprint},
+        })
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "jtlint",
+                "informationUri": "doc/analysis.md",
+                "rules": driver_rules,
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)

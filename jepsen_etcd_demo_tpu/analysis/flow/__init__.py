@@ -1,0 +1,34 @@
+"""jtflow — interprocedural kernel-contract and dataflow analysis.
+
+The per-file jtlint rules (ISSUE 7) cannot see *cross-module contract
+drift*: PR 3 widened ``wgl3.PACKED_FIELDS`` from 5 to 6 columns and had
+to hand-patch ``unpack_np``, ``parallel/dense.py``,
+``parallel/multislice.py`` and the ``__graft_entry__`` shard-shape
+assert; PR 7's ``/metrics`` family collision was the same shape of bug
+in the obs layer. This package is the whole-program half of the
+analysis layer (ISSUE 9):
+
+  * ``index.py``      — FlowIndex: every package module parsed once,
+                        with cross-module symbol + donation resolution
+                        through the factory → ``_CACHE`` →
+                        ``instrument_kernel`` idiom;
+  * ``facts.py``      — ``# jtflow:`` annotation parsing and contract
+                        extraction (packed-result schemas, donated
+                        operand positions, resumable-carry field sets,
+                        mesh/collective axis names, obs metric
+                        contracts);
+  * ``contracts.py``  — the machine-readable ``contracts.json``
+                        artifact: the reviewed, diffable statement of
+                        the kernel interfaces that ROADMAP item 5's
+                        KernelPlan layer will consume.
+
+Like the rest of ``analysis/``, everything here is stdlib-``ast`` only
+and never imports jax — the flow pass rides the same tier-1 fast path
+as the per-file rules (tests/test_lint.py keeps the whole strict run
+under 5 s).
+"""
+
+from .index import FlowIndex                     # noqa: F401
+from .facts import FlowFacts, flow_facts         # noqa: F401
+from .contracts import (CONTRACTS_FILE,          # noqa: F401
+                        extract_contracts, render_contracts)

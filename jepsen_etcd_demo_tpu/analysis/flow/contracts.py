@@ -1,0 +1,142 @@
+"""contracts.json: the machine-readable kernel-interface spec.
+
+This is the artifact the flow pass both *verifies against the tree*
+(rules JTL401-405) and *emits for consumers*: a reviewed, diffable
+statement of every cross-module kernel contract — exactly the explicit
+interface set ROADMAP item 5's ``KernelPlan`` layer will be built on.
+``jepsen-tpu lint --write-contracts`` regenerates it; a tier-1 check
+(JTL406 + tests/test_lint.py) fails when the checked-in copy drifts
+from the tree, the same regenerate-and-diff discipline as the
+KernelLimits doc lint.
+
+Sections (all extracted by analysis/flow/facts.py, deterministically —
+sorted keys, repo-relative posix paths, no timestamps):
+
+  * ``packed_schemas``   field tuple + column width per packed-result
+                         schema (``wgl3.PACKED_FIELDS[_XLA]``)
+  * ``kernels``          every ``instrument_kernel`` site: name, module,
+                         factory, donated operand positions, packed
+                         schema where declared
+  * ``partials``         per-chunk partial-sum layouts (the f32[N]
+                         accumulator rows consumers index into)
+  * ``carries``          resumable-carry NamedTuple field sets + the
+                         factories that build them
+  * ``meshes``           declared mesh axis names -> declaring modules
+  * ``collectives``      per-module collective/sharding axis uses
+  * ``metrics``          pre-registered capture names, labeled export
+                         families, snapshot-contract keys
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional
+
+from .facts import FlowFacts, flow_facts
+from .index import FlowIndex
+
+CONTRACTS_VERSION = 1
+CONTRACTS_FILE = "contracts.json"
+
+
+def extract_contracts(root: Path,
+                      index: Optional[FlowIndex] = None) -> dict:
+    """The contracts dict for `root` (building a FlowIndex unless the
+    caller shares one — the engine passes its ProjectContext index so
+    the whole lint run parses each file once)."""
+    if index is None:
+        index = FlowIndex.build(Path(root))
+    facts = flow_facts(index)
+    return _assemble(facts)
+
+
+def _assemble(facts: FlowFacts) -> dict:
+    kernels: dict[str, dict] = {}
+    for k in sorted(facts.kernels, key=lambda k: (k.name, k.module,
+                                                  k.line)):
+        ent = kernels.get(k.name)
+        if ent is None:
+            kernels[k.name] = ent = {
+                "module": k.module, "factory": k.factory or None,
+                "donates": sorted(k.donates)}
+        else:
+            # Same kernel name from two factories (wgl3-batch's packed
+            # and dict forms): one entry, facts merged.
+            ent["donates"] = sorted(set(ent["donates"]) | set(k.donates))
+        if k.packed and not ent.get("packed"):
+            ent["packed"] = k.packed
+
+    collectives: dict[str, dict[str, list[str]]] = {}
+    for use in facts.axis_uses:
+        by_kind = collectives.setdefault(use.mod.relpath, {})
+        axes = by_kind.setdefault(use.kind, [])
+        if use.axis not in axes:
+            axes.append(use.axis)
+    for by_kind in collectives.values():
+        for axes in by_kind.values():
+            axes.sort()
+
+    dynamic_families = sorted({
+        w.family for w in facts.metric_writes if w.family})
+
+    return {
+        "version": CONTRACTS_VERSION,
+        "generated_by": "jepsen-tpu lint --write-contracts",
+        "packed_schemas": {
+            ref: {"module": s.module, "fields": list(s.fields),
+                  "width": s.width}
+            for ref, s in sorted(facts.schemas.items())},
+        "kernels": kernels,
+        "partials": {key: list(names) for key, names
+                     in sorted(facts.partial_layouts.items())},
+        "carries": {
+            name: {"module": c.module, "fields": list(c.fields),
+                   "factories": sorted(
+                       f for f, cls in facts.carry_factories.items()
+                       if cls == name)}
+            for name, c in sorted(facts.carries.items())},
+        "meshes": {ax: sorted(mods)
+                   for ax, mods in sorted(facts.mesh_axes.items())},
+        "collectives": {rel: dict(sorted(by_kind.items()))
+                        for rel, by_kind in sorted(collectives.items())},
+        "table_word_bits": (facts.table_word_bits[0]
+                            if facts.table_word_bits else None),
+        "metrics": {
+            "preregistered": sorted(facts.preregistered),
+            "labeled_families": dict(sorted(
+                facts.labeled_families.items())),
+            "snapshot_keys": sorted({n for _, _, n
+                                     in facts.snapshot_reads}),
+            "dynamic_families": dynamic_families,
+        },
+    }
+
+
+def render_contracts(contracts: dict) -> str:
+    return json.dumps(contracts, indent=2, sort_keys=False) + "\n"
+
+
+def contracts_in_sync(root: Path,
+                      index: Optional[FlowIndex] = None
+                      ) -> tuple[bool, str]:
+    """(in_sync, detail): compare the checked-in contracts.json against
+    a fresh extraction. Missing file -> out of sync with a hint."""
+    path = Path(root) / CONTRACTS_FILE
+    fresh = render_contracts(extract_contracts(root, index=index))
+    if not path.is_file():
+        return False, (f"{CONTRACTS_FILE} missing — run `jepsen-tpu lint "
+                       f"--write-contracts`")
+    current = path.read_text(encoding="utf-8")
+    if current == fresh:
+        return True, ""
+    try:
+        cur, new = json.loads(current), json.loads(fresh)
+        changed = sorted(
+            k for k in set(cur) | set(new) if cur.get(k) != new.get(k))
+        detail = f"sections out of sync: {', '.join(changed)}"
+    except ValueError:
+        detail = "checked-in file is not valid JSON"
+    return False, (f"{CONTRACTS_FILE} is stale ({detail}) — regenerate "
+                   f"with `jepsen-tpu lint --write-contracts` and review "
+                   f"the diff")

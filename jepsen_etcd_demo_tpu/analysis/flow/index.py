@@ -1,0 +1,166 @@
+"""FlowIndex: the whole-program module index behind the flow rules.
+
+One parse of every in-scope module (reusing the engine's already-loaded
+``ModuleSource`` objects when the lint run scanned them — the parse-
+once contract of analysis/engine.py), plus the two resolution services
+every flow rule needs:
+
+  * **symbol resolution** — a dotted origin from a consumer module's
+    ``ImportMap`` (``ops.wgl3._cached_chunk_run``,
+    ``producer.cached_run``) resolved to (producing module, symbol);
+  * **donation resolution** — the donated-operand positions of a
+    callable resolved ACROSS modules, by chaining each module's
+    intra-module resolver (analysis/rules/donation.py) through the
+    import graph: ``stream/engine.py`` calling
+    ``wgl3._cached_chunk_run`` resolves through wgl3's
+    ``_CACHE[key] = instrument_kernel(..., _chunk_fn(...))`` store to
+    ``jax.jit(run, donate_argnums=(0,))``.
+
+Scope: when ``<root>/jepsen_etcd_demo_tpu`` exists the index covers the
+package (the production contract graph); otherwise every ``*.py``
+under the root (the flow-rule fixture mini-projects in
+tests/lint_fixtures/). Parses are cached process-wide keyed by
+(path, mtime_ns, size) so repeated lint runs — fixtures in one pytest
+session, ``--changed`` full-project fallbacks — never re-parse an
+unchanged file.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Optional
+
+from ..core import ModuleSource, PACKAGE_NAME
+
+# (resolved path, mtime_ns, size, root) -> ModuleSource. Bounded: the
+# cache is cleared wholesale past the cap (a whole-repo lint is ~130
+# files; the cap only guards pathological fixture churn).
+_PARSE_CACHE: dict[tuple, ModuleSource] = {}
+_PARSE_CACHE_CAP = 4096
+
+
+def load_module_cached(path: Path, root: Path) -> ModuleSource:
+    """ModuleSource.load with a process-wide stat-keyed cache."""
+    rp = Path(path).resolve()
+    try:
+        st = rp.stat()
+        key = (str(rp), st.st_mtime_ns, st.st_size, str(Path(root).resolve()))
+    except OSError:
+        return ModuleSource.load(path, root)
+    mod = _PARSE_CACHE.get(key)
+    if mod is None:
+        if len(_PARSE_CACHE) > _PARSE_CACHE_CAP:
+            _PARSE_CACHE.clear()
+        mod = ModuleSource.load(path, root)
+        _PARSE_CACHE[key] = mod
+    return mod
+
+
+class FlowIndex:
+    """Parsed modules + cross-module resolution for one project root."""
+
+    def __init__(self, root: Path, modules: dict[str, ModuleSource]):
+        self.root = Path(root)
+        self.modules = modules           # relpath -> ModuleSource
+        self._resolvers: dict[str, object] = {}
+        self._facts = None               # memoized FlowFacts (facts.py)
+        # Dotted module name -> relpath ("jepsen_etcd_demo_tpu.ops.wgl3"
+        # and its suffixes resolve; fixture files resolve by stem).
+        self.dotted: dict[str, str] = {}
+        for rel in modules:
+            parts = Path(rel).with_suffix("").parts
+            if parts and parts[-1] == "__init__":
+                parts = parts[:-1]
+            for i in range(len(parts)):
+                self.dotted.setdefault(".".join(parts[i:]), rel)
+
+    @classmethod
+    def build(cls, root: Path,
+              preloaded: Optional[dict[str, ModuleSource]] = None
+              ) -> "FlowIndex":
+        """Index the contract graph under `root`: the package when it
+        exists, else every .py below root (fixture mini-projects).
+        `preloaded` ModuleSources (the engine's current scan) are reused
+        verbatim — no re-parse."""
+        from ..core import _relpath
+        from ..engine import iter_python_files
+
+        root = Path(root)
+        pkg = root / PACKAGE_NAME
+        files = iter_python_files([pkg if pkg.is_dir() else root])
+        preloaded = preloaded or {}
+        modules: dict[str, ModuleSource] = {}
+        for f in files:
+            rel = _relpath(f, root)
+            mod = preloaded.get(rel)
+            if mod is None:
+                try:
+                    mod = load_module_cached(f, root)
+                except (SyntaxError, UnicodeDecodeError, OSError):
+                    continue        # JTL000 is the per-file engine's job
+            modules[rel] = mod
+        return cls(root, modules)
+
+    # -- symbol resolution -------------------------------------------------
+
+    def resolve_symbol(self, origin: Optional[str]
+                       ) -> Optional[tuple[ModuleSource, str]]:
+        """A dotted origin (import-resolved by the consumer module) ->
+        (defining module, symbol name), or None. Tries the longest
+        module prefix first so ``ops.wgl3._cached_chunk_run`` binds to
+        ops/wgl3.py even when a top-level module named ``ops`` exists."""
+        if not origin or "." not in origin:
+            return None
+        parts = origin.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            rel = self.dotted.get(".".join(parts[:i]))
+            if rel is not None and i < len(parts):
+                return self.modules[rel], ".".join(parts[i:])
+        return None
+
+    def module_of(self, mod_dotted: str) -> Optional[ModuleSource]:
+        rel = self.dotted.get(mod_dotted)
+        return self.modules[rel] if rel is not None else None
+
+    # -- donation resolution ----------------------------------------------
+
+    def _resolver(self, mod: ModuleSource):
+        from ..rules.donation import _Resolver
+
+        r = self._resolvers.get(mod.relpath)
+        if r is None:
+            r = self._resolvers[mod.relpath] = _Resolver(mod)
+        return r
+
+    def donates(self, mod: ModuleSource, node: ast.AST,
+                depth: int = 0) -> Optional[tuple[tuple[int, ...], bool]]:
+        """Donated positions of the callable `node` evaluates to, chasing
+        imports across modules. Returns (indices, crossed_module) or
+        None. ``crossed_module`` distinguishes the interprocedural
+        findings (JTL402) from what the intra-module rule (JTL102)
+        already reports."""
+        if depth > 4:
+            return None
+        local = self._resolver(mod).expr(node)
+        if local is not None:
+            return local, False
+        # Cross-module: a call (or bare name) whose origin lives in
+        # another indexed module.
+        target = None
+        if isinstance(node, ast.Call):
+            target = node.func
+        elif isinstance(node, (ast.Name, ast.Attribute)):
+            target = node
+        if target is None:
+            return None
+        resolved = self.resolve_symbol(mod.imports.resolve(target))
+        if resolved is None:
+            return None
+        tmod, sym = resolved
+        if tmod is mod or "." in sym:
+            return None
+        d = self._resolver(tmod).function(sym)
+        if d is None:
+            return None
+        return d, True

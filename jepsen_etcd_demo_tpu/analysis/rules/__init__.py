@@ -5,6 +5,9 @@ Rule id blocks (doc/analysis.md has the full reference):
   JTL2xx — concurrency discipline (runner/, stream/, sched/, db/, web/,
            clients/, control/)
   JTL3xx — project-level lints (doc consistency)
+  JTL4xx — interprocedural flow rules over the jtflow contract graph
+           (packed schemas, cross-module donation, sharding axes,
+           resumable carries, metric contracts, contracts.json sync)
   JTL000 — reserved: unparseable file (emitted by the engine itself)
 
 Adding a rule = one module here with a ``@register``-ed Rule subclass,
@@ -15,6 +18,7 @@ section in doc/analysis.md (tests/test_lint.py enforces the last two).
 from . import donation          # noqa: F401
 from . import env_limits        # noqa: F401
 from . import event_loop        # noqa: F401
+from . import flow_rules        # noqa: F401
 from . import host_sync         # noqa: F401
 from . import instrument        # noqa: F401
 from . import jit_cache         # noqa: F401

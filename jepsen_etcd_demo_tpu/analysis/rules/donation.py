@@ -132,6 +132,85 @@ class _Resolver:
         return None
 
 
+def scan_donation_sites(fn, mod: ModuleSource, rule: Rule,
+                        local: dict, expr_donates) -> Iterator[Finding]:
+    """The donated-call-site check, shared by the intra-module rule
+    (JTL102) and the interprocedural flow rule (JTL402 —
+    analysis/rules/flow_rules.py). `local` maps binding names to donated
+    positions; `expr_donates(call_expr)` resolves ``factory(...)(carry)``
+    immediate-call shapes. Same-scope walk only: nested defs get their
+    own pass."""
+    for node in walk_same_scope(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        indices = None
+        if isinstance(f, ast.Name):
+            indices = local.get(f.id)
+        elif isinstance(f, ast.Call):
+            indices = expr_donates(f)
+        if not indices:
+            continue
+        stmt = statement_of(node)
+        rebound = assigned_names(ast.Tuple(
+            elts=list(getattr(stmt, "targets", []))
+            if isinstance(stmt, ast.Assign) else [], ctx=ast.Store()))
+        for i in indices:
+            if i >= len(node.args):
+                continue
+            name = dotted(node.args[i])
+            if name is None:
+                continue   # a fresh expression: nothing to re-read
+            if name in rebound:
+                continue
+            if _in_loop_stmt(stmt, fn):
+                yield mod.finding(
+                    rule, node,
+                    f"donated operand `{name}` (position {i}) is "
+                    f"not rebound by the call statement inside a "
+                    f"loop — the next iteration passes a deleted "
+                    f"buffer")
+                continue
+            read = _later_read(stmt, name, fn)
+            if read is not None:
+                yield mod.finding(
+                    rule, read,
+                    f"donated operand `{name}` (donated at line "
+                    f"{node.lineno}) read after the donating call "
+                    f"— the buffer no longer exists")
+
+
+def _in_loop_stmt(stmt: ast.stmt, fn) -> bool:
+    for a in ancestors(stmt):
+        if a is fn:
+            return False
+        if isinstance(a, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+    return False
+
+
+def _later_read(stmt: ast.stmt, name: str, fn) -> Optional[ast.AST]:
+    """First Load of `name` in a statement after `stmt` in the same
+    (innermost) body list, before any rebinding statement."""
+    p = getattr(stmt, "jt_parent", None)
+    body = getattr(p, "body", None)
+    if not isinstance(body, list) or stmt not in body:
+        return None
+    after = body[body.index(stmt) + 1:]
+    for s in after:
+        for n in ast.walk(s):
+            if isinstance(n, (ast.Name, ast.Attribute)) \
+                    and isinstance(getattr(n, "ctx", None), ast.Load) \
+                    and dotted(n) == name:
+                return n
+        if isinstance(s, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            tgts = (s.targets if isinstance(s, ast.Assign)
+                    else [s.target])
+            if any(name in assigned_names(t) for t in tgts):
+                return None
+    return None
+
+
 @register
 class DonationReadRule(Rule):
     id = "JTL102"
@@ -166,77 +245,4 @@ class DonationReadRule(Rule):
                 d = resolver.expr(node.value)
                 if d is not None:
                     local[node.targets[0].id] = d
-        for node in walk_same_scope(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            indices = self._call_donates(node, local, resolver)
-            if not indices:
-                continue
-            stmt = statement_of(node)
-            rebound = assigned_names(ast.Tuple(
-                elts=list(getattr(stmt, "targets", []))
-                if isinstance(stmt, ast.Assign) else [], ctx=ast.Store()))
-            for i in indices:
-                if i >= len(node.args):
-                    continue
-                name = dotted(node.args[i])
-                if name is None:
-                    continue   # a fresh expression: nothing to re-read
-                if name in rebound:
-                    continue
-                if self._in_loop_stmt(stmt, fn):
-                    yield mod.finding(
-                        self, node,
-                        f"donated operand `{name}` (position {i}) is "
-                        f"not rebound by the call statement inside a "
-                        f"loop — the next iteration passes a deleted "
-                        f"buffer")
-                    continue
-                read = self._later_read(stmt, name, fn)
-                if read is not None:
-                    yield mod.finding(
-                        self, read,
-                        f"donated operand `{name}` (donated at line "
-                        f"{node.lineno}) read after the donating call "
-                        f"— the buffer no longer exists")
-
-    def _call_donates(self, call: ast.Call, local: dict,
-                      resolver: _Resolver) -> Optional[tuple[int, ...]]:
-        f = call.func
-        if isinstance(f, ast.Name):
-            if f.id in local:
-                return local[f.id]
-            return None   # bare function NAME calls: only via binding
-        if isinstance(f, ast.Call):
-            return resolver.expr(f)
-        return None
-
-    def _in_loop_stmt(self, stmt: ast.stmt, fn) -> bool:
-        for a in ancestors(stmt):
-            if a is fn:
-                return False
-            if isinstance(a, (ast.For, ast.AsyncFor, ast.While)):
-                return True
-        return False
-
-    def _later_read(self, stmt: ast.stmt, name: str, fn
-                    ) -> Optional[ast.AST]:
-        """First Load of `name` in a statement after `stmt` in the same
-        (innermost) body list, before any rebinding statement."""
-        p = getattr(stmt, "jt_parent", None)
-        body = getattr(p, "body", None)
-        if not isinstance(body, list) or stmt not in body:
-            return None
-        after = body[body.index(stmt) + 1:]
-        for s in after:
-            for n in ast.walk(s):
-                if isinstance(n, (ast.Name, ast.Attribute)) \
-                        and isinstance(getattr(n, "ctx", None), ast.Load) \
-                        and dotted(n) == name:
-                    return n
-            if isinstance(s, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-                tgts = (s.targets if isinstance(s, ast.Assign)
-                        else [s.target])
-                if any(name in assigned_names(t) for t in tgts):
-                    return None
-        return None
+        yield from scan_donation_sites(fn, mod, self, local, resolver.expr)

@@ -1,0 +1,507 @@
+"""JTL4xx — interprocedural flow rules over the jtflow contract graph.
+
+Where the JTL1xx/2xx rules see one file at a time, these run over the
+whole-program ``FlowIndex`` (analysis/flow/) and check the contracts
+that *span* modules — the drift class the PR 3 PACKED_FIELDS 5→6
+widening and the PR 7 ``/metrics`` family collision belong to:
+
+  JTL401 packed-schema drift     producer/consumer column-width and
+                                 annotation drift against the declared
+                                 packed-result schemas
+  JTL402 cross-module donation   read-after-donation through
+                                 factory→_CACHE→instrument_kernel edges
+                                 that cross module boundaries (the
+                                 interprocedural half of JTL102)
+  JTL403 sharding-axis contract  a collective's axis name absent from
+                                 every mesh construction; packed-table
+                                 word-width math disagreeing with the
+                                 declared table-word-bits
+  JTL404 resumable-carry drift   consumers touching carry fields the
+                                 kernel's NamedTuple does not declare
+  JTL405 metric contract         snapshot-contract keys not
+                                 pre-registered; dynamic metric
+                                 families colliding with plain names
+                                 outside export.LABELED_FAMILIES
+  JTL406 contracts-sync          contracts.json stale against the tree
+                                 (regenerate-and-diff, the limits-doc
+                                 discipline)
+
+All six are ProjectRules sharing ONE FlowIndex per lint invocation
+(the engine's ProjectContext); a direct ``check_project(root)`` call
+builds its own, which is how the fixture mini-projects under
+tests/lint_fixtures/flow_*/ are exercised.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterator, Optional
+
+from ..core import ModuleSource, PACKAGE_NAME, ProjectRule, register
+from ..findings import Finding
+
+# NamedTuple API surface that is not a field access.
+_NT_API = {"_replace", "_asdict", "_fields", "_make", "count", "index"}
+
+_PACKED_DIRECTIVES = ("packs", "unpacks", "packed", "packed-width",
+                      "partials", "partials-from")
+_ALL_DIRECTIVES = _PACKED_DIRECTIVES + ("mesh-axes", "table-word-bits",
+                                        "metrics")
+
+
+class FlowRule(ProjectRule):
+    """Shared plumbing: resolve the FlowIndex/FlowFacts for a root,
+    through the engine's shared context when one is provided."""
+
+    def _facts(self, root: Path, ctx=None):
+        from ..flow.facts import flow_facts
+        from ..flow.index import FlowIndex
+
+        index = None
+        if ctx is not None and hasattr(ctx, "flow_index"):
+            index = ctx.flow_index()
+        if index is None:
+            index = FlowIndex.build(Path(root))
+        return flow_facts(index)
+
+    def check_project(self, root: Path, ctx=None) -> list[Finding]:
+        return list(self._check(self._facts(root, ctx)))
+
+    def _check(self, facts) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+def _stack_widths(scope: ast.AST) -> list[int]:
+    """Element counts of every `*.stack([...])` call under `scope`."""
+    out = []
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "stack" and node.args \
+                and isinstance(node.args[0], (ast.List, ast.Tuple)):
+            out.append(len(node.args[0].elts))
+    return out
+
+
+def _row_widths(scope: ast.AST) -> list[int]:
+    """Widths of the row a statement builds: stack([...]) calls when
+    present, else bare tuple literals (the wgl2 host-checkpoint shape
+    `ckpt = (states, masks, valid, step)`)."""
+    widths = _stack_widths(scope)
+    if widths:
+        return widths
+    return [len(n.elts) for n in ast.walk(scope)
+            if isinstance(n, (ast.Tuple, ast.List))
+            and isinstance(getattr(n, "ctx", None), ast.Load) and n.elts]
+
+
+def _max_trailing_index(scope: ast.AST) -> Optional[int]:
+    """Max constant column index over `X[..., c]` subscripts."""
+    best = None
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Subscript):
+            continue
+        sl = node.slice
+        if isinstance(sl, ast.Tuple) and sl.elts \
+                and isinstance(sl.elts[-1], ast.Constant) \
+                and isinstance(sl.elts[-1].value, int) \
+                and any(isinstance(e, ast.Constant) and e.value is Ellipsis
+                        for e in sl.elts[:-1]):
+            c = sl.elts[-1].value
+            best = c if best is None else max(best, c)
+    return best
+
+
+def _stmt_int_literals(stmt: ast.stmt) -> set[int]:
+    return {n.value for n in ast.walk(stmt)
+            if isinstance(n, ast.Constant) and isinstance(n.value, int)
+            and not isinstance(n.value, bool)}
+
+
+@register
+class PackedSchemaDriftRule(FlowRule):
+    id = "JTL401"
+    name = "packed-schema-drift"
+    scopes = None
+    rationale = (
+        "PR 3 widened wgl3.PACKED_FIELDS from 5 to 6 columns and had to "
+        "hand-patch unpack_np, parallel/dense.py, parallel/multislice.py "
+        "and the __graft_entry__ shard-shape assert — a consumer "
+        "unpacking a width its producer doesn't emit reads garbage "
+        "columns or asserts on every launch")
+    hint = ("derive widths from the schema tuple (len(PACKED_FIELDS*)) "
+            "or keep the `# jtflow:` annotation's literal in step with "
+            "the declared field tuple")
+
+    def _check(self, facts) -> Iterator[Finding]:
+        for a in facts.annotations:
+            yield from self._check_annotation(facts, a)
+
+    def _check_annotation(self, facts, a) -> Iterator[Finding]:
+        mod: ModuleSource = a.mod
+        if a.directive not in _ALL_DIRECTIVES:
+            yield mod.finding(self, a.line,
+                              f"unknown jtflow directive "
+                              f"`{a.directive}` — the contract it meant "
+                              f"to declare is not being checked")
+            return
+        if a.node is None:
+            yield mod.finding(self, a.line,
+                              f"jtflow `{a.directive}` annotation does "
+                              f"not bind to a statement (stale "
+                              f"annotation — nothing is verified)")
+            return
+        if a.directive == "table-word-bits":
+            try:
+                int(a.arg)
+            except ValueError:
+                yield mod.finding(self, a.line,
+                                  f"table-word-bits needs an integer, "
+                                  f"got {a.arg!r}")
+            return
+        if a.directive not in _PACKED_DIRECTIVES:
+            return
+        if a.directive == "partials":
+            names = tuple(s.strip() for s in a.arg.split(",") if s.strip())
+            widths = _row_widths(a.node)
+            if not widths:
+                yield mod.finding(self, a.line,
+                                  "partials annotation binds to a "
+                                  "statement without a stack([...]) or "
+                                  "row tuple — nothing to verify")
+            elif widths[-1] != len(names):
+                yield mod.finding(
+                    self, a.node,
+                    f"partial-sum layout drift: {len(names)} field(s) "
+                    f"declared ({', '.join(names)}) but the stacked "
+                    f"accumulator has {widths[-1]} element(s)")
+            return
+        if a.directive == "partials-from":
+            yield from self._check_partials_from(facts, a)
+            return
+        # packs / unpacks / packed / packed-width share a schema ref.
+        parts = a.arg.split()
+        ref = parts[-1] if parts else ""
+        schema = facts.schemas.get(ref)
+        if schema is None:
+            yield mod.finding(self, a.line,
+                              f"jtflow {a.directive} references unknown "
+                              f"packed schema {ref!r} (known: "
+                              f"{', '.join(sorted(facts.schemas)) or 'none'})")
+            return
+        if a.directive == "packed-width":
+            try:
+                lit = int(parts[0])
+            except (ValueError, IndexError):
+                yield mod.finding(self, a.line,
+                                  f"packed-width needs `packed-width=N "
+                                  f"<schema>`, got {a.arg!r}")
+                return
+            if lit != schema.width:
+                yield mod.finding(
+                    self, a.node,
+                    f"packed-width drift: literal {lit} vs "
+                    f"{schema.ref} = {schema.width} column(s) "
+                    f"({', '.join(schema.fields)})")
+            elif lit not in _stmt_int_literals(a.node):
+                yield mod.finding(
+                    self, a.line,
+                    f"stale packed-width annotation: literal {lit} no "
+                    f"longer appears in the annotated statement")
+        elif a.directive == "packs":
+            widths = _stack_widths(a.node)
+            if not widths:
+                yield mod.finding(self, a.line,
+                                  f"packs annotation on "
+                                  f"{getattr(a.node, 'name', 'statement')!r} "
+                                  f"found no stack([...]) to verify")
+            elif widths[-1] != schema.width:
+                yield mod.finding(
+                    self, a.node,
+                    f"packed-schema drift: producer stacks "
+                    f"{widths[-1]} column(s) but {schema.ref} declares "
+                    f"{schema.width} ({', '.join(schema.fields)})")
+        elif a.directive == "unpacks":
+            top = _max_trailing_index(a.node)
+            if top is None:
+                yield mod.finding(self, a.line,
+                                  "unpacks annotation found no "
+                                  "`x[..., i]` column reads to verify")
+            elif top != schema.width - 1:
+                yield mod.finding(
+                    self, a.node,
+                    f"packed-schema drift: consumer reads column "
+                    f"{top} but {schema.ref} declares {schema.width} "
+                    f"column(s) ({', '.join(schema.fields)}) — max "
+                    f"index {schema.width - 1}")
+        # "packed" is declarative: the schema resolving is the check.
+
+    def _check_partials_from(self, facts, a) -> Iterator[Finding]:
+        mod = a.mod
+        layout = facts.partial_layouts.get(a.arg)
+        if layout is None:
+            yield mod.finding(
+                self, a.line,
+                f"partials-from references {a.arg!r}, which declares no "
+                f"`# jtflow: partials` layout (known: "
+                f"{', '.join(sorted(facts.partial_layouts)) or 'none'})")
+            return
+        header = (_stack_widths(a.node) or [0])[0]
+        total = header + len(layout)
+        target = None
+        if isinstance(a.node, ast.Assign) and len(a.node.targets) == 1 \
+                and isinstance(a.node.targets[0], ast.Name):
+            target = a.node.targets[0].id
+        if target is None:
+            return
+        body = getattr(getattr(a.node, "jt_parent", None), "body", None)
+        if not isinstance(body, list) or a.node not in body:
+            return
+        for s in body[body.index(a.node) + 1:]:
+            for n in ast.walk(s):
+                if isinstance(n, ast.Subscript) \
+                        and isinstance(n.value, ast.Name) \
+                        and n.value.id == target \
+                        and isinstance(n.slice, ast.Constant) \
+                        and isinstance(n.slice.value, int) \
+                        and n.slice.value >= total:
+                    yield mod.finding(
+                        self, n,
+                        f"partial-sum drift: `{target}[{n.slice.value}]` "
+                        f"reads past the {total} column(s) the "
+                        f"{a.arg} layout emits ({header} verdict + "
+                        f"{len(layout)} partials: {', '.join(layout)})")
+
+
+@register
+class CrossDonationRule(FlowRule):
+    id = "JTL402"
+    name = "cross-module-donation"
+    scopes = None
+    rationale = (
+        "the donating kernels live behind factories in ops/ while their "
+        "carries are threaded from stream/sched/checkers — JTL102 "
+        "resolves donation only inside one file, so a cross-module "
+        "consumer reading a donated carry after the call (or not "
+        "rebinding it in a loop) was invisible until this pass")
+    hint = ("rebind the donated operand from the call's result in the "
+            "same statement (`carry, part = run(carry, ...)`)")
+
+    def _check(self, facts) -> Iterator[Finding]:
+        from ..astutil import walk_same_scope
+        from ..flow.facts import contract_modules
+        from .donation import scan_donation_sites
+
+        index = facts.index
+        for mod in contract_modules(index):
+            for fn in mod.walk_nodes():
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                local: dict[str, tuple[int, ...]] = {}
+                for node in walk_same_scope(fn):
+                    if isinstance(node, ast.Assign) \
+                            and len(node.targets) == 1 \
+                            and isinstance(node.targets[0], ast.Name):
+                        d = index.donates(mod, node.value)
+                        if d is not None and d[1]:      # cross-module only
+                            local[node.targets[0].id] = d[0]
+
+                def expr_donates(call):
+                    d = index.donates(mod, call)
+                    return d[0] if d is not None and d[1] else None
+
+                yield from scan_donation_sites(fn, mod, self, local,
+                                               expr_donates)
+
+
+@register
+class ShardingAxisRule(FlowRule):
+    id = "JTL403"
+    name = "sharding-axis-contract"
+    scopes = None
+    rationale = (
+        "a collective (psum/pmax/ppermute) naming an axis no mesh "
+        "construction declares fails at trace time on the first real "
+        "pod — or silently binds to the wrong axis after a mesh rename; "
+        "and the packed-table word math (`1 << (K - 5)`) is duplicated "
+        "across wgl3/sparse/lattice, so one module changing the word "
+        "packing strands the others' shard-width arithmetic")
+    hint = ("declare the axis in the mesh construction (make_mesh/"
+            "Mesh/`# jtflow: mesh-axes`) or fix the collective's axis "
+            "name; keep word-width shifts equal to the declared "
+            "`# jtflow: table-word-bits`")
+
+    def _check(self, facts) -> Iterator[Finding]:
+        declared = set(facts.mesh_axes)
+        if declared:          # no meshes at all: nothing to check against
+            for use in facts.axis_uses:
+                if use.axis not in declared:
+                    yield use.mod.finding(
+                        self, use.line,
+                        f"{use.kind} uses axis {use.axis!r}, which no "
+                        f"mesh construction declares (declared: "
+                        f"{', '.join(sorted(declared))})")
+        if facts.table_word_bits is not None:
+            bits, decl_mod, decl_line = facts.table_word_bits
+            for mod, line, n in facts.word_shifts:
+                if n != bits:
+                    yield mod.finding(
+                        self, line,
+                        f"packed-table word math uses `1 << (K - {n})` "
+                        f"but table-word-bits={bits} is declared at "
+                        f"{decl_mod}:{decl_line} — shard widths "
+                        f"diverge")
+
+
+@register
+class CarryDriftRule(FlowRule):
+    id = "JTL404"
+    name = "resumable-carry-drift"
+    scopes = None
+    rationale = (
+        "the resumable chunk kernels thread NamedTuple carries "
+        "(wgl3._Carry3) through stream/sched checkpoint-restore paths "
+        "in OTHER modules; a field renamed in the kernel leaves the "
+        "consumer reading an attribute that no longer exists — an "
+        "AttributeError mid-run at best, a stale checkpoint at worst")
+    hint = ("read only fields the carry NamedTuple declares; extend the "
+            "NamedTuple (and its _init_carry* factory) first when the "
+            "consumer needs more state")
+
+    def _check(self, facts) -> Iterator[Finding]:
+        from ..astutil import dotted, enclosing_class, enclosing_function
+        from ..flow.facts import contract_modules
+
+        index = facts.index
+        if not facts.carry_factories:
+            return
+        for mod in contract_modules(index):
+            for node in mod.walk_nodes():
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                origin = mod.imports.resolve(node.value.func) or ""
+                key = ".".join(origin.split(".")[-2:])
+                carry_cls = facts.carry_factories.get(key)
+                if carry_cls is None:
+                    continue
+                carry = facts.carries[carry_cls]
+                target = dotted(node.targets[0])
+                if target is None:
+                    continue
+                scope = (enclosing_class(node) if target.startswith("self.")
+                         else enclosing_function(node)) or mod.tree
+                for read in ast.walk(scope):
+                    if not isinstance(read, ast.Attribute):
+                        continue
+                    chain = dotted(read)
+                    if chain is None \
+                            or not chain.startswith(target + "."):
+                        continue
+                    attr = chain[len(target) + 1:]
+                    if "." in attr:
+                        attr = attr.split(".", 1)[0]
+                    if attr in carry.fields or attr in _NT_API:
+                        continue
+                    yield mod.finding(
+                        self, read,
+                        f"`{target}.{attr}` is not a field of "
+                        f"{carry_cls} ({carry.module} declares: "
+                        f"{', '.join(carry.fields)}) — carry contract "
+                        f"drift")
+
+
+@register
+class MetricContractRule(FlowRule):
+    id = "JTL405"
+    name = "metric-contract"
+    scopes = None
+    rationale = (
+        "the bench/web snapshot contract is 'zeros permitted, never "
+        "absent': a key the stats readers fetch but no capture "
+        "pre-registers vanishes from metrics.json on quiet runs; and "
+        "PR 7's /metrics collision (per-kernel wgl.compile_s.<k> "
+        "summaries against the plain wgl.compile_s counter) rendered "
+        "one family with two TYPE lines, invalidating the whole scrape")
+    hint = ("add the key to the pre-registered capture() tuples "
+            "(obs/__init__.py), or register the dynamic family in "
+            "obs/export.py LABELED_FAMILIES so it exports under a "
+            "`_by_<label>` suffix")
+
+    def _check(self, facts) -> Iterator[Finding]:
+        prereg = set(facts.preregistered)
+        if facts.prereg_modules:
+            for mod, line, name in facts.snapshot_reads:
+                if name not in prereg:
+                    yield mod.finding(
+                        self, line,
+                        f"snapshot contract key {name!r} is not "
+                        f"pre-registered by capture() — absent (not "
+                        f"zero) on runs that never touch it")
+            # Pre-registered names nothing writes: dead contract weight.
+            literal_writes = {w.name for w in facts.metric_writes
+                              if w.name is not None}
+            families = [w.family for w in facts.metric_writes if w.family]
+            for name in sorted(prereg):
+                if name in literal_writes:
+                    continue
+                if any(name.startswith(f) for f in families):
+                    continue
+                decl_mod, decl_line = facts.preregistered[name]
+                m = facts.index.modules.get(decl_mod)
+                if m is not None:
+                    yield m.finding(
+                        self, decl_line,
+                        f"pre-registered metric {name!r} has no writer "
+                        f"anywhere in the project — stale contract "
+                        f"entry")
+        # The PR 7 collision class, statically: a dynamic family whose
+        # prefix is also a plain metric name must be a LABELED_FAMILIES
+        # member (the exporter then folds it under `_by_<label>`).
+        plain = {w.name for w in facts.metric_writes if w.name is not None}
+        for w in facts.metric_writes:
+            if w.family and w.family in plain \
+                    and w.family not in facts.labeled_families:
+                yield w.mod.finding(
+                    self, w.line,
+                    f"dynamic metric family `{w.family}.<member>` "
+                    f"collides with the plain metric {w.family!r} and "
+                    f"is not in export LABELED_FAMILIES — /metrics "
+                    f"would render one family with two TYPE lines "
+                    f"(invalid exposition, the PR 7 incident)")
+
+
+@register
+class ContractsSyncRule(FlowRule):
+    id = "JTL406"
+    name = "contracts-sync"
+    scopes = None
+    rationale = (
+        "contracts.json is the reviewed statement of the kernel "
+        "interfaces (and the seed for ROADMAP item 5's KernelPlan); a "
+        "stale copy silently re-legitimizes drift the flow rules exist "
+        "to catch — regenerate-and-diff, the limits-doc discipline")
+    hint = "run `jepsen-tpu lint --write-contracts` and review the diff"
+
+    def check_project(self, root: Path, ctx=None) -> list[Finding]:
+        root = Path(root)
+        if not (root / PACKAGE_NAME).is_dir():
+            return []        # fixture mini-projects / foreign trees
+        from ..flow.contracts import CONTRACTS_FILE, contracts_in_sync
+
+        index = None
+        if ctx is not None and hasattr(ctx, "flow_index"):
+            index = ctx.flow_index()
+        ok, detail = contracts_in_sync(root, index=index)
+        if ok:
+            return []
+        return [Finding(rule=self.id, path=CONTRACTS_FILE, line=1,
+                        message=detail, hint=self.hint)]
+
+    def covered_paths(self, root: Path) -> list[str]:
+        from ..flow.contracts import CONTRACTS_FILE
+
+        return [CONTRACTS_FILE]

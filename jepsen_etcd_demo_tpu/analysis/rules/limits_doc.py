@@ -121,7 +121,7 @@ class LimitsDocRule(ProjectRule):
     def covered_paths(self, root: Path) -> list[str]:
         return [self.doc_relpath] if self._applicable(root) else []
 
-    def check_project(self, root: Path) -> list[Finding]:
+    def check_project(self, root: Path, ctx=None) -> list[Finding]:
         if not self._applicable(root):
             return []
         doc = Path(root) / self.doc_relpath

@@ -237,8 +237,10 @@ def build_parser() -> argparse.ArgumentParser:
     # (analysis/ is jax-free and must stay fast — tier-1 runs it).
     sub.add_parser(
         "lint", add_help=False,
-        help="jtlint: JAX kernel hygiene + concurrency static analysis "
-             "(doc/analysis.md; --strict gates tier-1)")
+        help="jtlint: JAX kernel hygiene + concurrency + jtflow "
+             "cross-module contract analysis (doc/analysis.md; "
+             "--strict gates tier-1, --changed/--format sarif for CI, "
+             "--contracts/--write-contracts for contracts.json)")
     return p
 
 

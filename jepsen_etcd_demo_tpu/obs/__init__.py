@@ -71,12 +71,20 @@ METRICS_FILE = "metrics.json"
 KERNEL_COST_ENV = "JEPSEN_TPU_KERNEL_COST"
 
 # The bench/e2e contract keys: pre-registered at zero on every capture.
+# The jtflow "metrics preregistered" hooks below declare the
+# pre-registration set to the flow pass (JTL405): a key the snapshot
+# readers (kernel_phases / sched_stats / sweep_stats) fetch but no
+# capture pre-registers would be ABSENT — not zero — on quiet runs,
+# breaking the "zeros permitted, never absent" artifact contract.
+# jtflow: metrics preregistered
 PHASE_COUNTERS = ("wgl.compile_s", "wgl.execute_s", "encode.encode_s")
+# jtflow: metrics preregistered
 PHASE_GAUGE = "wgl.frontier_peak"
 # Corpus-scheduler accounting (sched/): padded-vs-real step counters
 # behind the bench's padding_waste field and the kernel-LRU hit/miss
 # counters behind cache_hit_rate — pre-registered so the artifacts carry
 # zeros, never absences, even for runs that never launch a batch.
+# jtflow: metrics preregistered
 SCHED_COUNTERS = ("sched.steps_real", "sched.steps_padded",
                   "sched.cache_hits", "sched.cache_misses",
                   "encode.cache_hits", "encode.cache_misses")
@@ -84,26 +92,32 @@ SCHED_COUNTERS = ("sched.steps_real", "sched.steps_padded",
 # per-mode step counters plus the live-tile occupancy gauge — pre-
 # registered so every dense-kernel run's metrics.json carries them
 # (zeros permitted, never absent; the web UI renders both).
+# jtflow: metrics preregistered
 SWEEP_COUNTERS = ("wgl.sweep_steps_sparse", "wgl.sweep_steps_dense",
                   "wgl.sweep_checks_sparse", "wgl.sweep_checks_dense",
                   "wgl.sweep_checks_mixed")
+# jtflow: metrics preregistered
 SWEEP_GAUGE = "wgl.live_tile_ratio"
 # Streaming check engine (stream/engine.py): fraction of return steps
 # swept while the run was still live, and the watermark's lag behind
 # the recorder (history entries recorded but not yet stable) — pre-
 # registered so every run's metrics.json carries them (zeros permitted,
 # never absent; a post-hoc run simply records zeros).
+# jtflow: metrics preregistered
 STREAM_GAUGES = ("stream.overlap_ratio", "stream.watermark_lag")
 # Deep kernel attribution (ISSUE 8): XLA cost_analysis totals captured
 # by instrument_kernel at lower time, plus the device-memory high-water
 # mark — behind kernel_phases' flops / bytes / device_mem_peak fields.
 # Tracer truncation (trace.dropped_records) rides along so a truncated
 # telemetry.jsonl is visible in metrics too, not only the footer.
+# jtflow: metrics preregistered
 COST_COUNTERS = ("wgl.flops", "wgl.bytes_accessed",
                  "trace.dropped_records")
+# jtflow: metrics preregistered
 COST_GAUGE = "wgl.device_mem_peak"
 # Backend health supervisor (obs/health.py): 0 healthy / 1 degraded /
 # 2 wedged, set on every transition.
+# jtflow: metrics preregistered
 HEALTH_GAUGE = "health.state"
 
 _NULL_TRACER = Tracer(enabled=False)

@@ -519,6 +519,9 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
             # clean). Later in-flight chunks are death-sticky no-ops —
             # drop them; `out` carries the exact final verdict fields.
             if keep_death_checkpoint:
+                # The host checkpoint row checkers/witness.py replays
+                # from (reconstruct_witness_from_sort_checkpoint).
+                # jtflow: partials states,masks,valid,checkpoint_step
                 death_ckpt = (np.asarray(pre.states),
                               np.asarray(pre.masks),
                               np.asarray(pre.valid), c0)

@@ -109,7 +109,11 @@ class _Carry3(NamedTuple):
 
 
 # LO_MASK[j] (j < 5): bits p in 0..31 whose index has bit j CLEAR — the
-# in-word "mask bit j not yet fired" positions.
+# in-word "mask bit j not yet fired" positions. 32 = 2^5 configs pack
+# per u32 word: every `1 << (K - 5)` table-width computation here and in
+# wgl3_sparse/wgl3_pallas/parallel.lattice derives from THIS packing —
+# the jtflow pass (JTL403) pins their shift literals to it.
+# jtflow: table-word-bits=5
 _LO_MASK = tuple(
     np.uint32(sum(1 << p for p in range(32) if not (p >> j) & 1))
     for j in range(5))
@@ -357,7 +361,9 @@ def _chunk_fn(model: Model, cfg: DenseConfig):
         idxs = idx0 + jnp.arange(tgts.shape[0], dtype=jnp.int32)
         carry, (ns, lives) = jax.lax.scan(step, carry, (trans, tgts, idxs))
         # Partial sums accumulate device-side across chunks, fetched once
-        # at the end: [configs_explored, live-tile sum, real steps].
+        # at the end — the row layout every chunk consumer (the long
+        # sweep below, stream/engine.py finalize) indexes into.
+        # jtflow: partials configs_explored,live_tile_sum,real_steps
         return carry, jnp.stack([
             jnp.sum(ns.astype(jnp.float32)),
             jnp.sum(lives.astype(jnp.float32)),
@@ -511,7 +517,9 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
 
     if cfgs_dev is None:
         cfgs_dev = jnp.zeros((3,), jnp.float32)
-    # One packed fetch at the end (chunks chain device-side).
+    # One packed fetch at the end (chunks chain device-side): 3 verdict
+    # fields + the chunk fn's declared partial row.
+    # jtflow: partials-from wgl3._chunk_fn
     packed = np.asarray(jnp.concatenate([
         jnp.stack([jnp.where(carry.dead, 0, 1),
                    carry.dead_step, carry.max_frontier]),
@@ -594,6 +602,7 @@ PACKED_FIELDS = ("survived", "overflow", "dead_step", "max_frontier",
 PACKED_FIELDS_XLA = PACKED_FIELDS + ("live_tile_pm",)
 
 
+# jtflow: packs wgl3.PACKED_FIELDS_XLA
 def _pack_result(out: dict) -> jax.Array:
     cfgs = jnp.clip(out["configs_explored"], 0, 2**31 - 1).astype(jnp.int32)
     return jnp.stack([out["survived"].astype(jnp.int32),
@@ -602,6 +611,7 @@ def _pack_result(out: dict) -> jax.Array:
                       out["live_tile_pm"]], axis=-1)
 
 
+# jtflow: unpacks wgl3.PACKED_FIELDS_XLA
 def unpack_np(arr) -> dict:
     """np i32[..., 5|6] (one fetch) -> result dict of np arrays/scalars.
     The 6th column (live_tile_pm), when present, is the XLA checkers'
@@ -630,6 +640,7 @@ def cached_checker3_packed(model: Model, cfg: DenseConfig):
     key = ("single3p", model.cache_key(), cfg)
     if key not in _CACHE:
         fn = _check_one_fn(model, cfg)
+        # jtflow: packed wgl3.PACKED_FIELDS_XLA
         _CACHE[key] = instrument_kernel(
             "wgl3-single", jax.jit(lambda *a: _pack_result(fn(*a))))
     return _CACHE[key]
@@ -639,6 +650,7 @@ def cached_batch_checker3_packed(model: Model, cfg: DenseConfig):
     key = ("batch3p", model.cache_key(), cfg)
     if key not in _CACHE:
         fn = jax.vmap(_check_one_fn(model, cfg))
+        # jtflow: packed wgl3.PACKED_FIELDS_XLA
         _CACHE[key] = instrument_kernel(
             "wgl3-batch", jax.jit(lambda *a: _pack_result(fn(*a))))
     return _CACHE[key]

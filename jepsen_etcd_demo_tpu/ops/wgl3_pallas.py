@@ -346,6 +346,7 @@ def _kernel_body(cfg: DenseConfig, resume: bool = False):
         # outright, so per-program blocks are not an option either.
         @pl.when(c == NC - 1)
         def _emit():
+            # jtflow: packed-width=5 wgl3.PACKED_FIELDS
             out_ref[5 * b + 0] = jnp.where(dead, 0, 1).astype(jnp.int32)
             out_ref[5 * b + 1] = jnp.int32(0)  # overflow: impossible (dense)
             out_ref[5 * b + 2] = dead_step
@@ -1067,6 +1068,7 @@ def local_pallas_launcher(model: Model, cfg: DenseConfig,
                 interpret=interpret,
             )(ln, tg, cm)[0].reshape(B, 5)
 
+        # jtflow: packed wgl3.PACKED_FIELDS
         return instrument_kernel("wgl3-pallas", jax.jit(run))
 
     return launch
@@ -1367,6 +1369,7 @@ def local_pallas_launcher_grouped(model: Model, cfg: DenseConfig, G: int,
                 interpret=interpret,
             )(ln, tg, cm)[0].reshape(B, 5)
 
+        # jtflow: packed wgl3.PACKED_FIELDS
         return instrument_kernel("wgl3-pallas-grouped", jax.jit(run))
 
     return launch

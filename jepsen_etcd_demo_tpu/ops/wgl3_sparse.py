@@ -266,6 +266,7 @@ def _chunk_fn_sparse(model: Model, cfg: DenseConfig, plan: SparsePlan):
         idxs = idx0 + jnp.arange(tgts.shape[0], dtype=jnp.int32)
         carry, (ns, lives, sp) = jax.lax.scan(step, carry,
                                               (trans, tgts, idxs))
+        # jtflow: partials configs_explored,live_tile_sum,real_steps,sparse_steps
         return carry, jnp.stack([
             jnp.sum(ns.astype(jnp.float32)),
             jnp.sum(lives.astype(jnp.float32)),
@@ -351,6 +352,7 @@ def check_steps3_long_sparse(rs: ReturnSteps, model: Model,
 
     if parts_dev is None:
         parts_dev = jnp.zeros((4,), jnp.float32)
+    # jtflow: partials-from wgl3_sparse._chunk_fn_sparse
     packed = np.asarray(jnp.concatenate([
         jnp.stack([jnp.where(carry.dead, 0, 1),
                    carry.dead_step, carry.max_frontier]),

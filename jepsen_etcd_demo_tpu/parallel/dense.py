@@ -73,6 +73,7 @@ def sharded_batch_checker3_packed(model: Model, cfg: DenseConfig,
         # sharded lane too — under virtual-device CI this IS the
         # production dense path, and it must not be a telemetry blind
         # spot.
+        # jtflow: packed wgl3.PACKED_FIELDS_XLA
         _CACHE[key] = instrument_kernel(
             "wgl3-dense-sharded",
             jax.jit(lambda *a: wgl3._pack_result(fn(*a)),

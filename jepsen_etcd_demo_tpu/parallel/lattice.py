@@ -368,6 +368,7 @@ def make_lattice_chunk_fn(model: Model, cfg: DenseConfig, mesh: Mesh,
         idxs = idx0 + jnp.arange(tgts.shape[0], dtype=jnp.int32)
         (table, dead, dead_step, maxf), (ns, lives, sp) = jax.lax.scan(
             step, (table, dead, dead_step, maxf), (trans, tgts, idxs))
+        # jtflow: partials configs_explored,live_tile_sum,real_steps,sparse_steps
         parts = jnp.stack([
             jnp.sum(ns.astype(jnp.float32)),
             jnp.sum(lives.astype(jnp.float32)),
@@ -473,6 +474,7 @@ def check_steps_lattice_long(rs: ReturnSteps, model: Model,
             break
     if cfgs_dev is None:
         cfgs_dev = jnp.zeros((4,), jnp.float32)
+    # jtflow: partials-from lattice.make_lattice_chunk_fn
     parts = np.asarray(jnp.clip(cfgs_dev, 0, 2**31 - 1).astype(jnp.int32))
     out = {
         "survived": not bool(np.asarray(dead)),

@@ -53,6 +53,7 @@ def init_multislice(coordinator: str, num_processes: int, process_id: int,
                                process_id=process_id)
 
 
+# jtflow: mesh-axes slice,batch
 def multislice_mesh(slice_axis: str = "slice", batch_axis: str = "batch"):
     """2D mesh over ALL global devices: [processes, devices-per-process].
     The outer (process-major) axis is the DCN axis."""

@@ -74,8 +74,15 @@ def _pad_rs(k_slots: int):
 
 def _dense_bucket_launcher(model, cfg, b: int, r: int):
     """Resolved packed checker for one (batch, step) bucket shape, from
-    the sched kernel LRU: run(tabs, act, tgt) -> DEVICE i32[b, 5]
-    (wgl3.PACKED_FIELDS). Returns (run, kernel_name)."""
+    the sched kernel LRU: run(tabs, act, tgt) -> DEVICE packed i32 rows.
+    The single-device route (wgl3_pallas.packed_batch_checker) emits
+    i32[b, 5] (wgl3.PACKED_FIELDS); the sharded route emits i32[b, 6]
+    (wgl3.PACKED_FIELDS_XLA — the live-tile telemetry column rides
+    along). The drain unpacks through wgl3.unpack_np, which accepts
+    both widths — that dual-width contract is the one jtflow pins
+    (doc/analysis.md "Contracts"; this docstring used to claim a flat
+    i32[b, 5], the exact stale-width drift JTL401 exists for).
+    Returns (run, kernel_name)."""
     import jax
 
     mkey = model.cache_key()
@@ -90,6 +97,7 @@ def _dense_bucket_launcher(model, cfg, b: int, r: int):
             return sharded_packed_batch_checker(model, cfg, mesh,
                                                 n_steps=r, batch=b)
 
+        # jtflow: packed wgl3.PACKED_FIELDS_XLA
         return kernel_cache().get(key, build)
     key = ("sched-dense", mkey, cfg, b, r)
 
@@ -98,6 +106,7 @@ def _dense_bucket_launcher(model, cfg, b: int, r: int):
 
         return packed_batch_checker(model, cfg, n_steps=r, batch=b)
 
+    # jtflow: packed wgl3.PACKED_FIELDS
     return kernel_cache().get(key, build)
 
 

@@ -318,6 +318,9 @@ class KeyStream:
 
         parts = self.parts if self.parts is not None \
             else jnp.zeros((3,), jnp.float32)
+        # The streamed chunks ran wgl3's resumable chunk kernel, so the
+        # fetch row is 3 verdict fields + ITS declared partial layout.
+        # jtflow: partials-from wgl3._chunk_fn
         packed = np.asarray(jnp.concatenate([
             jnp.stack([jnp.where(self.carry.dead, 0, 1),
                        self.carry.dead_step, self.carry.max_frontier]),

@@ -1,0 +1,11 @@
+"""JTL403 negative, kernel side: the collective's axis is declared
+(including through a parameter default) and the word math matches the
+declared packing."""
+import jax
+import jax.numpy as jnp
+
+
+def all_reduce_density(live_loc, cfg, axis="batch"):
+    live_g = jax.lax.psum(live_loc, axis)
+    w = 1 << (cfg.k_slots - 5)
+    return live_g, jnp.int32(w)

@@ -1,0 +1,11 @@
+"""JTL403 negative, mesh side."""
+import numpy as np
+from jax.sharding import Mesh
+
+
+# jtflow: table-word-bits=5
+WORD_LANES = 32
+
+
+def batch_mesh(devs):
+    return Mesh(np.array(devs), ("batch",))

@@ -1,0 +1,13 @@
+"""JTL403 positive, kernel side: a collective naming an axis no mesh
+declares (a rename that missed this module), and shard-width math
+using the wrong word-bit literal."""
+import jax
+import jax.numpy as jnp
+
+
+def all_reduce_density(live_loc, cfg):
+    # DRIFT: no mesh construction declares a "rows" axis.
+    live_g = jax.lax.psum(live_loc, "rows")
+    # DRIFT: table words are 2^5 configs wide, not 2^6.
+    w = 1 << (cfg.k_slots - 6)
+    return live_g, jnp.int32(w)

@@ -1,0 +1,12 @@
+"""JTL403 positive, mesh side: the project declares exactly one mesh
+axis ("batch") plus the packed-table word geometry."""
+import numpy as np
+from jax.sharding import Mesh
+
+
+# jtflow: table-word-bits=5
+WORD_LANES = 32
+
+
+def batch_mesh(devs):
+    return Mesh(np.array(devs), ("batch",))

@@ -1,0 +1,18 @@
+"""JTL404 negative, consumer side: every field the checkpoint touches
+is declared by the carry (and NamedTuple API calls stay exempt)."""
+import numpy as np
+
+from producer import _init_carry
+
+
+class KeyStream:
+    def __init__(self, cfg):
+        self.carry = _init_carry(cfg)
+
+    def poll_death(self):
+        return bool(np.asarray(self.carry.dead))
+
+    def checkpoint(self):
+        return (np.asarray(self.carry.table),
+                int(np.asarray(self.carry.dead_step)),
+                self.carry._replace(dead=True))

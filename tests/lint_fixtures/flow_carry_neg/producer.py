@@ -1,0 +1,17 @@
+"""JTL404 negative, producer side: same carry + factory."""
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class _Carry(NamedTuple):
+    table: jax.Array
+    dead: jax.Array
+    dead_step: jax.Array
+
+
+def _init_carry(cfg):
+    table = jnp.zeros((cfg.n_states, cfg.n_words), jnp.uint32)
+    return _Carry(table=table, dead=jnp.bool_(False),
+                  dead_step=jnp.int32(-1))

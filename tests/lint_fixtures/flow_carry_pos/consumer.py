@@ -1,0 +1,19 @@
+"""JTL404 positive, consumer side: a streaming checkpoint path reading
+a carry field the kernel's NamedTuple renamed away (`max_frontier` ->
+gone). An AttributeError mid-run, only on the restore path."""
+import numpy as np
+
+from producer import _init_carry
+
+
+class KeyStream:
+    def __init__(self, cfg):
+        self.carry = _init_carry(cfg)
+
+    def poll_death(self):
+        return bool(np.asarray(self.carry.dead))
+
+    def checkpoint(self):
+        # DRIFT: _Carry has no `max_frontier` field.
+        return (np.asarray(self.carry.table),
+                int(np.asarray(self.carry.max_frontier)))

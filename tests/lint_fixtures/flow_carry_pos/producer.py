@@ -1,0 +1,18 @@
+"""JTL404 positive, producer side: the resumable carry NamedTuple and
+its factory (the wgl3._Carry3/_init_carry3 shape)."""
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class _Carry(NamedTuple):
+    table: jax.Array
+    dead: jax.Array
+    dead_step: jax.Array
+
+
+def _init_carry(cfg):
+    table = jnp.zeros((cfg.n_states, cfg.n_words), jnp.uint32)
+    return _Carry(table=table, dead=jnp.bool_(False),
+                  dead_step=jnp.int32(-1))

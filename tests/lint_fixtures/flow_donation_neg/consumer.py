@@ -1,0 +1,11 @@
+"""JTL402 negative, consumer side: the repo idiom — the donated carry
+rebinds from the call's result in the same statement."""
+from producer import cached_chunk_run
+
+
+def sweep(model, cfg, chunks, carry):
+    part = None
+    run = cached_chunk_run(model, cfg)
+    for c in chunks:
+        carry, part = run(carry, c.tabs, c.tgts)
+    return carry, part

@@ -1,0 +1,22 @@
+"""JTL402 negative, producer side: same donating factory as the
+positive pair."""
+import jax
+
+from obs import instrument_kernel
+
+_CACHE = {}
+
+
+def _chunk_fn(model, cfg):
+    def run(carry, tabs, tgts):
+        carry = model.step(carry, tabs, tgts)
+        return carry, tabs.sum()
+
+    return jax.jit(run, donate_argnums=(0,))
+
+
+def cached_chunk_run(model, cfg):
+    key = ("chunk", model, cfg)
+    if key not in _CACHE:
+        _CACHE[key] = instrument_kernel("chunk", _chunk_fn(model, cfg))
+    return _CACHE[key]

@@ -1,0 +1,12 @@
+"""JTL402 positive, consumer side: the donated carry is not rebound by
+the call statement inside the chunk loop — iteration 2 passes a deleted
+buffer. JTL102 cannot see this (the donation lives in producer.py)."""
+from producer import cached_chunk_run
+
+
+def sweep(model, cfg, chunks, carry):
+    run = cached_chunk_run(model, cfg)
+    out = None
+    for c in chunks:
+        out = run(carry, c.tabs, c.tgts)
+    return out

@@ -1,0 +1,24 @@
+"""JTL402 positive, producer side: a donating chunk kernel behind the
+factory -> _CACHE -> instrument_kernel idiom (the wgl3._cached_chunk_run
+shape). The donation is invisible from the consumer's file — only the
+cross-module flow pass can resolve it."""
+import jax
+
+from obs import instrument_kernel
+
+_CACHE = {}
+
+
+def _chunk_fn(model, cfg):
+    def run(carry, tabs, tgts):
+        carry = model.step(carry, tabs, tgts)
+        return carry, tabs.sum()
+
+    return jax.jit(run, donate_argnums=(0,))
+
+
+def cached_chunk_run(model, cfg):
+    key = ("chunk", model, cfg)
+    if key not in _CACHE:
+        _CACHE[key] = instrument_kernel("chunk", _chunk_fn(model, cfg))
+    return _CACHE[key]

@@ -1,0 +1,40 @@
+"""JTL405 positive: the PR 7 /metrics incident class, reconstructed.
+
+Three drifts in one capture module: a snapshot reader fetching a key no
+capture pre-registers (absent-not-zero on quiet runs), a pre-registered
+key nothing ever writes (dead contract weight), and a dynamic per-kernel
+family whose prefix collides with the plain counter WITHOUT a
+LABELED_FAMILIES entry — the exact shape that rendered /metrics with
+two TYPE lines for one family.
+"""
+
+# jtflow: metrics preregistered
+PHASE_COUNTERS = ("wgl.compile_s", "wgl.never_written")
+
+
+class Capture:
+    def __init__(self, metrics):
+        self.metrics = metrics
+        for name in PHASE_COUNTERS:
+            self.metrics.counter(name)
+
+
+def record_compile(m, dt):
+    m.counter("wgl.compile_s").add(dt)
+
+
+def instrument(m, kernel, dt):
+    # jtlint: disable=JTL107 -- bounded family: kernel names are a fixed
+    # static set in this fixture.
+    m.histogram(f"wgl.compile_s.{kernel}").observe(dt)
+
+
+def kernel_phases(metrics):
+    snap = metrics.snapshot()
+
+    def counter_value(key):
+        rec = snap.get(key)
+        return rec["value"] if rec else 0.0
+
+    return {"compile_s": counter_value("wgl.compile_s"),
+            "execute_s": counter_value("wgl.execute_unregistered")}

@@ -1,0 +1,20 @@
+"""JTL401 negative, consumer side: literal widths in step with the
+schema, and a partials consumer indexing inside the declared row."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_shards(out, n_devices, b):
+    shard_shapes = {s.data.shape for s in out.addressable_shards}
+    # jtflow: packed-width=6 producer.PACKED_FIELDS_XLA
+    assert shard_shapes == {(b // n_devices, 6)}, shard_shapes
+
+
+def fetch(carry, parts):
+    # jtflow: partials-from producer.partial_row
+    packed = np.asarray(jnp.concatenate([
+        jnp.stack([carry.dead, carry.dead_step, carry.max_frontier]),
+        parts]))
+    return {"survived": not bool(packed[0]), "dead_step": int(packed[1]),
+            "configs_explored": int(packed[3]),
+            "real_steps": int(packed[5])}

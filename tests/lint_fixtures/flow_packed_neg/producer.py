@@ -1,0 +1,30 @@
+"""JTL401 negative: schema, producer, and consumers all agree on the
+6-column pack (the post-fix state of the PR 3 incident)."""
+import jax.numpy as jnp
+import numpy as np
+
+PACKED_FIELDS = ("survived", "overflow", "dead_step", "max_frontier",
+                 "configs_explored")
+PACKED_FIELDS_XLA = PACKED_FIELDS + ("live_tile_pm",)
+
+
+# jtflow: packs producer.PACKED_FIELDS_XLA
+def _pack_result(out):
+    return jnp.stack([out["survived"], out["overflow"], out["dead_step"],
+                      out["max_frontier"], out["configs_explored"],
+                      out["live_tile_pm"]], axis=-1)
+
+
+# jtflow: unpacks producer.PACKED_FIELDS_XLA
+def unpack_np(arr):
+    arr = np.asarray(arr)
+    pm = (arr[..., 5] if arr.shape[-1] > 5
+          else np.full(arr.shape[:-1], -1, np.int32))
+    return {"survived": arr[..., 0] != 0, "overflow": arr[..., 1] != 0,
+            "dead_step": arr[..., 2], "max_frontier": arr[..., 3],
+            "configs_explored": arr[..., 4], "live_tile_pm": pm}
+
+
+# jtflow: partials configs_explored,live_tile_sum,real_steps
+def partial_row(ns, lives, tgts):
+    return jnp.stack([ns.sum(), lives.sum(), (tgts >= 0).sum()])

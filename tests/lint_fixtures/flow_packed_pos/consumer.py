@@ -1,0 +1,9 @@
+"""JTL401 positive, consumer side: the __graft_entry__ shard-shape
+assert class — a literal pack width tied to the schema by annotation,
+left behind when the schema widened."""
+
+
+def check_shards(out, n_devices, b):
+    shard_shapes = {s.data.shape for s in out.addressable_shards}
+    # jtflow: packed-width=5 producer.PACKED_FIELDS
+    assert shard_shapes == {(b // n_devices, 5)}, shard_shapes

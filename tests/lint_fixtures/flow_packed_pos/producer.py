@@ -1,0 +1,29 @@
+"""JTL401 positive: the PR 3 incident class, reconstructed.
+
+PR 3 widened the packed result from 5 to 6 columns (live_tile_pm) and
+had to hand-patch every consumer. This mini-project freezes that drift
+moment: the schema tuple already declares 6 fields, but the producer
+still stacks 5 columns and the unpacker still reads only columns 0..4.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+PACKED_FIELDS = ("survived", "overflow", "dead_step", "max_frontier",
+                 "configs_explored", "live_tile_pm")
+
+
+# jtflow: packs producer.PACKED_FIELDS
+def _pack_result(out):
+    # DRIFT: 5 columns stacked against the 6-field schema above.
+    return jnp.stack([out["survived"], out["overflow"], out["dead_step"],
+                      out["max_frontier"], out["configs_explored"]],
+                     axis=-1)
+
+
+# jtflow: unpacks producer.PACKED_FIELDS
+def unpack_np(arr):
+    # DRIFT: the top column read is 4; the schema's last column is 5.
+    arr = np.asarray(arr)
+    return {"survived": arr[..., 0] != 0, "overflow": arr[..., 1] != 0,
+            "dead_step": arr[..., 2], "max_frontier": arr[..., 3],
+            "configs_explored": arr[..., 4]}

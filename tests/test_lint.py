@@ -477,6 +477,443 @@ def test_package_lints_clean_under_strict():
             f"suppression near {f.path}:{f.line} lacks a justification")
 
 
+# -- jtflow: interprocedural flow rules (ISSUE 9) --------------------------
+# Flow fixtures are mini-PROJECTS (directories), not single files: the
+# JTL4xx rules resolve contracts across modules, so each positive/
+# negative pair is a producer/consumer pair with root at the fixture
+# dir. Lines are golden against the checked-in fixtures, same contract
+# as GOLDEN above.
+FLOW_GOLDEN = [
+    ("JTL401", "flow_packed_pos",
+     [("consumer.py", 9), ("producer.py", 16), ("producer.py", 24)],
+     "flow_packed_neg"),
+    ("JTL402", "flow_donation_pos", [("consumer.py", 11)],
+     "flow_donation_neg"),
+    ("JTL403", "flow_axis_pos", [("kernel.py", 10), ("kernel.py", 12)],
+     "flow_axis_neg"),
+    ("JTL404", "flow_carry_pos", [("consumer.py", 19)],
+     "flow_carry_neg"),
+    ("JTL405", "flow_metric_pos",
+     [("obsmod.py", 11), ("obsmod.py", 29), ("obsmod.py", 40)],
+     "flow_metric_neg"),
+]
+
+
+def _lint_flow(dirname, rule_id):
+    d = FIXTURES / dirname
+    rules = analysis.all_rules()
+    return analysis.run_lint([d], rules={rule_id: rules[rule_id]},
+                             root=d)
+
+
+@pytest.mark.parametrize("rule_id,pos,locs,neg", FLOW_GOLDEN,
+                         ids=[g[0] for g in FLOW_GOLDEN])
+def test_flow_rule_fixture_golden(rule_id, pos, locs, neg):
+    res = _lint_flow(pos, rule_id)
+    got = sorted((f.path, f.line) for f in res.findings)
+    assert got == sorted(locs), (
+        f"{rule_id} on {pos}: expected {sorted(locs)}, got {got}:\n"
+        + analysis.format_text(res.findings))
+    assert all(f.rule == rule_id and f.fingerprint
+               for f in res.findings)
+    neg_res = _lint_flow(neg, rule_id)
+    assert not neg_res.findings, (
+        f"{rule_id} false positives on {neg}:\n"
+        + analysis.format_text(neg_res.findings))
+
+
+def test_flow_rules_have_fixture_dirs():
+    """The 4xx family rides the same fixture-pair enforcement as the
+    module rules: every flow rule (except the contracts-sync gate,
+    pinned by its own tests below) has a pos/neg mini-project and a
+    FLOW_GOLDEN row. Doc sections are enforced for ALL rules by
+    test_every_module_rule_has_fixture_pair_and_docs."""
+    flow_ids = {i for i in analysis.all_rules() if i.startswith("JTL4")}
+    assert flow_ids == {"JTL401", "JTL402", "JTL403", "JTL404",
+                        "JTL405", "JTL406"}
+    assert {g[0] for g in FLOW_GOLDEN} == flow_ids - {"JTL406"}
+    for _rid, pos, _locs, neg in FLOW_GOLDEN:
+        assert (FIXTURES / pos).is_dir() and (FIXTURES / neg).is_dir()
+
+
+def test_pr3_packed_width_regression_fixture():
+    """Satellite: the PR 3 PACKED_FIELDS 5-vs-6 column drift — the
+    producer stacking 5 columns against the 6-field schema, the
+    consumer's literal shard-shape assert, and the 0..4 unpacker — is
+    caught by JTL401 with messages naming both widths."""
+    res = _lint_flow("flow_packed_pos", "JTL401")
+    msgs = sorted(f.message for f in res.findings)
+    assert any("producer stacks 5 column(s)" in m
+               and "declares 6" in m for m in msgs)
+    assert any("literal 5 vs producer.PACKED_FIELDS = 6" in m
+               for m in msgs)
+    assert any("reads column 4" in m and "declares 6" in m for m in msgs)
+
+
+def test_pr7_metric_collision_regression_fixture():
+    """Satellite: the PR 7 labeled-family /metrics collision — a
+    dynamic `wgl.compile_s.<kernel>` family against the plain
+    wgl.compile_s counter without a LABELED_FAMILIES entry — is caught
+    by JTL405, alongside both snapshot-contract drift directions."""
+    res = _lint_flow("flow_metric_pos", "JTL405")
+    msgs = sorted(f.message for f in res.findings)
+    assert any("two TYPE lines" in m for m in msgs)
+    assert any("not pre-registered" in m for m in msgs)
+    assert any("no writer" in m for m in msgs)
+
+
+def test_stale_jtflow_annotation_is_a_finding(tmp_path):
+    """An annotation referencing a schema that no longer exists (or one
+    that binds to nothing) is itself JTL401 drift — a stale annotation
+    must never read as 'verified'."""
+    (tmp_path / "m.py").write_text(
+        "# jtflow: packs nowhere.SCHEMA\nX = 1\n")
+    rules = analysis.all_rules()
+    res = analysis.run_lint([tmp_path], rules={"JTL401": rules["JTL401"]},
+                            root=tmp_path)
+    assert len(res.findings) == 1
+    assert "unknown packed schema" in res.findings[0].message
+
+
+def test_flow_findings_honor_inline_suppression(tmp_path):
+    """Project-rule findings land on module lines and honor the same
+    justified inline-suppression contract as module rules (the
+    'fixed or inline-justified' half of the flow acceptance)."""
+    (tmp_path / "meshes.py").write_text(
+        "import numpy as np\nfrom jax.sharding import Mesh\n\n\n"
+        "def batch_mesh(devs):\n"
+        "    return Mesh(np.array(devs), ('batch',))\n")
+    (tmp_path / "kernel.py").write_text(
+        "import jax\n\n\ndef f(x):\n"
+        "    # jtlint: disable=JTL403 -- fixture: axis exists on the "
+        "real pod only\n"
+        "    return jax.lax.psum(x, 'rows')\n")
+    rules = analysis.all_rules()
+    res = analysis.run_lint([tmp_path], rules={"JTL403": rules["JTL403"]},
+                            root=tmp_path)
+    assert not res.findings, analysis.format_text(res.findings)
+    assert len(res.suppressed) == 1 and res.suppressed[0].rule == "JTL403"
+
+
+def test_contracts_json_in_sync():
+    """Satellite (CI/tooling): contracts.json is regenerated from the
+    tree and diffed — the checked-in artifact IS the extraction, byte
+    for byte (the check_limits_doc discipline), and it covers every
+    kernel family."""
+    fresh = analysis.render_contracts(analysis.extract_contracts(REPO))
+    checked_in = (REPO / analysis.CONTRACTS_FILE).read_text(
+        encoding="utf-8")
+    assert checked_in == fresh, (
+        "contracts.json is stale — run `jepsen-tpu lint "
+        "--write-contracts` and review the diff")
+    c = json.loads(fresh)
+    for fam in ("wgl2-chunk", "wgl3-chunk", "wgl3-pallas",
+                "wgl3-sparse-chunk", "wgl3-lattice-chunk",
+                "wgl3-dense-multislice"):
+        assert fam in c["kernels"], f"kernel family {fam} missing"
+    assert c["packed_schemas"]["wgl3.PACKED_FIELDS_XLA"]["width"] == 6
+    assert c["kernels"]["wgl3-chunk"]["donates"] == [0]
+    assert c["kernels"]["wgl3-pallas-resumable"]["donates"] == [1, 4]
+    assert c["carries"]["_Carry3"]["fields"] == [
+        "table", "dead", "dead_step", "max_frontier"]
+    assert c["partials"]["wgl3._chunk_fn"] == [
+        "configs_explored", "live_tile_sum", "real_steps"]
+    assert set(c["meshes"]) == {"batch", "lattice", "slice"}
+    assert c["table_word_bits"] == 5
+
+
+def test_contracts_cli_matches_checked_in(capsys):
+    assert lint_cli.main(["--contracts"]) == 0
+    out = capsys.readouterr().out
+    assert out == (REPO / analysis.CONTRACTS_FILE).read_text(
+        encoding="utf-8")
+
+
+def test_contracts_sync_rule_detects_missing_and_stale(tmp_path):
+    """JTL406 on a mini repo: missing file -> finding; written ->
+    clean; tree drifts -> stale finding. Foreign trees (no package
+    dir) are skipped entirely."""
+    rule = analysis.all_rules()["JTL406"]
+    assert rule.check_project(tmp_path) == []     # no package: skip
+    pkg = tmp_path / "jepsen_etcd_demo_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("X = 1\n")
+    found = rule.check_project(tmp_path)
+    assert found and "missing" in found[0].message
+    (tmp_path / analysis.CONTRACTS_FILE).write_text(
+        analysis.render_contracts(analysis.extract_contracts(tmp_path)),
+        encoding="utf-8")
+    assert rule.check_project(tmp_path) == []
+    (pkg / "mod.py").write_text('PACKED_FIELDS = ("a", "b")\n')
+    found = rule.check_project(tmp_path)
+    assert found and "stale" in found[0].message
+    assert found[0].path == analysis.CONTRACTS_FILE
+
+
+def test_baseline_prunes_deleted_files(tmp_path):
+    """Satellite bugfix: a file deleted outright used to leave its
+    baseline entries undetectable as stale (the path was never scanned,
+    so fingerprint staleness never fired) — deletion now prunes."""
+    target = tmp_path / "old.py"
+    target.write_text('import os\n'
+                      'mode = os.getenv("JEPSEN_TPU_LIMIT_SPARSE_MODE")\n')
+    (tmp_path / "keep.py").write_text("x = 1\n")
+    bl = tmp_path / "baseline.json"
+    assert lint_cli.main(["--baseline", str(bl), "--write-baseline",
+                          "--no-project-rules", str(tmp_path)]) == 0
+    assert len(json.loads(bl.read_text())["findings"]) == 1
+    target.unlink()
+    assert lint_cli.main(["--strict", "--baseline", str(bl),
+                          "--no-project-rules", str(tmp_path)]) == 1
+    assert lint_cli.main(["--baseline", str(bl), "--write-baseline",
+                          "--no-project-rules", str(tmp_path)]) == 0
+    assert json.loads(bl.read_text())["findings"] == {}
+    assert lint_cli.main(["--strict", "--baseline", str(bl),
+                          "--no-project-rules", str(tmp_path)]) == 0
+
+
+def test_cli_sarif_format(capsys):
+    """Satellite: --format sarif emits valid SARIF 2.1.0 with one
+    result per finding, rule metadata, and the stable jtlint
+    fingerprint as a partial fingerprint."""
+    assert lint_cli.main(["--format", "sarif", "--no-baseline",
+                          "--no-project-rules",
+                          str(FIXTURES / "env_limits_pos.py")]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "jtlint"
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == {"JTL106"}
+    results = run["results"]
+    assert len(results) == 3
+    for r in results:
+        assert r["ruleId"] == "JTL106"
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(
+            "env_limits_pos.py")
+        assert loc["region"]["startLine"] in (5, 6, 7)
+        assert r["partialFingerprints"]["jtlint/v1"]
+
+
+def test_cli_changed_mode(tmp_path, capsys):
+    """Satellite: --changed REF lints only files changed vs the git
+    base; zero changed files is a clean no-op; project rules are
+    skipped when no changed file dirties the package contract graph."""
+    def git(*a):
+        subprocess.run(["git", "-c", "user.email=t@t", "-c",
+                        "user.name=t", *a], cwd=tmp_path, check=True,
+                       capture_output=True)
+
+    (tmp_path / "pyproject.toml").write_text("")
+    clean = tmp_path / "clean.py"
+    dirty = tmp_path / "dirty.py"
+    # Both files carry the same JTL106 shape; only the changed one may
+    # be linted.
+    bad = ('import os\n'
+           'mode = os.getenv("JEPSEN_TPU_LIMIT_SPARSE_MODE")\n')
+    clean.write_text(bad)
+    dirty.write_text("x = 1\n")
+    git("init")
+    git("add", ".")
+    git("commit", "-m", "base")
+    dirty.write_text(bad)
+    assert lint_cli.main(["--changed", "HEAD", "--no-baseline",
+                          str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "dirty.py" in out and "clean.py" not in out
+    git("add", ".")
+    git("commit", "-m", "drift")
+    assert lint_cli.main(["--changed", "HEAD", "--no-baseline",
+                          str(tmp_path)]) == 0
+    assert "nothing to lint" in capsys.readouterr().out
+
+
+def test_cli_changed_mode_sees_non_py_contract_inputs(tmp_path, capsys):
+    """Review finding: --changed's dirty detection must judge the RAW
+    change list — a drifted contracts.json (or a deleted module) has no
+    surviving .py file to module-lint, but the project rules read it,
+    so 'nothing to lint' exit 0 would green-light a tree the full
+    strict lint fails."""
+    def git(*a):
+        subprocess.run(["git", "-c", "user.email=t@t", "-c",
+                        "user.name=t", *a], cwd=tmp_path, check=True,
+                       capture_output=True)
+
+    (tmp_path / "pyproject.toml").write_text("")
+    pkg = tmp_path / "jepsen_etcd_demo_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("X = 1\n")
+    (tmp_path / analysis.CONTRACTS_FILE).write_text(
+        analysis.render_contracts(analysis.extract_contracts(tmp_path)),
+        encoding="utf-8")
+    git("init")
+    git("add", ".")
+    git("commit", "-m", "base")
+    # Nothing changed: clean no-op even with the package present.
+    assert lint_cli.main(["--changed", "HEAD", "--strict",
+                          "--no-baseline", str(tmp_path)]) == 0
+    assert "nothing to lint" in capsys.readouterr().out
+    # Drift ONLY contracts.json (no .py change): strict must go red
+    # through the project rules, not no-op green.
+    (tmp_path / analysis.CONTRACTS_FILE).write_text("{}\n")
+    assert lint_cli.main(["--changed", "HEAD", "--strict",
+                          "--no-baseline", str(tmp_path)]) == 1
+    assert "contracts.json is stale" in capsys.readouterr().out
+
+
+def test_axis_declaration_binds_to_axes_param_default(tmp_path):
+    """Review finding: a tuple-of-strings default on a NEIGHBORING
+    parameter must not declare mesh axes — only the `axes` parameter's
+    own default does, else undeclared collective axes pass silently."""
+    (tmp_path / "meshmod.py").write_text(
+        "def make_thing(shapes=('x', 'y'), axes=None):\n"
+        "    return shapes, axes\n\n\n"
+        "def make_mesh(n, axes=('batch',)):\n"
+        "    return axes\n")
+    (tmp_path / "kernel.py").write_text(
+        "import jax\n\n\ndef f(v):\n"
+        "    return jax.lax.psum(v, 'x')\n")
+    rules = analysis.all_rules()
+    res = analysis.run_lint([tmp_path], rules={"JTL403": rules["JTL403"]},
+                            root=tmp_path)
+    assert len(res.findings) == 1, analysis.format_text(res.findings)
+    assert "'x'" in res.findings[0].message
+    assert "batch" in res.findings[0].message
+
+
+def test_cli_changed_mode_nested_in_monorepo(tmp_path, capsys):
+    """Review finding: `git diff --name-only` emits toplevel-relative
+    paths, so a project nested inside a larger git repo (the monorepo
+    CI case) dropped every change and exited 0 on a red tree; the
+    --relative flag pins paths to the lint root."""
+    def git(*a):
+        subprocess.run(["git", "-c", "user.email=t@t", "-c",
+                        "user.name=t", *a], cwd=tmp_path, check=True,
+                       capture_output=True)
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "pyproject.toml").write_text("")
+    mod = proj / "m.py"
+    mod.write_text("x = 1\n")
+    git("init")
+    git("add", ".")
+    git("commit", "-m", "base")
+    mod.write_text('import os\n'
+                   'mode = os.getenv("JEPSEN_TPU_LIMIT_SPARSE_MODE")\n')
+    assert lint_cli.main(["--changed", "HEAD", "--strict",
+                          "--no-baseline", str(proj)]) == 1
+    assert "m.py" in capsys.readouterr().out
+
+
+def test_cli_changed_noop_honors_output_format(tmp_path, capsys):
+    """Review finding: the --changed quiet no-op must emit an EMPTY
+    findings document under --format json/sarif, not prose — CI parses
+    stdout on the common nothing-changed push."""
+    def git(*a):
+        subprocess.run(["git", "-c", "user.email=t@t", "-c",
+                        "user.name=t", *a], cwd=tmp_path, check=True,
+                       capture_output=True)
+
+    (tmp_path / "pyproject.toml").write_text("")
+    (tmp_path / "m.py").write_text("x = 1\n")
+    git("init")
+    git("add", ".")
+    git("commit", "-m", "base")
+    assert lint_cli.main(["--changed", "HEAD", "--format", "sarif",
+                          str(tmp_path)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0" and doc["runs"][0]["results"] == []
+    assert lint_cli.main(["--changed", "HEAD", "--format", "json",
+                          str(tmp_path)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["findings"] == [] and doc["ok"] is True
+
+
+def test_suppression_on_multiline_string_close_line(tmp_path):
+    """Review finding: a REAL trailing comment on the line where a
+    multiline string closes must still suppress (comments now come from
+    the tokenizer, not a lines-inside-strings blanket), while quoted
+    examples inside the string stay inert."""
+    f = tmp_path / "t.py"
+    f.write_text(
+        'import os\n\n'
+        'x = f("""\n'
+        '# jtlint: disable=JTL106 -- quoted example, must stay inert\n'
+        'doc""", os.getenv("JEPSEN_TPU_LIMIT_SPARSE_MODE"))  '
+        '# jtlint: disable=JTL106 -- real comment after the close\n')
+    rules = analysis.all_rules()
+    res = analysis.run_lint([f], root=tmp_path,
+                            rules={"JTL106": rules["JTL106"]},
+                            project_rules=False)
+    assert not res.findings, analysis.format_text(res.findings)
+    assert len(res.suppressed) == 1
+
+
+def test_unused_accounting_skips_unran_project_rules(tmp_path):
+    """Review finding: a project_rules=False run (the --changed
+    clean-graph fast path) never executed JTL3xx/4xx, so their
+    justified suppressions must not read as stale."""
+    f = tmp_path / "k.py"
+    f.write_text(
+        "import jax\n\n\ndef f(x):\n"
+        "    # jtlint: disable=JTL403 -- axis exists on the real pod\n"
+        "    return jax.lax.psum(x, 'rows')\n")
+    res = analysis.run_lint([f], root=tmp_path, project_rules=False)
+    assert not res.unused_suppressions, res.unused_suppressions
+
+
+def test_lint_report_flags_stale_and_healthy(tmp_path):
+    """Satellite: tools/lint_report.py exits nonzero on a stale
+    (suppresses-nothing) justified suppression and zero on a healthy
+    ledger; justification text is surfaced per suppression."""
+    stale = tmp_path / "stale.py"
+    stale.write_text("import os\n"
+                     "# jtlint: disable=JTL106 -- no longer needed\n"
+                     "x = 1\n")
+    healthy = tmp_path / "healthy.py"
+    healthy.write_text(
+        "import os\n"
+        "# jtlint: disable=JTL106 -- fixture: sanctioned raw read\n"
+        'mode = os.getenv("JEPSEN_TPU_LIMIT_SPARSE_MODE")\n')
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_report.py"),
+         "--json", str(stale)], capture_output=True, text=True,
+        cwd=REPO, timeout=120)
+    report = json.loads(out.stdout)
+    assert out.returncode == 1 and not report["ok"]
+    assert report["stale_suppressions"] \
+        and report["stale_suppressions"][0]["ids"] == ["JTL106"]
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_report.py"),
+         "--json", str(healthy)], capture_output=True, text=True,
+        cwd=REPO, timeout=120)
+    report = json.loads(out.stdout)
+    assert out.returncode == 0 and report["ok"]
+    assert report["rules"]["JTL106"]["suppressed"] == 1
+    assert "sanctioned raw read" \
+        in report["rules"]["JTL106"]["suppressions"][0]["justification"]
+
+
+def test_suppression_examples_in_docstrings_are_inert(tmp_path):
+    """A suppression (or jtflow annotation) QUOTED inside a docstring
+    is prose: it must neither suppress a finding on the next code line
+    nor count as a stale suppression (the analysis layer's own
+    docstrings quote both grammars heavily)."""
+    f = tmp_path / "d.py"
+    f.write_text(
+        'import os\n\n\n'
+        'def doc():\n'
+        '    """Example:\n\n'
+        '        # jtlint: disable=JTL106 -- quoted example\n'
+        '    """\n'
+        '    return os.getenv("JEPSEN_TPU_LIMIT_SPARSE_MODE")\n')
+    res = analysis.run_lint([f], root=tmp_path, project_rules=False)
+    assert any(x.rule == "JTL106" for x in res.findings)  # NOT suppressed
+    assert not res.suppressed
+    assert not res.unused_suppressions
+
+
 @pytest.mark.slow
 def test_lint_path_never_imports_jax():
     """The tier-1 wiring's speed rests on never touching jax: prove it

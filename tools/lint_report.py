@@ -1,0 +1,127 @@
+#!/usr/bin/env python3
+"""Per-rule jtlint accounting: findings, suppressions, justifications.
+
+`jepsen-tpu lint --strict` answers "is the tree clean"; this tool
+answers "what did we *accept* and why" — the review surface for the
+suppression debt:
+
+  * a table of finding / suppressed / baselined counts per rule id;
+  * every inline suppression with its justification text, grouped by
+    rule (a suppression is an argument — this prints the arguments);
+  * STALE suppressions — justified `# jtlint: disable=` comments that
+    suppressed nothing in a full run (the rule no longer fires there:
+    the comment is dead weight or, worse, hiding a future regression);
+  * justification-free suppressions (JTL001 findings).
+
+Exit status: 0 when the suppression ledger is healthy; 1 when any
+suppression is stale or justification-free (CI wires this next to the
+strict gate so the ledger cannot rot).
+
+Usage: python tools/lint_report.py [--json] [paths...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from jepsen_etcd_demo_tpu import analysis                  # noqa: E402
+from jepsen_etcd_demo_tpu.analysis.baseline import Baseline  # noqa: E402
+
+
+def build_report(paths=None, root: Path = REPO) -> dict:
+    """The full per-rule accounting for `paths` (default: the package),
+    against the checked-in baseline like the tier-1 gate."""
+    paths = paths or [root / "jepsen_etcd_demo_tpu"]
+    baseline = Baseline.load_or_empty(root / analysis.DEFAULT_BASELINE)
+    res = analysis.run_lint(paths, root=root, baseline=baseline)
+
+    per_rule: dict[str, dict] = {}
+
+    def bucket(rule: str) -> dict:
+        return per_rule.setdefault(rule, {
+            "findings": 0, "suppressed": 0, "baselined": 0,
+            "suppressions": []})
+
+    for f in res.findings:
+        bucket(f.rule)["findings"] += 1
+    for f in res.baselined:
+        bucket(f.rule)["baselined"] += 1
+    # Justification text per suppressed finding, read back through the
+    # ONE suppression grammar (ModuleSource.suppression_notes) — never
+    # a second parse that could drift from what the engine honored.
+    from jepsen_etcd_demo_tpu.analysis.flow.index import \
+        load_module_cached
+
+    for f in res.suppressed:
+        b = bucket(f.rule)
+        b["suppressed"] += 1
+        justification = ""
+        src = root / f.path
+        if src.is_file():
+            mod = load_module_cached(src, root)
+            hit = mod.suppression_line(f.rule, f.line)
+            if hit is None and f.anchor and f.anchor != f.line:
+                hit = mod.suppression_line(f.rule, f.anchor)
+            if hit is not None:
+                justification = mod.suppression_notes.get(hit, "")
+        b["suppressions"].append({
+            "path": f.path, "line": f.line,
+            "justification": justification})
+
+    unjustified = [f.as_dict() for f in res.findings
+                   if f.rule == "JTL001"]
+    return {
+        "files": res.files,
+        "rules": dict(sorted(per_rule.items())),
+        "stale_suppressions": res.unused_suppressions,
+        "unjustified_suppressions": unjustified,
+        "stale_baseline": res.stale_baseline,
+        "ok": not res.unused_suppressions and not unjustified,
+    }
+
+
+def _print_text(report: dict) -> None:
+    print(f"jtlint report — {report['files']} file(s)")
+    print(f"{'rule':<8} {'findings':>8} {'suppressed':>10} "
+          f"{'baselined':>9}")
+    for rid, b in report["rules"].items():
+        print(f"{rid:<8} {b['findings']:>8} {b['suppressed']:>10} "
+              f"{b['baselined']:>9}")
+    for rid, b in report["rules"].items():
+        for s in b["suppressions"]:
+            j = s["justification"] or "(justification in comment block)"
+            print(f"  {rid} suppressed at {s['path']}:{s['line']} -- {j}")
+    for s in report["stale_suppressions"]:
+        print(f"STALE suppression {s['path']}:{s['line']} "
+              f"(disable={','.join(s['ids'])}) — suppresses nothing; "
+              f"remove it")
+    for f in report["unjustified_suppressions"]:
+        print(f"UNJUSTIFIED suppression {f['path']}:{f['line']} — "
+              f"a suppression is an argument, not an off switch")
+    print("suppression ledger: " + ("ok" if report["ok"] else "UNHEALTHY"))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-rule jtlint findings/suppression report "
+                    "(exit 1 on stale or justification-free "
+                    "suppressions)")
+    ap.add_argument("paths", nargs="*")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    report = build_report([Path(p) for p in args.paths] or None)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        _print_text(report)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
