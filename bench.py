@@ -536,6 +536,130 @@ def bench_sparse(model, n_ops: int = 150, k_slots: int = 20) -> dict:
     return lane
 
 
+def bench_dedup(model, n_ops: int = 600, k_slots: int = 16,
+                sort_ops: int = 300) -> dict:
+    """Frontier-dedup lane (ISSUE 10 tentpole), two arms over
+    symmetry-heavy fixtures (small value domains + forever-pending
+    populations, so equal-effect pending-op classes really exist), each
+    run dedup-OFF (dedup_mode=1) then dedup-ON:
+
+      * SORT arm — the GATED measurement (off/on_events_per_sec,
+        tools/bench_compare.py): one single-value-domain history whose
+        crashed ops interleave factorially, through the resumable sort
+        ladder (wgl2.check_steps_resumable), where frontier size
+        directly drives cost. Canonicalization collapses C(n,k)
+        symmetric masks to n+1, avoiding whole 4x capacity escalations
+        — measured 4.1x on the CPU backend at this scale.
+      * TABLE arm — informational: the chunked dense sweep under
+        dedup_mode=2 (the table passes canonicalize under force/tuned
+        profiles only — a table sweep's cost is fixed in the table
+        size). Reports the measured frontier_dedup_ratio, the pruned
+        count, and raw (dedup-off) vs UNIQUE (canonical) configs/s as
+        SEPARATE numbers, so the headline configs metric cannot
+        silently improve by pruning.
+
+    Verdict fields are asserted identical in both arms in both modes
+    (canonicalization is a verdict-preserving quotient, ops/canon.py)."""
+    from dataclasses import replace
+
+    from jepsen_etcd_demo_tpu.ops import wgl2, wgl3
+    from jepsen_etcd_demo_tpu.ops.encode import (encode_register_history,
+                                                 encode_return_steps,
+                                                 reslot_events)
+    from jepsen_etcd_demo_tpu.ops.limits import limits, set_limits
+    from jepsen_etcd_demo_tpu.utils.fuzz import gen_register_history
+
+    def sym_steps(n, value_range, k_floor):
+        # p_info is the symmetry dial AND the slot-pressure dial:
+        # crashed ops accumulate for the whole history, so the rate
+        # scales as ~6 (table) / ~15 (sort) expected crashes per run;
+        # the slot width rides the history's real concurrency.
+        rng = random.Random(0xDED1 + n)
+        h = gen_register_history(rng, n_ops=n, n_procs=8,
+                                 value_range=value_range,
+                                 p_info=(6.0 if value_range > 1 else 15.0)
+                                 / n)
+        enc = encode_register_history(h, k_slots=32)
+        k = max(k_floor, wgl3.tight_k_slots(enc))
+        enc = reslot_events(enc, k) if enc.k_slots != k else enc
+        return enc, encode_return_steps(enc), k
+
+    def timed(fn):
+        fn()                                   # compile/warm
+        best = float("inf")
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            out = fn()
+            best = min(best, time.perf_counter() - t0)
+        return best, out
+
+    # -- sort arm (gated) --------------------------------------------
+    enc_s, rs_s, _k = sym_steps(sort_ops, value_range=1, k_floor=8)
+    lane = {"sort_ops": sort_ops, "sort_events": enc_s.n_events}
+    sort_res = {}
+    for mode, name in ((1, "off"), (2, "on")):
+        prev = set_limits(replace(limits(), dedup_mode=mode))
+        try:
+            best, out = timed(lambda: wgl2.check_steps_resumable(
+                rs_s, model, f_cap=64))
+        finally:
+            set_limits(prev)
+        sort_res[name] = out
+        lane[f"{name}_s"] = round(best, 4)
+        lane[f"{name}_events_per_sec"] = round(enc_s.n_events / best, 1)
+    for f in ("valid", "survived", "dead_step"):
+        assert sort_res["off"][f] == sort_res["on"][f], \
+            f"dedup sort-arm verdict drift on {f}: {sort_res}"
+    lane["sort_f_cap_off"] = sort_res["off"]["f_cap"]
+    lane["sort_f_cap_on"] = sort_res["on"]["f_cap"]
+    lane["sort_escalations_off"] = sort_res["off"]["escalations"]
+    lane["sort_escalations_on"] = sort_res["on"]["escalations"]
+    lane["speedup_vs_off"] = (round(lane["off_s"] / lane["on_s"], 3)
+                              if lane["on_s"] else 0.0)
+
+    # -- table arm (informational) -----------------------------------
+    enc_t, rs_t, k = sym_steps(n_ops, value_range=2, k_floor=k_slots)
+    cfg = wgl3.dense_config(model, k, max(enc_t.max_value, 4),
+                            budget=1 << 28)
+    assert cfg is not None, (k, enc_t.max_value)
+    events = enc_t.n_events
+    lane.update({"ops": n_ops, "events": events, "k_slots": k,
+                 "table_cells": cfg.n_states * cfg.n_masks})
+    table_res = {}
+    for mode, name in ((1, "off"), (2, "on")):
+        prev = set_limits(replace(limits(), dedup_mode=mode,
+                                  sparse_mode=1))
+        try:
+            best, out = timed(lambda: wgl3.check_steps3_long(
+                rs_t, model, cfg))
+        finally:
+            set_limits(prev)
+        table_res[name] = out
+        lane[f"table_{name}_s"] = round(best, 4)
+    off, on = table_res["off"], table_res["on"]
+    for f in ("valid", "survived", "overflow", "dead_step"):
+        assert off[f] == on[f], \
+            f"dedup table-arm verdict drift on {f}: {table_res}"
+    dd = on.get("dedup", {})
+    assert dd.get("configs_pruned", 0) > 0, \
+        f"symmetry-heavy corpus pruned nothing: {on}"
+    lane["frontier_dedup_ratio"] = dd.get("frontier_dedup_ratio", 0.0)
+    lane["configs_pruned"] = dd.get("configs_pruned", 0)
+    # Raw vs unique configs/s, REPORTED SEPARATELY: raw counts the
+    # dedup-off search's work, unique the canonical frontier's — gating
+    # stays on the sort arm's events/s (bench_compare treats the
+    # configs rates as informational).
+    lane["raw_configs_per_sec"] = round(
+        off["configs_explored"] / lane["table_off_s"], 1) \
+        if lane["table_off_s"] else 0
+    lane["unique_configs_per_sec"] = round(
+        on["configs_explored"] / lane["table_on_s"], 1) \
+        if lane["table_on_s"] else 0
+    lane["max_frontier_off"] = off["max_frontier"]
+    lane["max_frontier_on"] = on["max_frontier"]
+    return lane
+
+
 def bench_tuned(model, n_hist: int = 128, ops_range=(20, 300)) -> dict:
     """Tuned-profile lane (ISSUE 4 tentpole): ONE mixed-length corpus
     through the bucketed scheduler under the DATACLASS-DEFAULT limits
@@ -792,22 +916,26 @@ def bench_invalid_lane(model) -> dict:
                                                  reslot_events)
     from jepsen_etcd_demo_tpu.ops.limits import limits, set_limits
 
-    for _ in range(20):   # mutations are LIKELY-invalid; insist on it
-        h = mutate_history(rng, gen_register_history(
-            rng, n_ops=4000, n_procs=8, p_info=0.002))
-        enc = encode_register_history(h, k_slots=16)
-        k = wgl3.tight_k_slots(enc)
-        lcfg = wgl3.dense_config(model, k, enc.max_value)
-        enc = reslot_events(enc, k) if enc.k_slots != k else enc
-        rs = encode_return_steps(enc)
-        ref = wgl3.check_steps3_long(rs, model, lcfg, chunk=512)
-        if ref["valid"] is False:
-            break
-    assert ref["valid"] is False, "no invalid long mutation in 20 tries"
-    # replace(), not a fresh KernelLimits: the active profile may carry
-    # env overrides that must keep applying to the windowed launches.
-    prev = set_limits(replace(limits(), max_r_pallas=512))
+    # dedup_mode pinned OFF for this certification: the pallas kernels
+    # run no canonicalization pass, and the lane compares the SEARCH
+    # metrics (max_frontier) bit-for-bit — the dedup lane owns the
+    # canonicalized numbers. replace(), not a fresh KernelLimits: the
+    # active profile may carry env overrides that must keep applying.
+    prev = set_limits(replace(limits(), dedup_mode=1))
     try:
+        for _ in range(20):   # mutations are LIKELY-invalid; insist on it
+            h = mutate_history(rng, gen_register_history(
+                rng, n_ops=4000, n_procs=8, p_info=0.002))
+            enc = encode_register_history(h, k_slots=16)
+            k = wgl3.tight_k_slots(enc)
+            lcfg = wgl3.dense_config(model, k, enc.max_value)
+            enc = reslot_events(enc, k) if enc.k_slots != k else enc
+            rs = encode_return_steps(enc)
+            ref = wgl3.check_steps3_long(rs, model, lcfg, chunk=512)
+            if ref["valid"] is False:
+                break
+        assert ref["valid"] is False, "no invalid long mutation in 20 tries"
+        set_limits(replace(limits(), dedup_mode=1, max_r_pallas=512))
         got = wgl3_pallas.check_steps3_long_pallas(rs, model, lcfg)
     finally:
         set_limits(prev)
@@ -1083,6 +1211,10 @@ def main():
             # Sparse active-tile lane: dense-vs-sparse sweep on one wide
             # long history (ISSUE 3) — the win measured, not asserted.
             sparse_lane = bench_sparse(model)
+            # Frontier-dedup lane (ISSUE 10): dedup-off vs dedup-on on
+            # one symmetry-heavy history, verdicts asserted identical,
+            # raw vs unique configs/s reported separately.
+            dedup_lane = bench_dedup(model)
             # Tuned-profile lane (ISSUE 4): default vs tuned-profile
             # limits on one corpus, verdicts asserted identical.
             tuned_lane = bench_tuned(model)
@@ -1150,6 +1282,7 @@ def main():
         "invalid_lane": invalid_lane,
         "corpus_sched": sched_lane,
         "sparse": sparse_lane,
+        "dedup": dedup_lane,
         "tuned": tuned_lane,
         "streaming": stream_lane,
     }

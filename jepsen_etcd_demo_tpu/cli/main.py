@@ -571,7 +571,8 @@ def _cmd_corpus_checked(args, multislice: bool) -> int:
     t0 = time.perf_counter()
     invalid, kernels, n_keys = [], set(), 0
     sched_stats = {"launches": 0, "steps_real": 0, "steps_padded": 0,
-                   "sweep_steps_sparse": 0, "sweep_steps_dense": 0}
+                   "sweep_steps_sparse": 0, "sweep_steps_dense": 0,
+                   "configs_pruned": 0, "sparse_overflow_rounds": 0}
     for model_name, entries in sorted(by_model.items()):
         model = Linearizable(model=model_name).model
         if multislice:
@@ -586,7 +587,8 @@ def _cmd_corpus_checked(args, multislice: bool) -> int:
             results, kernel, stats = sched.check_corpus(
                 [e[2] for e in entries], model)
             for f in ("launches", "steps_real", "steps_padded",
-                      "sweep_steps_sparse", "sweep_steps_dense"):
+                      "sweep_steps_sparse", "sweep_steps_dense",
+                      "configs_pruned", "sparse_overflow_rounds"):
                 sched_stats[f] += stats.get(f, 0)
         kernels.add(kernel)
         n_keys += len(entries)
@@ -611,9 +613,14 @@ def _cmd_corpus_checked(args, multislice: bool) -> int:
         out["cache_hit_rate"] = round(
             sched.kernel_cache().stats()["hit_rate"], 4)
         # Sparse-sweep exposure (doc/perf.md "Sparse sweeps"): how many
-        # long-sweep steps the corpus pass ran in each mode.
+        # long-sweep steps the corpus pass ran in each mode — plus the
+        # frontier-dedup / overflow accounting (doc/perf.md "Frontier
+        # dedup", ISSUE 10).
         out["sweep_steps_sparse"] = sched_stats["sweep_steps_sparse"]
         out["sweep_steps_dense"] = sched_stats["sweep_steps_dense"]
+        out["configs_pruned"] = sched_stats["configs_pruned"]
+        out["sparse_overflow_rounds"] = \
+            sched_stats["sparse_overflow_rounds"]
     if multislice:
         import jax
 

@@ -95,9 +95,19 @@ SCHED_COUNTERS = ("sched.steps_real", "sched.steps_padded",
 # jtflow: metrics preregistered
 SWEEP_COUNTERS = ("wgl.sweep_steps_sparse", "wgl.sweep_steps_dense",
                   "wgl.sweep_checks_sparse", "wgl.sweep_checks_dense",
-                  "wgl.sweep_checks_mixed")
+                  "wgl.sweep_checks_mixed",
+                  # ISSUE 10: configs removed by frontier
+                  # canonicalization (ops/canon.py) and the previously-
+                  # silent work-list-overflow dense rounds
+                  # (ops/wgl3_sparse.py).
+                  "wgl.configs_pruned", "wgl.sparse_overflow_rounds")
 # jtflow: metrics preregistered
 SWEEP_GAUGE = "wgl.live_tile_ratio"
+# Frontier-dedup effectiveness: pruned / pre-canon configs over the
+# canon-applied steps of a check (ops/canon.py; zeros-never-absent like
+# every sweep key).
+# jtflow: metrics preregistered
+DEDUP_GAUGE = "wgl.frontier_dedup_ratio"
 # Streaming check engine (stream/engine.py): fraction of return steps
 # swept while the run was still live, and the watermark's lag behind
 # the recorder (history entries recorded but not yet stable) — pre-
@@ -140,6 +150,7 @@ class Capture:
                 self.metrics.counter(name)
             self.metrics.gauge(PHASE_GAUGE)
             self.metrics.gauge(SWEEP_GAUGE)
+            self.metrics.gauge(DEDUP_GAUGE)
             self.metrics.gauge(COST_GAUGE)
             self.metrics.gauge(HEALTH_GAUGE)
             for name in STREAM_GAUGES:
@@ -358,10 +369,35 @@ def record_check_result(res: dict) -> None:
                 # the closed two-element tuple above; both names are
                 # pre-registered by capture().
                 m.counter(f"wgl.sweep_{key}").add(v)
+        try:
+            ovf = int(sweep.get("overflow_rounds", 0))
+        except (TypeError, ValueError):
+            ovf = 0
+        if ovf > 0:
+            # The previously-silent sparse fallback (ISSUE 10): rounds
+            # where work-list overflow forced a dense sweep.
+            m.counter("wgl.sparse_overflow_rounds").add(ovf)
     elif ratio >= 0:
         # A dense batched launch: no sweep record, but the measured
         # occupancy proves it ran the dense kernels.
         m.counter("wgl.sweep_checks_dense").add(1)
+    # Frontier canonicalization accounting (ops/canon.py): configs
+    # removed by the symmetry-reduction pass and its effectiveness
+    # ratio over the canon-applied steps.
+    dedup = res.get("dedup")
+    if isinstance(dedup, dict):
+        try:
+            pruned = int(dedup.get("configs_pruned", 0))
+        except (TypeError, ValueError):
+            pruned = 0
+        if pruned > 0:
+            m.counter("wgl.configs_pruned").add(pruned)
+        try:
+            dr = float(dedup.get("frontier_dedup_ratio"))
+        except (TypeError, ValueError):
+            dr = -1.0
+        if dr >= 0:
+            m.gauge(DEDUP_GAUGE).set(dr)
 
 
 def active_profile_hash() -> str:
@@ -448,7 +484,9 @@ def sweep_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
     per-mode step/check counters. Zeros when no registry / no dense runs
     — the contract is "zeros permitted, never absent"."""
     out = {"live_tile_ratio": 0.0, "steps_sparse": 0, "steps_dense": 0,
-           "checks_sparse": 0, "checks_dense": 0, "checks_mixed": 0}
+           "checks_sparse": 0, "checks_dense": 0, "checks_mixed": 0,
+           "configs_pruned": 0, "sparse_overflow_rounds": 0,
+           "frontier_dedup_ratio": 0.0}
     if metrics is None or not metrics.enabled:
         return out
     snap = metrics.snapshot()
@@ -463,9 +501,15 @@ def sweep_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
     out["checks_sparse"] = counter_value("wgl.sweep_checks_sparse")
     out["checks_dense"] = counter_value("wgl.sweep_checks_dense")
     out["checks_mixed"] = counter_value("wgl.sweep_checks_mixed")
+    out["configs_pruned"] = counter_value("wgl.configs_pruned")
+    out["sparse_overflow_rounds"] = \
+        counter_value("wgl.sparse_overflow_rounds")
     g = snap.get(SWEEP_GAUGE)
     if g and g.get("last") is not None:
         out["live_tile_ratio"] = round(float(g["last"]), 4)
+    g = snap.get(DEDUP_GAUGE)
+    if g and g.get("last") is not None:
+        out["frontier_dedup_ratio"] = round(float(g["last"]), 4)
     return out
 
 

@@ -1,0 +1,331 @@
+"""Frontier canonicalization: symmetry reduction over equal-effect
+forever-pending ops (ROADMAP item 4b — knossos' own state-space trick,
+applied device-side to the packed WGL frontiers).
+
+The combinatorial frontiers that DNF the sort ladder (ops/wgl2.py's own
+docstring: "dozens of forever-pending ops interleaving factorially") and
+bloat the dense tables' live occupancy are dominated by SYMMETRY: when
+two pending ops have identical encoded rows (same ``(f, a1, a2, rv)``)
+and NEITHER ever returns in the remaining history, linearizing either
+one first reaches exactly the same model state, and no future prune can
+ever distinguish them (prunes address ops by slot, and these slots never
+appear as targets again). Swapping the two slots is therefore an
+automorphism of the remaining search: a config that fired ``{hi}`` out
+of such a class is equivalent to the config that fired ``{lo}``, and in
+general only the COUNT of fired ops per class matters — ``C(n, k)``
+masks collapse to ``n + 1``.
+
+Canonicalization picks the representative with the fired bits packed
+into the LOWEST slots of each class, implemented as a compare-exchange
+network over the class's slot bits: ``CE(lo, hi)`` rewrites every config
+with bit ``hi`` set and bit ``lo`` clear to the config with the bits
+swapped (a binary selection-sort network, ``c·(c-1)/2`` exchanges per
+class of size ``c``). On the dense packed table (ops/wgl3.py) a CE is
+pure bit algebra — position-mask selects plus an index-bit toggle (an
+in-word butterfly for bits < 5, a word-axis gather for higher bits) —
+and merging is the table's own idempotent OR. On the sort kernel's
+explicit mask rows (ops/wgl2.py) a CE is one vectorized conditional
+XOR, and the merge happens in the existing sort-dedup.
+
+Soundness (why verdicts are bit-identical to dedup-off): the quotient
+map ``canon`` commutes with every kernel operation over the remaining
+history — expansion (class rows are identical, so firable effect
+multisets match), JIT-linearization banking and pruning (class slots
+are never targets, so the banked/pruned bit is canon-invariant), and
+death (canon merges configs, never empties a nonempty frontier). The
+frontier after canonicalization is ``canon(frontier)`` at every step,
+so survival at every prune — and with it ``valid`` / ``survived`` /
+``overflow`` / ``dead_step`` — is exactly the dedup-off kernel's.
+The SEARCH-SIZE metrics (``max_frontier``, ``configs_explored``) do
+shrink: that is the point, and the bench's ``dedup`` lane reports raw
+vs unique configs/s separately so the headline metric cannot silently
+improve by pruning.
+
+Host side, :func:`canon_pairs` derives the per-step exchange network
+from the return-major encoding alone (no model needed — equal rows imply
+equal ``model.step`` behavior for every model): slot ``j`` is
+forever-pending at step ``t`` iff it is active and never appears in
+``targets[t:]``, which is monotone in ``t``, so the network changes at
+most ``K`` times per history and the ``[R, P, 2]`` scan input is cheap
+to build even for 100k-step histories.
+
+Gating lives in :mod:`ops.limits`: ``dedup_mode`` (0 auto / 1 off /
+2 force), ``dedup_min_frontier`` (skip the pass on tiny frontiers —
+always sound), ``dedup_hash_slots`` (the sparse engine's seen-memo
+capacity, ops/wgl3_sparse.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .encode import ReturnSteps
+from .limits import limits
+
+# Re-exported bit constant (ops/wgl3.py owns the packing rationale).
+# jtflow: table-word-bits=5
+from .wgl3 import _LO_MASK
+
+# Pair-capacity buckets: the exchange network rides the scan inputs with
+# a static per-step capacity P; bucketing P bounds compiled shapes per
+# geometry the same way step_bucket bounds scan lengths.
+PAIR_CAP_FLOOR = 4
+
+
+def pair_capacity(n_pairs: int) -> int:
+    """Static per-step pair capacity for a history whose densest step
+    has `n_pairs` exchanges: next power of two, floor PAIR_CAP_FLOOR."""
+    cap = PAIR_CAP_FLOOR
+    while cap < n_pairs:
+        cap *= 2
+    return cap
+
+
+def _selection_network(slots: list[int]) -> list[tuple[int, int]]:
+    """Binary selection-sort network over one class's slot indices
+    (ascending): CE(lo, hi) for every lo < hi pairs the fired bits into
+    the lowest class slots — c·(c-1)/2 exchanges, exact for 0/1 keys."""
+    out = []
+    for i in range(len(slots) - 1):
+        for k in range(i + 1, len(slots)):
+            out.append((slots[i], slots[k]))
+    return out
+
+
+def canon_pairs(rs: ReturnSteps,
+                max_bit: int | None = None) -> np.ndarray | None:
+    """The per-step compare-exchange network ``i32[R, P, 2]`` for this
+    history, or None when no step has any symmetry to reduce.
+
+    A pair ``(lo, hi)`` at step ``t`` means slots ``lo < hi`` hold
+    equal-effect ops that are both forever-pending from ``t`` on (active
+    at ``t``, never again a target). Pad entries are ``(-1, -1)``
+    (identity). ``max_bit`` drops pairs touching slot bits >= it — the
+    lattice-sharded table canonicalizes shard-locally
+    (parallel/lattice.py), which is sound because every CE is
+    individually sound.
+
+    Eligibility is monotone in ``t`` (a forever-pending slot stays
+    active and untargeted through the end), so the network is piecewise
+    constant over at most K+1 segments — the [R, P, 2] array is built
+    per segment, not per step."""
+    R = rs.slot_tabs.shape[0]
+    K = rs.k_slots
+    n = rs.n_steps
+    if n == 0:
+        return None
+    targets = np.asarray(rs.targets[:n])
+    active = np.asarray(rs.slot_active[:n])
+    tabs = np.asarray(rs.slot_tabs[:n])
+    # forever_from[j]: first step index from which slot j never returns
+    # again (0 when j is never a target at all).
+    forever_from = np.zeros(K, dtype=np.int64)
+    for t, j in enumerate(targets):
+        if 0 <= j < K:
+            forever_from[j] = t + 1
+    # start[j]: first step where slot j is BOTH active and past its last
+    # return — the op occupying it from here on never returns. -1 when
+    # the slot is never forever-pending.
+    start = np.full(K, -1, dtype=np.int64)
+    for j in range(K):
+        f0 = int(forever_from[j])
+        if f0 >= n:
+            continue
+        tail = active[f0:, j]
+        hit = np.argmax(tail)
+        if tail[hit]:
+            start[j] = f0 + int(hit)
+    eligible = [j for j in range(K) if start[j] >= 0]
+    if len(eligible) < 2:
+        return None
+    boundaries = sorted({int(start[j]) for j in eligible})
+    seg_pairs: list[tuple[int, list[tuple[int, int]]]] = []
+    max_pairs = 0
+    for b in boundaries:
+        live = [j for j in eligible if start[j] <= b]
+        by_row: dict[tuple, list[int]] = {}
+        for j in live:
+            by_row.setdefault(tuple(tabs[start[j], j].tolist()),
+                              []).append(j)
+        pairs: list[tuple[int, int]] = []
+        for slots in by_row.values():
+            if len(slots) >= 2:
+                pairs.extend(_selection_network(sorted(slots)))
+        if max_bit is not None:
+            pairs = [(lo, hi) for lo, hi in pairs
+                     if lo < max_bit and hi < max_bit]
+        seg_pairs.append((b, pairs))
+        max_pairs = max(max_pairs, len(pairs))
+    if max_pairs == 0:
+        return None
+    P = pair_capacity(max_pairs)
+    out = np.full((R, P, 2), -1, dtype=np.int32)
+    for i, (b, pairs) in enumerate(seg_pairs):
+        if not pairs:
+            continue
+        end = seg_pairs[i + 1][0] if i + 1 < len(seg_pairs) else n
+        row = np.full((P, 2), -1, dtype=np.int32)
+        row[:len(pairs)] = np.asarray(pairs, dtype=np.int32)
+        out[b:end] = row
+    return out
+
+
+def history_canon_pairs(rs: ReturnSteps, max_bit: int | None = None,
+                        table: bool = False):
+    """The padded history's exchange network under the active limits —
+    the ONE copy of the dedup engage policy, shared by the sort ladder
+    (ops/wgl2.py) and every table sweep (wgl3 / wgl3_sparse /
+    parallel/lattice). None when dedup is off (dedup_mode=1) or the
+    history has no symmetry to reduce (the common case: the compiled
+    kernels are then byte-identical to the pre-dedup build).
+
+    ``table=True`` marks a packed-TABLE sweep, where canonicalization
+    engages under dedup_mode=2 (force — the bench/test lane, or a tuned
+    profile that measured it faster) ONLY: a table sweep's cost is
+    fixed in the table size, so the pass pays there only when the
+    shrunken occupancy feeds something downstream — which the dedup
+    tune probe measures per machine. AUTO (0) keeps the pass where
+    frontier size directly drives cost: the resumable sort ladder
+    (measured 4x on symmetry-heavy histories via avoided capacity
+    escalations) and the sparse engine's seen memo."""
+    lim = limits()
+    if lim.dedup_mode == 1 or (table and lim.dedup_mode != 2):
+        return None
+    return canon_pairs(rs, max_bit=max_bit)
+
+
+def dedup_min_frontier_active(lim=None) -> int:
+    """The per-step table-canonicalization gate under the active limits
+    — ONE copy shared by the dense, sparse, and lattice rungs so they
+    gate identically. Orthogonal to dedup_mode: the gate is a per-step
+    COST control (a few table gathers per pair, never repaid by tiny
+    frontiers), not a soundness switch."""
+    if lim is None:
+        lim = limits()
+    return lim.dedup_min_frontier
+
+
+def apply_step_canon(canon_fn, T, pairs, n, is_pad, min_frontier: int,
+                     count_fn=None):
+    """The post-closure canonicalization step shared by the dense,
+    sparse, and lattice scan bodies: gate on (real step, non-empty
+    network, frontier >= min_frontier), canonicalize under the cond so
+    quiet steps pay nothing, and account the shrink. Returns
+    (T', n', canon_pruned, canon_base). ``count_fn`` overrides the
+    popcount reduce — the lattice passes its psum'd variant so the
+    gate (already on the GLOBAL n) and the accounting stay uniform
+    across the mesh."""
+    if count_fn is None:
+        def count_fn(T):
+            return jnp.sum(jax.lax.population_count(T), dtype=jnp.int32)
+
+    do = (~is_pad) & (pairs[0, 0] >= 0) & (n >= jnp.int32(min_frontier))
+
+    def apply(T):
+        Tc = canon_fn(T, pairs)
+        return Tc, count_fn(Tc)
+
+    T2, n2 = jax.lax.cond(do, apply, lambda T: (T, n), T)
+    return T2, n2, n - n2, jnp.where(do, n, 0)
+
+
+def make_table_canon(w_local: int):
+    """``canon(T u32[S, W], pairs i32[P, 2]) -> T'`` over the packed
+    dense table (ops/wgl3.py word packing: 32 configs per u32, mask bit
+    b < 5 in-word, bit b >= 5 in the word index). Valid for pairs whose
+    bits are < 5 + log2(w_local) — the caller (canon_pairs max_bit)
+    guarantees it for sharded tables; the full-width table accepts every
+    bit by construction. Pair indices are TRACED (scan inputs), so one
+    compiled program serves every step's network."""
+    lo_masks = jnp.asarray(np.array(_LO_MASK, dtype=np.uint32))
+    w_idx = jnp.arange(w_local, dtype=jnp.int32)
+    full = jnp.uint32(0xFFFFFFFF)
+
+    def clear_mask(b):
+        """u32[W]: config positions whose mask bit b is CLEAR."""
+        in_word = lo_masks[jnp.minimum(b, 4)]
+        word_level = jnp.where(
+            ((w_idx >> jnp.maximum(b - 5, 0)) & 1) == 0, full,
+            jnp.uint32(0))
+        return jnp.where(b < 5, jnp.broadcast_to(in_word, (w_local,)),
+                         word_level)
+
+    def toggle(T, b):
+        """Re-address every config to the index with mask bit b
+        TOGGLED: an in-word butterfly swap for b < 5, a word-axis XOR
+        gather for b >= 5 (both branches computed, selected — the same
+        traced-bit style as wgl3.table_ops' prune)."""
+        bi = jnp.minimum(b, 4).astype(jnp.uint32)
+        sh = jnp.uint32(1) << bi
+        lom = lo_masks[jnp.minimum(b, 4)]
+        inw = ((T & lom) << sh) | ((T >> sh) & lom)
+        wsel = jnp.where(b < 5, w_idx,
+                         w_idx ^ (jnp.int32(1) << jnp.maximum(b - 5, 0)))
+        return jnp.where(b < 5, inw, T[:, wsel])
+
+    def ce(T, lo, hi):
+        """One compare-exchange: configs with bit hi set / bit lo clear
+        move to the bit-swapped index (OR-merge with whatever is
+        there); everything else is untouched."""
+        amask = clear_mask(lo) & ~clear_mask(hi)
+        src = T & amask[None, :]
+        moved = toggle(toggle(src, hi), lo)
+        return (T & ~amask[None, :]) | moved
+
+    def canon(T, pairs):
+        def body(i, T):
+            lo = pairs[i, 0]
+            hi = pairs[i, 1]
+            return jax.lax.cond(lo >= 0,
+                                lambda t: ce(t, lo, hi),
+                                lambda t: t, T)
+        return jax.lax.fori_loop(0, pairs.shape[0], body, T)
+
+    return canon
+
+
+def canon_keys_packed(keys, pairs, sbits: int, invalid):
+    """Canonicalize packed single-word sort keys (ops/wgl2.py
+    ``state | mask << sbits`` layout): one conditional XOR per traced
+    pair. `invalid` is the all-ones sentinel key (never rewritten)."""
+    sb = jnp.uint32(sbits)
+
+    def body(i, keys):
+        lo = pairs[i, 0]
+        hi = pairs[i, 1]
+
+        def apply(keys):
+            bl = jnp.uint32(1) << (lo.astype(jnp.uint32) + sb)
+            bh = jnp.uint32(1) << (hi.astype(jnp.uint32) + sb)
+            cond = ((keys != invalid) & ((keys & bh) != 0)
+                    & ((keys & bl) == 0))
+            return jnp.where(cond, keys ^ (bl | bh), keys)
+
+        return jax.lax.cond(lo >= 0, apply, lambda k: k, keys)
+
+    return jax.lax.fori_loop(0, pairs.shape[0], body, keys)
+
+
+def canon_masks_words(masks, pairs, slot_bitmask):
+    """Canonicalize explicit multi-word mask rows (ops/wgl2.py unpacked
+    path): ``masks u32[N, W]``, ``slot_bitmask u32[K, W]``
+    (wgl2._slot_constants). Rows without the hi bit (including all-zero
+    invalid lanes) are untouched."""
+
+    def body(i, masks):
+        lo = pairs[i, 0]
+        hi = pairs[i, 1]
+
+        def apply(m):
+            bl = slot_bitmask[lo]
+            bh = slot_bitmask[hi]
+            has_hi = jnp.any((m & bh[None]) != 0, axis=-1)
+            has_lo = jnp.any((m & bl[None]) != 0, axis=-1)
+            cond = (has_hi & ~has_lo)[:, None]
+            return jnp.where(cond, m ^ (bl | bh)[None], m)
+
+        return jax.lax.cond(lo >= 0, apply, lambda m: m, masks)
+
+    return jax.lax.fori_loop(0, pairs.shape[0], body, masks)

@@ -203,6 +203,34 @@ class KernelLimits:
     # dense rounds on overflow — configs are never dropped). 2 is the
     # bench/test lane for exercising the sparse path deterministically.
     sparse_mode: int = _f(0, "arch", 0, 2)
+    # [tunable] Frontier dedup/canonicalization mode (ops/canon.py):
+    # 0 = auto — canonicalize where frontier size directly drives cost
+    # (the resumable sort ladder, wgl2.check_steps_resumable: measured
+    # 4x on symmetry-heavy histories via avoided capacity escalations)
+    # plus the sparse engine's per-tile seen memo; the packed-TABLE
+    # sweeps (dense/sparse/lattice) stay canon-free — their sweep cost
+    # is fixed in the table size, so the pass is pure overhead there
+    # unless measured otherwise.
+    # 1 = off (every kernel byte-identical to the pre-dedup build).
+    # 2 = force — the table sweeps canonicalize too (the bench/test
+    # lane, or a tuned profile on a machine where the `dedup` probe
+    # measured it faster). Exact in every mode: canonicalization is a
+    # verdict-preserving quotient (doc/perf.md "Frontier dedup"), so
+    # the tuner may search it freely.
+    dedup_mode: int = _f(0, "tunable", 0, 2, group="dedup")
+    # [tunable] Slot capacity of the sparse engine's device-side `seen`
+    # memo (one consumed-popcount slot per occupancy tile, direct
+    # indexed — collision-free by construction). Geometries with more
+    # tiles than slots FAIL OPEN to no-memo (every live tile re-swept,
+    # exactly the pre-dedup behavior) so verdicts stay exact; the memo
+    # array costs 4 bytes/slot of device memory per compiled geometry.
+    dedup_hash_slots: int = _f(4096, "tunable", 64, 1 << 20, group="dedup")
+    # [tunable] Converged-frontier size below which the per-step TABLE
+    # canonicalization pass is skipped: the pass costs a few table
+    # gathers per symmetry pair, which tiny frontiers never repay.
+    # Skipping is always sound (canonicalization is an optimization,
+    # not a correctness pass); orthogonal to dedup_mode.
+    dedup_min_frontier: int = _f(64, "tunable", 0, 1 << 20, group="dedup")
     # [tunable] Return steps per streamed check chunk (stream/engine.py):
     # the stable-prefix dispatcher accumulates this many stable return
     # steps before feeding one resumable dense chunk to the device.

@@ -142,12 +142,23 @@ def _dedup_packed(keys, f_cap):
     return out, n_unique
 
 
-def make_step_fn2(model: Model, cfg: WGLConfig):
+def make_step_fn2(model: Model, cfg: WGLConfig, canon: bool = False):
+    """Sort-kernel scan body. With ``canon=True`` the scan inputs gain
+    the per-step compare-exchange network (ops/canon.py) and every
+    expansion round canonicalizes frontier + candidate masks BEFORE the
+    sort-dedup, so symmetric configs (equal-effect forever-pending ops
+    fired in different orders) merge as duplicates — the frontier stays
+    small enough that combinatorial histories stop escalating f_cap.
+    Verdict-exact (the canonical config is reachable by a real
+    linearization; soundness argument in ops/canon.py); the default
+    build is byte-identical to the pre-dedup kernel."""
     word_of, bit_of, slot_bitmask = _slot_constants(cfg)
     f_cap, k = cfg.f_cap, cfg.k_slots
     use_packed = packable(model, cfg)
     sbits = cfg.state_bits
     soff = model.state_offset
+    if canon:
+        from .canon import canon_keys_packed, canon_masks_words
 
     def bits_set(masks):
         return (masks[:, word_of] >> bit_of) & jnp.uint32(1)
@@ -165,7 +176,10 @@ def make_step_fn2(model: Model, cfg: WGLConfig):
             jnp.where(valid[:, None], masks, jnp.uint32(0)), valid
 
     def step(carry: _Carry2, xs):
-        slot_tab, slot_active, target, idx = xs
+        if canon:
+            slot_tab, slot_active, target, idx, pairs = xs
+        else:
+            slot_tab, slot_active, target, idx = xs
         is_pad = target < 0
         tgt = jnp.maximum(target, 0)
         t_word, t_bit = word_of[tgt], bit_of[tgt]
@@ -193,6 +207,9 @@ def make_step_fn2(model: Model, cfg: WGLConfig):
                     pack(states, masks[:, 0], valid),
                     pack(nxt.reshape(-1), cand_words.reshape(-1),
                          cand_valid.reshape(-1))])
+                if canon:
+                    all_keys = canon_keys_packed(all_keys, pairs, sbits,
+                                                 PACKED_INVALID)
                 keys, n_unique = _dedup_packed(all_keys, f_cap)
                 s2, m2, v2 = unpack(keys)
                 return s2, m2, v2, n_unique
@@ -201,6 +218,9 @@ def make_step_fn2(model: Model, cfg: WGLConfig):
             all_masks = jnp.concatenate(
                 [masks, cand_masks.reshape(-1, cfg.words)])
             all_valid = jnp.concatenate([valid, cand_valid.reshape(-1)])
+            if canon:
+                all_masks = canon_masks_words(all_masks, pairs,
+                                              slot_bitmask)
             return _dedup(all_states, all_masks, all_valid, f_cap)
 
         def cond(st):
@@ -351,21 +371,29 @@ def check_steps(rs: ReturnSteps, model: Model | None = None,
 # configs can only make death MORE likely... dropping cannot create
 # death-free runs; a died+overflowed chunk is re-run too).
 
-def _chunk_fn(model: Model, cfg: WGLConfig):
-    step = make_step_fn2(model, cfg)
+def _chunk_fn(model: Model, cfg: WGLConfig, canon: bool = False):
+    step = make_step_fn2(model, cfg, canon=canon)
 
-    def run(carry, slot_tabs, slot_active, targets, idxs):
-        final, _ = jax.lax.scan(
-            step, carry, (slot_tabs, slot_active, targets, idxs))
-        return final
+    if canon:
+        def run(carry, slot_tabs, slot_active, targets, idxs, pairs):
+            final, _ = jax.lax.scan(
+                step, carry, (slot_tabs, slot_active, targets, idxs,
+                              pairs))
+            return final
+    else:
+        def run(carry, slot_tabs, slot_active, targets, idxs):
+            final, _ = jax.lax.scan(
+                step, carry, (slot_tabs, slot_active, targets, idxs))
+            return final
 
     return jax.jit(run)
 
 
-def cached_chunk2(model: Model, cfg: WGLConfig):
-    key = ("chunk2", model.cache_key(), cfg)
+def cached_chunk2(model: Model, cfg: WGLConfig, canon: bool = False):
+    key = ("chunk2", model.cache_key(), cfg, canon)
     if key not in _CACHE:
-        _CACHE[key] = instrument_kernel("wgl2-chunk", _chunk_fn(model, cfg))
+        _CACHE[key] = instrument_kernel(
+            "wgl2-chunk", _chunk_fn(model, cfg, canon=canon))
     return _CACHE[key]
 
 
@@ -438,6 +466,16 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
     r = rs.n_steps
     padded = rs.padded_to(((r + chunk - 1) // chunk or 1) * chunk)
     tabs, act, tgt = steps_arrays(padded)
+    # Frontier canonicalization (ops/canon.py): symmetric configs over
+    # equal-effect forever-pending ops merge in the sort-dedup, which is
+    # exactly what keeps the combinatorial histories this resumable
+    # ladder exists for from escalating f_cap 4x per overflow. None for
+    # histories with no symmetry (or dedup_mode gating it off): the
+    # compiled kernel is then byte-identical to the pre-dedup build.
+    from .canon import history_canon_pairs
+
+    pairs_np = history_canon_pairs(padded)
+    pairs_dev = None if pairs_np is None else jnp.asarray(pairs_np)
     cfg = config_for(rs, model, f_cap)
     carry = _init_carry2(model, cfg)
     escalations = 0
@@ -461,6 +499,9 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
     def dispatch(c0: int, pre: _Carry2) -> _Carry2:
         sl = slice(c0, c0 + chunk)
         idxs = jnp.arange(c0, c0 + chunk, dtype=jnp.int32)
+        if pairs_dev is not None:
+            return cached_chunk2(model, cfg, canon=True)(
+                pre, tabs[sl], act[sl], tgt[sl], idxs, pairs_dev[sl])
         return cached_chunk2(model, cfg)(
             pre, tabs[sl], act[sl], tgt[sl], idxs)
 

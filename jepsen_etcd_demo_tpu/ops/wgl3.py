@@ -233,16 +233,31 @@ def live_tile_geometry(cfg: DenseConfig,
     return tile, w // tile
 
 
-def make_step_fn3(model: Model, cfg: DenseConfig):
+def make_step_fn3(model: Model, cfg: DenseConfig, canon: bool = False,
+                  min_frontier: int = 0):
     """Scan body over the bit-packed table (see table_ops for the bit
     algebra). Each step additionally emits the converged table's live-
     TILE count (occupancy over live_tile_geometry tiles) — the telemetry
     behind the wgl.live_tile_ratio gauge and the sparse engine's density
     signal (ops/wgl3_sparse.py); one O(S*W) reduce per step, ~1/K of a
-    single sweep's cost."""
+    single sweep's cost.
+
+    With ``canon=True`` the scan inputs gain a per-step compare-exchange
+    network (ops/canon.py canon_pairs) and each step canonicalizes the
+    CONVERGED frontier before metrics and prune — symmetry-reducing
+    equal-effect forever-pending ops, a verdict-preserving quotient (the
+    soundness argument lives in ops/canon.py). The step then emits two
+    extra outputs (configs pruned by canonicalization, the pre-canon
+    count at canon-applied steps). ``min_frontier`` skips the pass on
+    converged frontiers below it (always sound; dedup_mode=2 passes 0).
+    The default build is byte-identical to the pre-dedup kernel."""
     ops = table_ops(model, cfg)
     allowed_mask, transitions = ops.allowed_mask, ops.transitions
     tile, n_tiles = live_tile_geometry(cfg)
+    if canon:
+        from .canon import apply_step_canon, make_table_canon
+
+        canon_fn = make_table_canon(1 << (cfg.k_slots - 5))
 
     def live_tiles(T):
         any_w = jnp.any(T != jnp.uint32(0), axis=0)
@@ -250,7 +265,10 @@ def make_step_fn3(model: Model, cfg: DenseConfig):
                        dtype=jnp.int32)
 
     def step(carry: _Carry3, xs):
-        trans, target, idx = xs
+        if canon:
+            trans, target, idx, pairs = xs
+        else:
+            trans, target, idx = xs
         is_pad = target < 0
         t = jnp.maximum(target, 0)
 
@@ -271,6 +289,14 @@ def make_step_fn3(model: Model, cfg: DenseConfig):
         T, n, _c, _r = jax.lax.while_loop(
             cond, body, (carry.table, n0, ~is_pad, jnp.int32(0)))
 
+        if canon:
+            # Canonicalize the converged frontier BEFORE metrics and
+            # prune: max_frontier / configs_explored count UNIQUE
+            # (canonical) configs, and the occupancy the sparse signal
+            # sees is the reduced one (apply_step_canon gates the pass
+            # so quiet steps pay nothing).
+            T, n, canon_pruned, canon_base = apply_step_canon(
+                canon_fn, T, pairs, n, is_pad, min_frontier)
         live = live_tiles(T)
         pruned = ops.prune(T, t, allowed)
         T_new = jnp.where(is_pad, T, pruned)
@@ -278,17 +304,19 @@ def make_step_fn3(model: Model, cfg: DenseConfig):
         died = ~is_pad & ~carry.dead & ~alive
         dead = carry.dead | died
         T_new = jnp.where(dead, jnp.zeros_like(T_new), T_new)
-        return _Carry3(
-            table=T_new, dead=dead,
-            dead_step=jnp.where(died & (carry.dead_step < 0), idx,
-                                carry.dead_step),
-            max_frontier=jnp.maximum(carry.max_frontier, n)), (
-                jnp.where(is_pad, 0, n),
+        outs = (jnp.where(is_pad, 0, n),
                 jnp.where(is_pad, 0, live))
         #       pads do no search work: keep the configs-explored and
         #       live-tile metrics padding-invariant (scan buckets here,
         #       chunk alignment in the pallas kernel — both must agree
         #       exactly)
+        if canon:
+            outs = outs + (canon_pruned, canon_base)
+        return _Carry3(
+            table=T_new, dead=dead,
+            dead_step=jnp.where(died & (carry.dead_step < 0), idx,
+                                carry.dead_step),
+            max_frontier=jnp.maximum(carry.max_frontier, n)), outs
 
     return step, transitions
 
@@ -372,6 +400,69 @@ def _chunk_fn(model: Model, cfg: DenseConfig):
     return jax.jit(run, donate_argnums=(0,))
 
 
+def _chunk_fn_dedup(model: Model, cfg: DenseConfig, min_frontier: int):
+    """Canonicalizing twin of _chunk_fn: the scan inputs gain the
+    per-step exchange network (pairs i32[C, P, 2]) and the partial row
+    grows the dedup accounting — configs pruned by canonicalization and
+    the pre-canon config count at canon-applied steps (the
+    frontier_dedup_ratio denominator). Built ONLY for histories whose
+    network is non-empty (canon_pairs returned rows), so the default
+    path's compiled shapes never change."""
+    step, transitions = make_step_fn3(model, cfg, canon=True,
+                                      min_frontier=min_frontier)
+
+    def run(carry, tabs, act, tgts, pairs, idx0):
+        trans = jax.vmap(transitions)(tabs, act)
+        idxs = idx0 + jnp.arange(tgts.shape[0], dtype=jnp.int32)
+        carry, (ns, lives, pruned, base) = jax.lax.scan(
+            step, carry, (trans, tgts, idxs, pairs))
+        # jtflow: partials configs_explored,live_tile_sum,real_steps,canon_pruned,canon_base
+        return carry, jnp.stack([
+            jnp.sum(ns.astype(jnp.float32)),
+            jnp.sum(lives.astype(jnp.float32)),
+            jnp.sum((tgts >= 0).astype(jnp.float32)),
+            jnp.sum(pruned.astype(jnp.float32)),
+            jnp.sum(base.astype(jnp.float32))])
+
+    return jax.jit(run, donate_argnums=(0,))
+
+
+def dedup_min_frontier_active(lim=None) -> int:
+    """Lazy alias of ops/canon.dedup_min_frontier_active (the policy's
+    one copy lives there; the alias keeps wgl3 the import point for the
+    sweep-side consumers without a module-level canon import, which
+    would be circular)."""
+    from .canon import dedup_min_frontier_active as _f
+
+    return _f(lim)
+
+
+def history_canon_pairs(rs: ReturnSteps, max_bit: int | None = None,
+                        table: bool = False):
+    """Lazy alias of ops/canon.history_canon_pairs — the ONE copy of
+    the dedup engage policy (see its docstring for the auto/force
+    scoping rationale)."""
+    from .canon import history_canon_pairs as _f
+
+    return _f(rs, max_bit=max_bit, table=table)
+
+
+def attach_dedup_record(out: dict, pruned: float, base: float) -> None:
+    """Fold the chunked partials' canonicalization accounting into the
+    result dict: configs pruned, the pre-canon base count, and the
+    frontier_dedup_ratio (pruned/base over canon-applied steps) behind
+    the wgl.configs_pruned counter and wgl.frontier_dedup_ratio gauge
+    (obs.record_check_result). ONE copy shared by the dense, sparse,
+    and lattice long sweeps."""
+    pruned = max(0, int(pruned))
+    base = max(0, int(base))
+    out["dedup"] = {
+        "configs_pruned": pruned,
+        "canon_base": base,
+        "frontier_dedup_ratio": round(pruned / base, 4) if base else 0.0,
+    }
+
+
 def default_scan_chunk(cfg: DenseConfig) -> int:
     """Host-loop chunk size: scales inversely with table width (sweep cost
     per step is proportional to cells). Floor 128: at the chunked-budget
@@ -393,9 +484,19 @@ def _cached_chunk_run(model: Model, cfg: DenseConfig, chunk: int):
     return _CACHE[key]
 
 
+def _cached_chunk_run_dedup(model: Model, cfg: DenseConfig, chunk: int,
+                            min_frontier: int):
+    key = ("chunk3-dedup", model.cache_key(), cfg, chunk, min_frontier)
+    if key not in _CACHE:
+        _CACHE[key] = instrument_kernel(
+            "wgl3-chunk-dedup", _chunk_fn_dedup(model, cfg, min_frontier))
+    return _CACHE[key]
+
+
 def sweep_summary(cfg: DenseConfig, live_sum: float, real_steps: int,
                   sparse_steps: int = 0,
-                  tiling: tuple[int, int] | None = None) -> dict:
+                  tiling: tuple[int, int] | None = None,
+                  overflow_rounds: int = 0) -> dict:
     """The per-run sweep-mode/occupancy record the long sweeps attach to
     their result dicts (and record_check_result folds into the metrics
     registry): which sweep mode the steps ran under and the mean live-
@@ -417,7 +518,11 @@ def sweep_summary(cfg: DenseConfig, live_sum: float, real_steps: int,
     return {"mode": mode,
             "live_tile_ratio": round(min(max(ratio, 0.0), 1.0), 4),
             "steps_sparse": sparse, "steps_dense": dense,
-            "tiles": n_tiles, "tile_words": tile}
+            "tiles": n_tiles, "tile_words": tile,
+            # Work-list overflows that forced a dense closure round
+            # (ops/wgl3_sparse.py — the previously-silent fallback,
+            # surfaced as the wgl.sparse_overflow_rounds counter).
+            "overflow_rounds": max(0, int(overflow_rounds))}
 
 
 def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
@@ -463,10 +568,20 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
     t0 = _time.monotonic()
     if chunk is None:
         chunk = default_scan_chunk(cfg)
-    run = _cached_chunk_run(model, cfg, chunk)
     n = rs.n_steps
     n_pad = (n + chunk - 1) // chunk * chunk
     rs = rs.padded_to(n_pad)
+    # Frontier canonicalization (ops/canon.py): when the history carries
+    # equal-effect forever-pending ops (and dedup_mode allows), the scan
+    # threads the per-step exchange network and every step symmetry-
+    # reduces the converged frontier. Histories with no symmetry — the
+    # common case — take the byte-identical pre-dedup chunk fn.
+    pairs = history_canon_pairs(rs, table=True)
+    if pairs is not None:
+        run = _cached_chunk_run_dedup(model, cfg, chunk,
+                                      dedup_min_frontier_active())
+    else:
+        run = _cached_chunk_run(model, cfg, chunk)
     carry = _init_carry3(model, cfg)
     cfgs_dev = None
     if time_budget_s is None:
@@ -474,10 +589,12 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
 
         def stage(c):
             sl = slice(c * chunk, (c + 1) * chunk)
-            return (jnp.asarray(rs.slot_tabs[sl]),
-                    jnp.asarray(rs.slot_active[sl]),
-                    jnp.asarray(rs.targets[sl]),
-                    jnp.int32(c * chunk))
+            staged = (jnp.asarray(rs.slot_tabs[sl]),
+                      jnp.asarray(rs.slot_active[sl]),
+                      jnp.asarray(rs.targets[sl]))
+            if pairs is not None:
+                staged = staged + (jnp.asarray(pairs[sl]),)
+            return staged + (jnp.int32(c * chunk),)
 
         done = 0
         for staged in double_buffer(range(n_pad // chunk), stage):
@@ -500,10 +617,12 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
                                  f"{time_budget_s:.0f}s time budget at "
                                  f"return step {c * chunk}"}
             sl = slice(c * chunk, (c + 1) * chunk)
-            carry, part = run(carry, jnp.asarray(rs.slot_tabs[sl]),
-                              jnp.asarray(rs.slot_active[sl]),
-                              jnp.asarray(rs.targets[sl]),
-                              jnp.int32(c * chunk))
+            args = (jnp.asarray(rs.slot_tabs[sl]),
+                    jnp.asarray(rs.slot_active[sl]),
+                    jnp.asarray(rs.targets[sl]))
+            if pairs is not None:
+                args = args + (jnp.asarray(pairs[sl]),)
+            carry, part = run(carry, *args, jnp.int32(c * chunk))
             cfgs_dev = part if cfgs_dev is None else cfgs_dev + part
             # Early exit on death: one 1-byte fetch per chunk (~0.1 s on
             # a tunneled backend) vs minutes of dead chunks on wide
@@ -515,11 +634,13 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
                 break
     from .wgl import verdict
 
+    n_parts = 5 if pairs is not None else 3
     if cfgs_dev is None:
-        cfgs_dev = jnp.zeros((3,), jnp.float32)
+        cfgs_dev = jnp.zeros((n_parts,), jnp.float32)
     # One packed fetch at the end (chunks chain device-side): 3 verdict
     # fields + the chunk fn's declared partial row.
     # jtflow: partials-from wgl3._chunk_fn
+    # jtflow: partials-from wgl3._chunk_fn_dedup
     packed = np.asarray(jnp.concatenate([
         jnp.stack([jnp.where(carry.dead, 0, 1),
                    carry.dead_step, carry.max_frontier]),
@@ -534,6 +655,12 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
     out["sweep"] = sweep_summary(cfg, live_sum=float(packed[4]),
                                  real_steps=int(packed[5]))
     out["live_tile_ratio"] = out["sweep"]["live_tile_ratio"]
+    if pairs is not None:
+        # The canon columns are the LAST two of the dedup layout by
+        # construction (wgl3._chunk_fn_dedup) — negative indexing keeps
+        # the base-layout reads above layout-checkable (JTL401).
+        attach_dedup_record(out, pruned=float(packed[-2]),
+                            base=float(packed[-1]))
     out["valid"] = verdict(out)
     record_check_result(out)
     return out

@@ -461,17 +461,20 @@ def check_steps3_long_pallas(rs, model: Model, cfg: DenseConfig,
     kernel-side i32 configs accumulator (exact where the XLA path's f32
     partial sums are approximate past 2^24).
 
-    Under limits().sparse_mode == 2 (prefer-sparse, an explicit opt-in)
-    eligible geometries take the sparse work-list kernel instead
-    (check_steps3_long_pallas_sparse) — bit-identical verdicts, plus the
-    sweep telemetry record."""
+    Geometries the density signal selects sparse for (the SAME
+    sparse_plan policy the XLA engine routes by — prefer-sparse
+    sparse_mode=2 forces it, auto mode engages past the measured
+    crossover) take the sparse work-list kernel instead
+    (check_steps3_long_pallas_sparse) — bit-identical verdicts, plus
+    the sweep telemetry record. This is the routed DEFAULT since
+    ISSUE 10; sparse_mode=1 keeps the dense kernel unconditionally."""
     import time as _time
 
     from . import wgl3
     from .wgl import verdict
 
     lim = limits()
-    if lim.sparse_mode == 2 and pallas_sparse_blocks(cfg):
+    if pallas_sparse_selected(cfg):
         return check_steps3_long_pallas_sparse(
             rs, model, cfg, time_budget_s=time_budget_s,
             interpret=interpret)
@@ -585,6 +588,23 @@ def pallas_sparse_blocks(cfg: DenseConfig) -> int:
     w = 1 << (cfg.k_slots - 5)
     nb = w // SPARSE_BLOCK_LANES
     return nb if nb >= 2 else 0
+
+
+def pallas_sparse_selected(cfg: DenseConfig) -> bool:
+    """Routing predicate of the pallas long sweep: take the sparse
+    work-list kernel wherever the DENSITY SIGNAL already selects sparse
+    for this geometry — literally the XLA engine's own sparse_plan
+    policy (sparse_mode 0 engages past the measured sparse_min_tiles
+    crossover, 2 forces it, 1 disables) — provided the table spans
+    work-list blocks at all. ISSUE 10 flipped this from the old
+    explicit sparse_mode=2 opt-in: a tuned profile that lowers the
+    crossover (tune/probes.py `sparse` and `pallas` groups) now routes
+    the Mosaic work-list kernel by default, no operator pin needed."""
+    if not pallas_sparse_blocks(cfg):
+        return False
+    from .wgl3_sparse import sparse_plan
+
+    return sparse_plan(cfg) is not None
 
 
 def _kernel_body_sparse_resumable(cfg: DenseConfig, nb: int,
@@ -903,8 +923,9 @@ def check_steps3_long_pallas_sparse(rs, model: Model, cfg: DenseConfig,
     """Host-chained SPARSE fused-kernel sweep: the work-list kernel's
     twin of check_steps3_long_pallas (same windowing, same budget
     contract, bit-identical verdicts), plus the sweep-mode/live-block
-    telemetry record. Opt-in — the production router only takes it under
-    limits().sparse_mode == 2 (see pallas_sparse_blocks)."""
+    telemetry record. Routed by default wherever the density signal
+    selects sparse (pallas_sparse_selected — the ISSUE 10 flip;
+    sparse_mode=2 still forces it for measurement)."""
     import time as _time
 
     from . import wgl3

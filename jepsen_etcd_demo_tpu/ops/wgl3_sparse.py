@@ -75,11 +75,18 @@ _CACHE: dict[tuple, Any] = {}
 class SparsePlan:
     """The static shape of one geometry's sparse sweep: tile size, tile
     count, gather capacity, and the effective live-tile threshold above
-    which a round runs dense. Hashable — part of the jit cache key."""
+    which a round runs dense. Hashable — part of the jit cache key.
+
+    ``thresh_density`` keeps the RAW density threshold (before the min
+    with the work-list cap) so the step fn can tell an overflow-forced
+    dense round (live <= density but > cap — the silent fallback the
+    wgl.sparse_overflow_rounds counter surfaces) from a density-chosen
+    one."""
     tile_words: int     # TILE: packed words per occupancy tile (pow2)
     n_tiles: int        # W / TILE
     cap: int            # static work-list capacity (tiles gathered)
     thresh_tiles: int   # live-tile count above which the round is dense
+    thresh_density: int = 0   # raw density threshold (>= thresh_tiles)
 
 
 def sparse_plan(cfg: DenseConfig, words: int | None = None
@@ -112,12 +119,32 @@ def sparse_plan(cfg: DenseConfig, words: int | None = None
     else:
         thresh = max(1, n_tiles * lim.sparse_density_threshold_pct // 100)
     return SparsePlan(tile_words=tile, n_tiles=n_tiles, cap=cap,
-                      thresh_tiles=min(thresh, cap))
+                      thresh_tiles=min(thresh, cap), thresh_density=thresh)
+
+
+def memo_slots_for(plan: SparsePlan, lim=None) -> int:
+    """Slot count of the device-side `seen` memo for this plan — the
+    tile count when the memo engages, 0 when it stays off. The memo is
+    direct-indexed (one consumed-popcount slot per tile: collision-free
+    by construction), so a geometry with more tiles than
+    limits().dedup_hash_slots FAILS OPEN to no-memo — every live tile
+    re-swept each round, the exact pre-dedup behavior — rather than
+    risking a collision-aliased skip."""
+    if lim is None:
+        lim = limits()
+    if lim.dedup_mode == 1 or plan.n_tiles > lim.dedup_hash_slots:
+        return 0
+    return plan.n_tiles
 
 
 def make_sparse_sweep(model: Model, cfg: DenseConfig, plan: SparsePlan):
-    """(T, allowed, trans, occ_t, live) -> T': one gather->expand->
-    scatter round over the live tiles.
+    """(T, allowed, trans, idx, count) -> T': one gather->expand->
+    scatter round over the tiles listed in ``idx`` (u32 tile indices,
+    CAP-padded; ``count`` real entries). The caller builds the list from
+    live occupancy — or, with the seen memo, from the tiles whose
+    content GREW since they were last swept this step (skipping a
+    non-grown tile is sound: the table is monotone, so equal popcount
+    means equal content and its expansion is already applied).
 
     LOCKSTEP NOTE: parallel/lattice.py `sweep_sparse` is this sweep's
     shard-local mirror (same gather, same in-word/in-tile/tile-bit
@@ -136,12 +163,12 @@ def make_sparse_sweep(model: Model, cfg: DenseConfig, plan: SparsePlan):
     tile_off = jnp.arange(TILE, dtype=jnp.int32)
     cap_ids = jnp.arange(CAP, dtype=jnp.int32)
 
-    def sweep(T, allowed, trans, occ_t, live):
-        # Static-capacity gather of the live tiles. Pad entries index
-        # tile 0 and are zeroed via `valid`, so their scatter adds are
-        # zeros (harmless under the unique-destination adds below).
-        idx = jnp.nonzero(occ_t, size=CAP, fill_value=0)[0]
-        valid = cap_ids < live
+    def sweep(T, allowed, trans, idx, count):
+        # Static-capacity gathered work list (built by the caller). Pad
+        # entries index tile 0 and are zeroed via `valid`, so their
+        # scatter adds are zeros (harmless under the unique-destination
+        # adds below).
+        valid = cap_ids < count
         cols = idx[:, None] * TILE + tile_off[None, :]        # [CAP, TILE]
         flat = cols.reshape(-1)
         G = jnp.where(valid[None, :, None], T[:, cols], jnp.uint32(0))
@@ -187,53 +214,151 @@ def make_sparse_sweep(model: Model, cfg: DenseConfig, plan: SparsePlan):
     return sweep
 
 
-def make_step_fn3_sparse(model: Model, cfg: DenseConfig, plan: SparsePlan):
+def make_step_fn3_sparse(model: Model, cfg: DenseConfig, plan: SparsePlan,
+                         canon: bool = False, min_frontier: int = 0,
+                         memo_slots: int = 0):
     """Scan body mirroring wgl3.make_step_fn3 with the closure round
     replaced by the density-switched sparse/dense hybrid. Per-step scan
     outputs: (configs live after convergence, live tiles after
-    convergence, every-round-ran-sparse flag) — pads emit zeros."""
+    convergence, every-round-ran-sparse flag, overflow-forced dense
+    rounds) — pads emit zeros.
+
+    ``memo_slots`` (memo_slots_for) enables the device-side `seen`
+    memo: one consumed-popcount slot per occupancy tile, reset each
+    step. A sparse round then gathers only the tiles whose content GREW
+    since last swept — exact because the table is a monotone OR-lattice
+    (equal popcount ⟺ equal content), so a non-grown tile's expansion
+    is already in the table (its local fires landed in the scatter, its
+    cross-tile fires in the destination tiles). A round with nothing
+    eligible skips the gather/expand entirely (the fixpoint-
+    verification round costs one reduce instead of a sweep), and a
+    dense round invalidates the memo wholesale (Gauss-Seidel consumes
+    mid-sweep content, so per-tile consumed counts are undefined).
+
+    ``canon``/``min_frontier``: the per-step frontier canonicalization
+    pass (ops/canon.py), applied to the CONVERGED table exactly like
+    wgl3.make_step_fn3 — the scan inputs gain the exchange network and
+    the outputs gain (canon_pruned, canon_base)."""
     ops = table_ops(model, cfg)
     sweep = make_sparse_sweep(model, cfg, plan)
-    TILE, NT = plan.tile_words, plan.n_tiles
+    TILE, NT, CAP = plan.tile_words, plan.n_tiles, plan.cap
     thresh = plan.thresh_tiles
+    thresh_density = max(plan.thresh_density, plan.thresh_tiles)
     transitions = ops.transitions
+    memo = memo_slots > 0
+    assert not memo or memo_slots == NT, (memo_slots, NT)
+    cap_ids = jnp.arange(CAP, dtype=jnp.int32)
+    if canon:
+        from .canon import apply_step_canon, make_table_canon
+
+        canon_fn = make_table_canon(1 << (cfg.k_slots - 5))
 
     def occupancy(T):
         any_w = jnp.any(T != jnp.uint32(0), axis=0)
         occ_t = jnp.any(any_w.reshape(NT, TILE), axis=1)
         return occ_t, jnp.sum(occ_t, dtype=jnp.int32)
 
+    def tile_popcounts(T):
+        """i32[NT] per-tile config counts — the memo's change detector.
+        Sum-of-tiles = the table popcount, and the memo loop CARRIES the
+        vector between rounds, so eligibility and the convergence check
+        share one O(S*W) reduce per round."""
+        pc = jax.lax.population_count(T).astype(jnp.int32)
+        return jnp.sum(pc.reshape(cfg.n_states, NT, TILE), axis=(0, 2))
+
+    def worklist(mask, count):
+        idx = jnp.nonzero(mask, size=CAP, fill_value=0)[0]
+        return idx, jnp.minimum(count, jnp.int32(CAP))
+
     def step(carry, xs):
-        trans, target, idx = xs
+        if canon:
+            trans, target, idx, pairs = xs
+        else:
+            trans, target, idx = xs
         is_pad = target < 0
         t = jnp.maximum(target, 0)
         allowed = ops.allowed_mask(t)
 
         def body(st):
-            T, n_prev, _changed, rounds, sp_rounds = st
-            occ_t, live = occupancy(T)
+            if memo:
+                (T, pc, n_prev, _changed, rounds, sp_rounds, ovf_rounds,
+                 swept) = st
+                occ_t = pc > 0
+                live = jnp.sum(occ_t, dtype=jnp.int32)
+                elig_t = occ_t & (pc != swept)
+                elig = jnp.sum(elig_t, dtype=jnp.int32)
+            else:
+                T, n_prev, _changed, rounds, sp_rounds, ovf_rounds = st
+                occ_t, live = occupancy(T)
+                elig_t, elig = occ_t, live
             # The direction-optimizing switch, PER ROUND: a frontier
             # that fills up mid-closure crosses to dense (and back) with
             # no host involvement; a work-list overflow (live > cap) is
-            # just a dense round — configs are never dropped.
+            # just a dense round — configs are never dropped, but the
+            # fallback is COUNTED (wgl.sparse_overflow_rounds).
             use_sparse = live <= thresh
+            ovf = (~use_sparse) & (live <= jnp.int32(thresh_density))
+            wl, count = worklist(elig_t, elig)
+
+            def run_sparse(T):
+                if memo:
+                    # Skip the whole gather/expand when nothing grew —
+                    # the fixpoint-verification round for free.
+                    return jax.lax.cond(
+                        elig > 0,
+                        lambda T: sweep(T, allowed, trans, wl, count),
+                        lambda T: T, T)
+                return sweep(T, allowed, trans, wl, count)
+
             T = jax.lax.cond(
-                use_sparse,
-                lambda T: sweep(T, allowed, trans, occ_t, live),
+                use_sparse, run_sparse,
                 lambda T: ops.dense_sweep(T, allowed, trans),
                 T)
+            if memo:
+                # One reduce serves next round's eligibility AND this
+                # round's convergence check.
+                pc2 = tile_popcounts(T)
+                n_now = jnp.sum(pc2, dtype=jnp.int32)
+                # Record each gathered tile's CONSUMED count (its
+                # content may grow during its own sweep — it then
+                # mismatches and re-sweeps next round, which is the
+                # convergence check). A dense round invalidates all.
+                swept2 = swept.at[
+                    jnp.where(cap_ids < count, wl, jnp.int32(NT))].set(
+                        pc[wl], mode="drop")
+                swept = jnp.where(use_sparse, swept2,
+                                  jnp.full((NT,), -1, jnp.int32))
+                return (T, pc2, n_now, n_now > n_prev, rounds + 1,
+                        sp_rounds + use_sparse.astype(jnp.int32),
+                        ovf_rounds + ovf.astype(jnp.int32), swept)
             n_now = jnp.sum(jax.lax.population_count(T), dtype=jnp.int32)
             return (T, n_now, n_now > n_prev, rounds + 1,
-                    sp_rounds + use_sparse.astype(jnp.int32))
+                    sp_rounds + use_sparse.astype(jnp.int32),
+                    ovf_rounds + ovf.astype(jnp.int32))
+
+        ci = 3 if memo else 2   # index of `changed` in the loop state
 
         def cond(st):
-            return st[2] & (st[3] < cfg.rounds)
+            return st[ci] & (st[ci + 1] < cfg.rounds)
 
-        n0 = jnp.sum(jax.lax.population_count(carry.table),
-                     dtype=jnp.int32)
-        T, n, _c, rounds, sp_rounds = jax.lax.while_loop(
-            cond, body, (carry.table, n0, ~is_pad, jnp.int32(0),
-                         jnp.int32(0)))
+        if memo:
+            pc0 = tile_popcounts(carry.table)
+            init = (carry.table, pc0,
+                    jnp.sum(pc0, dtype=jnp.int32), ~is_pad,
+                    jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                    jnp.full((NT,), -1, jnp.int32))
+            fin = jax.lax.while_loop(cond, body, init)
+            T, _pc, n, _c, rounds, sp_rounds, ovf_rounds = fin[:7]
+        else:
+            n0 = jnp.sum(jax.lax.population_count(carry.table),
+                         dtype=jnp.int32)
+            init = (carry.table, n0, ~is_pad, jnp.int32(0), jnp.int32(0),
+                    jnp.int32(0))
+            fin = jax.lax.while_loop(cond, body, init)
+            T, n, _c, rounds, sp_rounds, ovf_rounds = fin[:6]
+        if canon:
+            T, n, canon_pruned, canon_base = apply_step_canon(
+                canon_fn, T, pairs, n, is_pad, min_frontier)
         _occ, live_fin = occupancy(T)
         pruned = ops.prune(T, t, allowed)
         T_new = jnp.where(is_pad, T, pruned)
@@ -242,46 +367,96 @@ def make_step_fn3_sparse(model: Model, cfg: DenseConfig, plan: SparsePlan):
         dead = carry.dead | died
         T_new = jnp.where(dead, jnp.zeros_like(T_new), T_new)
         sparse_all = (~is_pad) & (rounds > 0) & (sp_rounds == rounds)
+        outs = (jnp.where(is_pad, 0, n),
+                jnp.where(is_pad, 0, live_fin),
+                sparse_all.astype(jnp.int32),
+                jnp.where(is_pad, 0, ovf_rounds))
+        if canon:
+            outs = outs + (canon_pruned, canon_base)
         return _Carry3(
             table=T_new, dead=dead,
             dead_step=jnp.where(died & (carry.dead_step < 0), idx,
                                 carry.dead_step),
-            max_frontier=jnp.maximum(carry.max_frontier, n)), (
-                jnp.where(is_pad, 0, n),
-                jnp.where(is_pad, 0, live_fin),
-                sparse_all.astype(jnp.int32))
+            max_frontier=jnp.maximum(carry.max_frontier, n)), outs
 
     return step, transitions
 
 
-def _chunk_fn_sparse(model: Model, cfg: DenseConfig, plan: SparsePlan):
+def _chunk_fn_sparse(model: Model, cfg: DenseConfig, plan: SparsePlan,
+                     memo_slots: int = 0):
     """Sparse twin of wgl3._chunk_fn: jitted (carry, tabs, act, tgts,
-    idx0) -> (carry', f32[4] partials [configs, live-tile sum, real
-    steps, sparse steps]). The carry is DONATED (threaded linearly by
-    every caller, like the dense chunk fn)."""
-    step, transitions = make_step_fn3_sparse(model, cfg, plan)
+    idx0) -> (carry', f32[5] partials [configs, live-tile sum, real
+    steps, sparse steps, overflow-forced dense rounds]). The carry is
+    DONATED (threaded linearly by every caller, like the dense chunk
+    fn)."""
+    step, transitions = make_step_fn3_sparse(model, cfg, plan,
+                                             memo_slots=memo_slots)
 
     def run(carry, tabs, act, tgts, idx0):
         trans = jax.vmap(transitions)(tabs, act)
         idxs = idx0 + jnp.arange(tgts.shape[0], dtype=jnp.int32)
-        carry, (ns, lives, sp) = jax.lax.scan(step, carry,
-                                              (trans, tgts, idxs))
-        # jtflow: partials configs_explored,live_tile_sum,real_steps,sparse_steps
+        carry, (ns, lives, sp, ovf) = jax.lax.scan(step, carry,
+                                                   (trans, tgts, idxs))
+        # jtflow: partials configs_explored,live_tile_sum,real_steps,sparse_steps,overflow_rounds
         return carry, jnp.stack([
             jnp.sum(ns.astype(jnp.float32)),
             jnp.sum(lives.astype(jnp.float32)),
             jnp.sum((tgts >= 0).astype(jnp.float32)),
-            jnp.sum(sp.astype(jnp.float32))])
+            jnp.sum(sp.astype(jnp.float32)),
+            jnp.sum(ovf.astype(jnp.float32))])
+
+    return jax.jit(run, donate_argnums=(0,))
+
+
+def _chunk_fn_sparse_dedup(model: Model, cfg: DenseConfig,
+                           plan: SparsePlan, min_frontier: int,
+                           memo_slots: int):
+    """Canonicalizing twin of _chunk_fn_sparse (pairs scan input, two
+    extra partial columns) — built only for histories whose exchange
+    network is non-empty, like wgl3._chunk_fn_dedup."""
+    step, transitions = make_step_fn3_sparse(model, cfg, plan, canon=True,
+                                             min_frontier=min_frontier,
+                                             memo_slots=memo_slots)
+
+    def run(carry, tabs, act, tgts, pairs, idx0):
+        trans = jax.vmap(transitions)(tabs, act)
+        idxs = idx0 + jnp.arange(tgts.shape[0], dtype=jnp.int32)
+        carry, (ns, lives, sp, ovf, pr, base) = jax.lax.scan(
+            step, carry, (trans, tgts, idxs, pairs))
+        # jtflow: partials configs_explored,live_tile_sum,real_steps,sparse_steps,overflow_rounds,canon_pruned,canon_base
+        return carry, jnp.stack([
+            jnp.sum(ns.astype(jnp.float32)),
+            jnp.sum(lives.astype(jnp.float32)),
+            jnp.sum((tgts >= 0).astype(jnp.float32)),
+            jnp.sum(sp.astype(jnp.float32)),
+            jnp.sum(ovf.astype(jnp.float32)),
+            jnp.sum(pr.astype(jnp.float32)),
+            jnp.sum(base.astype(jnp.float32))])
 
     return jax.jit(run, donate_argnums=(0,))
 
 
 def _cached_sparse_chunk(model: Model, cfg: DenseConfig, plan: SparsePlan,
-                         chunk: int):
-    key = ("sparse-chunk", model.cache_key(), cfg, plan, chunk)
+                         chunk: int, memo_slots: int = 0):
+    key = ("sparse-chunk", model.cache_key(), cfg, plan, chunk,
+           memo_slots)
     if key not in _CACHE:
-        _CACHE[key] = instrument_kernel("wgl3-sparse-chunk",
-                                        _chunk_fn_sparse(model, cfg, plan))
+        _CACHE[key] = instrument_kernel(
+            "wgl3-sparse-chunk",
+            _chunk_fn_sparse(model, cfg, plan, memo_slots=memo_slots))
+    return _CACHE[key]
+
+
+def _cached_sparse_chunk_dedup(model: Model, cfg: DenseConfig,
+                               plan: SparsePlan, chunk: int,
+                               min_frontier: int, memo_slots: int):
+    key = ("sparse-chunk-dedup", model.cache_key(), cfg, plan, chunk,
+           min_frontier, memo_slots)
+    if key not in _CACHE:
+        _CACHE[key] = instrument_kernel(
+            "wgl3-sparse-chunk-dedup",
+            _chunk_fn_sparse_dedup(model, cfg, plan, min_frontier,
+                                   memo_slots))
     return _CACHE[key]
 
 
@@ -302,10 +477,21 @@ def check_steps3_long_sparse(rs: ReturnSteps, model: Model,
     t0 = _time.monotonic()
     if chunk is None:
         chunk = default_scan_chunk(cfg)
-    run = _cached_sparse_chunk(model, cfg, plan, chunk)
     n = rs.n_steps
     n_pad = (n + chunk - 1) // chunk * chunk
     rs = rs.padded_to(n_pad)
+    from .canon import dedup_min_frontier_active, history_canon_pairs
+    from .wgl3 import attach_dedup_record
+
+    memo = memo_slots_for(plan)
+    pairs = history_canon_pairs(rs, table=True)
+    if pairs is not None:
+        run = _cached_sparse_chunk_dedup(model, cfg, plan, chunk,
+                                         dedup_min_frontier_active(),
+                                         memo)
+    else:
+        run = _cached_sparse_chunk(model, cfg, plan, chunk,
+                                   memo_slots=memo)
     carry = _init_carry3(model, cfg)
     parts_dev = None
     if time_budget_s is None:
@@ -313,10 +499,12 @@ def check_steps3_long_sparse(rs: ReturnSteps, model: Model,
 
         def stage(c):
             sl = slice(c * chunk, (c + 1) * chunk)
-            return (jnp.asarray(rs.slot_tabs[sl]),
-                    jnp.asarray(rs.slot_active[sl]),
-                    jnp.asarray(rs.targets[sl]),
-                    jnp.int32(c * chunk))
+            staged = (jnp.asarray(rs.slot_tabs[sl]),
+                      jnp.asarray(rs.slot_active[sl]),
+                      jnp.asarray(rs.targets[sl]))
+            if pairs is not None:
+                staged = staged + (jnp.asarray(pairs[sl]),)
+            return staged + (jnp.int32(c * chunk),)
 
         done = 0
         for staged in double_buffer(range(n_pad // chunk), stage):
@@ -339,10 +527,12 @@ def check_steps3_long_sparse(rs: ReturnSteps, model: Model,
                                  f"{time_budget_s:.0f}s time budget at "
                                  f"return step {c * chunk}"}
             sl = slice(c * chunk, (c + 1) * chunk)
-            carry, part = run(carry, jnp.asarray(rs.slot_tabs[sl]),
-                              jnp.asarray(rs.slot_active[sl]),
-                              jnp.asarray(rs.targets[sl]),
-                              jnp.int32(c * chunk))
+            args = (jnp.asarray(rs.slot_tabs[sl]),
+                    jnp.asarray(rs.slot_active[sl]),
+                    jnp.asarray(rs.targets[sl]))
+            if pairs is not None:
+                args = args + (jnp.asarray(pairs[sl]),)
+            carry, part = run(carry, *args, jnp.int32(c * chunk))
             parts_dev = part if parts_dev is None else parts_dev + part
             # jtlint: disable=JTL103 -- budgeted lane: synchronous per-
             # chunk fetch bounds budget overshoot to one chunk (the
@@ -350,9 +540,11 @@ def check_steps3_long_sparse(rs: ReturnSteps, model: Model,
             if bool(np.asarray(carry.dead)):
                 break
 
+    n_parts = 7 if pairs is not None else 5
     if parts_dev is None:
-        parts_dev = jnp.zeros((4,), jnp.float32)
+        parts_dev = jnp.zeros((n_parts,), jnp.float32)
     # jtflow: partials-from wgl3_sparse._chunk_fn_sparse
+    # jtflow: partials-from wgl3_sparse._chunk_fn_sparse_dedup
     packed = np.asarray(jnp.concatenate([
         jnp.stack([jnp.where(carry.dead, 0, 1),
                    carry.dead_step, carry.max_frontier]),
@@ -367,8 +559,15 @@ def check_steps3_long_sparse(rs: ReturnSteps, model: Model,
     }
     out["sweep"] = sweep_summary(cfg, live_sum=float(packed[4]),
                                  real_steps=int(packed[5]),
-                                 sparse_steps=int(packed[6]))
+                                 sparse_steps=int(packed[6]),
+                                 overflow_rounds=int(packed[7]))
     out["live_tile_ratio"] = out["sweep"]["live_tile_ratio"]
+    if pairs is not None:
+        # Canon columns are the LAST two of the dedup layout by
+        # construction (_chunk_fn_sparse_dedup) — negative indexing
+        # keeps the base-layout reads above layout-checkable (JTL401).
+        attach_dedup_record(out, pruned=float(packed[-2]),
+                            base=float(packed[-1]))
     out["valid"] = verdict(out)
     record_check_result(out)
     return out

@@ -91,10 +91,24 @@ def lattice_dense_config(model: Model, k_slots: int, max_value: int,
 
 
 def _build_local_step(model: Model, cfg: DenseConfig, axis: str, d: int,
-                      plan=None):
+                      plan=None, canon: bool = False,
+                      min_frontier: int = 0, memo_slots: int = 0):
     """The per-device scan body over one shard of the table. Mirrors
     wgl3.make_step_fn3 exactly (same banking/closure/prune semantics, same
     metrics) with the word axis split over `axis`.
+
+    ``canon`` enables the per-step frontier canonicalization pass
+    (ops/canon.py) SHARD-LOCALLY: the caller filters the exchange
+    network to pairs whose slot bits stay inside the shard
+    (max_bit = 5 + log2(w_loc)), which is sound because every
+    compare-exchange is individually sound — device-bit pairs are
+    simply not reduced. The engage decision keys on the psum'd global
+    frontier size, so every device takes the same branch. ``memo_slots``
+    enables the sparse engine's per-tile seen memo per shard (consumed
+    popcounts, ops/wgl3_sparse.make_step_fn3_sparse rationale); the
+    nothing-eligible skip keys on the psum'd eligible count so the
+    branch — and the ppermutes inside the sweep — stay collective-
+    consistent.
 
     With a `plan` (ops/wgl3_sparse.SparsePlan built on the SHARD width),
     each closure round runs the sparse active-tile sweep over the shard's
@@ -208,20 +222,37 @@ def _build_local_step(model: Model, cfg: DenseConfig, axis: str, d: int,
     nt_glob = nt_loc * d
     tbits = tile.bit_length() - 1
     tile_off = jnp.arange(tile, dtype=jnp.int32)
+    memo = memo_slots > 0
+    assert not memo or (plan is not None and memo_slots == nt_loc), \
+        (memo_slots, nt_loc)
     if plan is not None:
         CAP = plan.cap
         cap_ids = jnp.arange(CAP, dtype=jnp.int32)
         thresh_glob = (nt_glob if lim.sparse_mode == 2 else
                        max(1, nt_glob * lim.sparse_density_threshold_pct
                            // 100))
+    if canon:
+        from ..ops.canon import apply_step_canon, make_table_canon
+
+        canon_fn = make_table_canon(w_loc)
 
     def occupancy(T):
         any_w = jnp.any(T != jnp.uint32(0), axis=0)
         occ_t = jnp.any(any_w.reshape(nt_loc, tile), axis=1)
         return occ_t, jnp.sum(occ_t, dtype=jnp.int32)
 
-    def sweep_sparse(T, trans, allowed, occ_t, live_loc):
-        """Gather->expand->scatter over this SHARD's live tiles. Local
+    def tile_popcounts(T):
+        """Shard-local per-tile config counts; the memo loop carries
+        the vector between rounds so eligibility and the psum'd
+        convergence check share one reduce (the wgl3_sparse twin's
+        rationale)."""
+        pc = jax.lax.population_count(T).astype(jnp.int32)
+        return jnp.sum(pc.reshape(S, nt_loc, tile), axis=(0, 2))
+
+    def sweep_sparse(T, trans, allowed, idx, count):
+        """Gather->expand->scatter over this SHARD's listed tiles (the
+        caller builds the list from shard-local occupancy — or, with
+        the seen memo, from the tiles that grew since last swept). Local
         slot bits mirror ops/wgl3_sparse.make_sparse_sweep on the shard;
         device-bit fires scatter to full shard width first, then cross
         the mesh with the same ppermute the dense expand uses.
@@ -229,8 +260,7 @@ def _build_local_step(model: Model, cfg: DenseConfig, axis: str, d: int,
         LOCKSTEP NOTE: keep the in-word/in-tile/tile-bit branches and
         the valid/src_ok masking identical to make_sparse_sweep (see its
         docstring) — fixes must land in both copies."""
-        idx = jnp.nonzero(occ_t, size=CAP, fill_value=0)[0]
-        valid = cap_ids < live_loc
+        valid = cap_ids < count
         cols = idx[:, None] * tile + tile_off[None, :]
         flat = cols.reshape(-1)
         G = jnp.where(valid[None, :, None], T[:, cols], jnp.uint32(0))
@@ -282,45 +312,116 @@ def _build_local_step(model: Model, cfg: DenseConfig, axis: str, d: int,
 
     def step(carry, xs):
         T, dead, dead_step, maxf = carry
-        trans, target, idx = xs
+        if canon:
+            trans, target, idx, pairs = xs
+        else:
+            trans, target, idx = xs
         is_pad = target < 0
         t = jnp.maximum(target, 0)
         allowed = allowed_mask(t)
 
         def body(st):
-            Tw, n_prev, _c, rounds, sp_rounds = st
+            if memo:
+                (Tw, pc, n_prev, _c, rounds, sp_rounds, ovf_rounds,
+                 swept) = st
+            else:
+                Tw, n_prev, _c, rounds, sp_rounds, ovf_rounds = st
             if plan is None:
                 Tw = expand(Tw, trans, allowed)
                 use_sparse = jnp.int32(0)
+                ovf = jnp.int32(0)
             else:
-                occ_t, live_loc = occupancy(Tw)
+                if memo:
+                    occ_t = pc > 0
+                    live_loc = jnp.sum(occ_t, dtype=jnp.int32)
+                    elig_t = occ_t & (pc != swept)
+                    elig_loc = jnp.sum(elig_t, dtype=jnp.int32)
+                    elig_g = jax.lax.psum(elig_loc, axis)
+                else:
+                    occ_t, live_loc = occupancy(Tw)
+                    elig_t, elig_loc = occ_t, live_loc
+                    elig_g = None
                 # All-reduced density signal: every device sees the same
                 # global live count AND the worst shard's work-list
                 # pressure, so the branch — and the ppermutes inside it —
                 # is uniform across the mesh.
                 live_g = jax.lax.psum(live_loc, axis)
                 live_max = jax.lax.pmax(live_loc, axis)
-                take = (live_g <= thresh_glob) & (live_max <= CAP)
+                take_density = live_g <= thresh_glob
+                take = take_density & (live_max <= CAP)
+                # The previously-silent fallback, surfaced: a round the
+                # density signal WANTED sparse but a shard's work-list
+                # pressure forced dense (wgl.sparse_overflow_rounds).
+                ovf = (take_density & ~take).astype(jnp.int32)
+                wl = jnp.nonzero(elig_t, size=CAP, fill_value=0)[0]
+                count = jnp.minimum(elig_loc, jnp.int32(CAP))
+                if memo:
+                    # Nothing grew anywhere on the mesh: the sweep is a
+                    # no-op — skip it UNIFORMLY (the predicate is the
+                    # psum'd count, so the collectives stay consistent).
+                    take_sweep = take & (elig_g > 0)
+                else:
+                    take_sweep = take
                 Tw = jax.lax.cond(
-                    take,
-                    lambda T: sweep_sparse(T, trans, allowed, occ_t,
-                                           live_loc),
-                    lambda T: expand(T, trans, allowed),
+                    take_sweep,
+                    lambda T: sweep_sparse(T, trans, allowed, wl, count),
+                    lambda T: jax.lax.cond(
+                        take, lambda T: T,
+                        lambda T: expand(T, trans, allowed), T),
                     Tw)
                 use_sparse = take.astype(jnp.int32)
+                if memo:
+                    swept2 = swept.at[
+                        jnp.where(cap_ids < count, wl,
+                                  jnp.int32(nt_loc))].set(
+                            pc[wl], mode="drop")
+                    swept = jnp.where(take, swept2,
+                                      jnp.full((nt_loc,), -1, jnp.int32))
+            if memo:
+                # One shard-local reduce serves next round's eligibility
+                # AND this round's psum'd convergence check.
+                pc2 = tile_popcounts(Tw)
+                n_now = jax.lax.psum(jnp.sum(pc2, dtype=jnp.int32), axis)
+                return (Tw, pc2, n_now, n_now > n_prev, rounds + 1,
+                        sp_rounds + use_sparse, ovf_rounds + ovf, swept)
             n_now = jax.lax.psum(
                 jnp.sum(jax.lax.population_count(Tw), dtype=jnp.int32),
                 axis)
             return (Tw, n_now, n_now > n_prev, rounds + 1,
-                    sp_rounds + use_sparse)
+                    sp_rounds + use_sparse, ovf_rounds + ovf)
+
+        ci = 3 if memo else 2   # index of `changed` in the loop state
 
         def cond(st):
-            return st[2] & (st[3] < cfg.rounds)
+            return st[ci] & (st[ci + 1] < cfg.rounds)
 
-        n0 = jax.lax.psum(
-            jnp.sum(jax.lax.population_count(T), dtype=jnp.int32), axis)
-        T, n, _c, rounds, sp_rounds = jax.lax.while_loop(
-            cond, body, (T, n0, ~is_pad, jnp.int32(0), jnp.int32(0)))
+        if memo:
+            pc0 = tile_popcounts(T)
+            init = (T, pc0,
+                    jax.lax.psum(jnp.sum(pc0, dtype=jnp.int32), axis),
+                    ~is_pad, jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                    jnp.full((nt_loc,), -1, jnp.int32))
+            fin = jax.lax.while_loop(cond, body, init)
+            T, _pc, n, _c, rounds, sp_rounds, ovf_rounds = fin[:7]
+        else:
+            n0 = jax.lax.psum(
+                jnp.sum(jax.lax.population_count(T), dtype=jnp.int32),
+                axis)
+            init = (T, n0, ~is_pad, jnp.int32(0), jnp.int32(0),
+                    jnp.int32(0))
+            fin = jax.lax.while_loop(cond, body, init)
+            T, n, _c, rounds, sp_rounds, ovf_rounds = fin[:6]
+        if canon:
+            # Shard-local canonicalization of the converged frontier
+            # (pairs pre-filtered to shard-local bits by the caller);
+            # the gate keys on the GLOBAL frontier size and the count
+            # reduce is psum'd, so the branch — and the collective
+            # inside it — is uniform across the mesh.
+            T, n, canon_pruned, canon_base = apply_step_canon(
+                canon_fn, T, pairs, n, is_pad, min_frontier,
+                count_fn=lambda Tc: jax.lax.psum(
+                    jnp.sum(jax.lax.population_count(Tc),
+                            dtype=jnp.int32), axis))
         _occ, live_fin = occupancy(T)
         live_g_fin = jax.lax.psum(live_fin, axis)
 
@@ -333,12 +434,15 @@ def _build_local_step(model: Model, cfg: DenseConfig, axis: str, d: int,
         T_new = jnp.where(dead, jnp.zeros_like(T_new), T_new)
         sparse_all = ((~is_pad) & (rounds > 0)
                       & (sp_rounds == rounds)).astype(jnp.int32)
+        outs = (jnp.where(is_pad, 0, n),
+                jnp.where(is_pad, 0, live_g_fin),
+                jnp.where(is_pad, 0, sparse_all),
+                jnp.where(is_pad, 0, ovf_rounds))
+        if canon:
+            outs = outs + (canon_pruned, canon_base)
         return (T_new, dead,
                 jnp.where(died & (dead_step < 0), idx, dead_step),
-                jnp.maximum(maxf, n)), (
-                    jnp.where(is_pad, 0, n),
-                    jnp.where(is_pad, 0, live_g_fin),
-                    jnp.where(is_pad, 0, sparse_all))
+                jnp.maximum(maxf, n)), outs
 
     return step, w_loc, (tile, nt_glob)
 
@@ -353,33 +457,56 @@ def lattice_sparse_plan(cfg: DenseConfig, d: int):
 
 
 def make_lattice_chunk_fn(model: Model, cfg: DenseConfig, mesh: Mesh,
-                          axis: str = "lattice", plan=None):
+                          axis: str = "lattice", plan=None,
+                          canon: bool = False, min_frontier: int = 0,
+                          memo_slots: int = 0):
     """(jitted chunk fn, (tile_words, global n_tiles)): the chunk fn is
     (table[S, W] sharded, dead, dead_step, maxf, trans[C,K,S,S'],
-    tgts[C], idx0) -> (table', dead', dead_step', maxf', f32[4] partials
-    [configs, live-tile sum, real steps, sparse steps]) — the sharded
-    twin of wgl3._chunk_fn. The table stays a mesh-sharded jax.Array
-    between host-loop chunks; the tiling rides along so the caller's
-    sweep_summary denominator is EXACTLY the tiling the kernel swept."""
+    tgts[C], [pairs[C,P,2] when canon,] idx0) -> (table', dead',
+    dead_step', maxf', f32[7] partials [configs, live-tile sum, real
+    steps, sparse steps, overflow rounds, canon pruned, canon base —
+    the canon columns are zeros in a canon-off build])
+    — the sharded twin of wgl3._chunk_fn. The table stays a
+    mesh-sharded jax.Array between host-loop chunks; the tiling rides
+    along so the caller's sweep_summary denominator is EXACTLY the
+    tiling the kernel swept."""
     d = mesh.shape[axis]
-    step, w_loc, tiling = _build_local_step(model, cfg, axis, d, plan=plan)
+    step, w_loc, tiling = _build_local_step(
+        model, cfg, axis, d, plan=plan, canon=canon,
+        min_frontier=min_frontier, memo_slots=memo_slots)
 
-    def run(table, dead, dead_step, maxf, trans, tgts, idx0):
+    def run(table, dead, dead_step, maxf, trans, tgts, *rest):
+        if canon:
+            pairs, idx0 = rest
+        else:
+            (idx0,) = rest
         idxs = idx0 + jnp.arange(tgts.shape[0], dtype=jnp.int32)
-        (table, dead, dead_step, maxf), (ns, lives, sp) = jax.lax.scan(
-            step, (table, dead, dead_step, maxf), (trans, tgts, idxs))
-        # jtflow: partials configs_explored,live_tile_sum,real_steps,sparse_steps
+        xs = (trans, tgts, idxs) + ((pairs,) if canon else ())
+        (table, dead, dead_step, maxf), outs = jax.lax.scan(
+            step, (table, dead, dead_step, maxf), xs)
+        # FIXED seven-column row in both builds (canon-off emits zero
+        # canon columns): one partial layout, one consumer indexing.
+        # jtflow: partials configs_explored,live_tile_sum,real_steps,sparse_steps,overflow_rounds,canon_pruned,canon_base
         parts = jnp.stack([
-            jnp.sum(ns.astype(jnp.float32)),
-            jnp.sum(lives.astype(jnp.float32)),
+            jnp.sum(outs[0].astype(jnp.float32)),
+            jnp.sum(outs[1].astype(jnp.float32)),
             jnp.sum((tgts >= 0).astype(jnp.float32)),
-            jnp.sum(sp.astype(jnp.float32))])
+            jnp.sum(outs[2].astype(jnp.float32)),
+            jnp.sum(outs[3].astype(jnp.float32)),
+            jnp.sum(outs[4].astype(jnp.float32)) if canon
+            else jnp.float32(0),
+            jnp.sum(outs[5].astype(jnp.float32)) if canon
+            else jnp.float32(0)])
         return table, dead, dead_step, maxf, parts
 
+    in_specs = [P(None, axis), P(), P(), P(), P(None, None, None, None),
+                P(None)]
+    if canon:
+        in_specs.append(P(None, None))   # pairs: replicated
+    in_specs.append(P())
     specs = dict(
         mesh=mesh,
-        in_specs=(P(None, axis), P(), P(), P(), P(None, None, None, None),
-                  P(None), P()),
+        in_specs=tuple(in_specs),
         out_specs=(P(None, axis), P(), P(), P(), P()))
     try:
         sharded = shard_map(run, check_vma=False, **specs)
@@ -393,12 +520,15 @@ def make_lattice_chunk_fn(model: Model, cfg: DenseConfig, mesh: Mesh,
 
 
 def cached_lattice_chunk(model: Model, cfg: DenseConfig, mesh: Mesh,
-                         axis: str = "lattice", plan=None):
+                         axis: str = "lattice", plan=None,
+                         canon: bool = False, min_frontier: int = 0,
+                         memo_slots: int = 0):
     key = ("lattice-chunk", model.cache_key(), cfg, _mesh_key(mesh), axis,
-           plan)
+           plan, canon, min_frontier, memo_slots)
     if key not in _CACHE:
-        _CACHE[key] = make_lattice_chunk_fn(model, cfg, mesh, axis,
-                                            plan=plan)
+        _CACHE[key] = make_lattice_chunk_fn(
+            model, cfg, mesh, axis, plan=plan, canon=canon,
+            min_frontier=min_frontier, memo_slots=memo_slots)
     return _CACHE[key]
 
 
@@ -436,11 +566,27 @@ def check_steps_lattice_long(rs: ReturnSteps, model: Model,
         cells = cfg.n_states * cfg.n_masks // d   # per-device sweep cost
         base = limits().long_scan_chunk
         chunk = min(base, max(128, base * (1 << 15) // max(cells, 1)))
-    run, tiling = cached_lattice_chunk(model, cfg, mesh, plan=plan)
-    trans_of = _transitions_fn(model, cfg)
     n = rs.n_steps
     n_pad = (n + chunk - 1) // chunk * chunk
     rs = rs.padded_to(n_pad)
+    # Frontier canonicalization (ops/canon.py): dedup SHARD-LOCALLY —
+    # pairs touching device-index bits are filtered out host-side
+    # (every compare-exchange is individually sound, so the partial
+    # network is exact too), then the occupancy/density signals are
+    # all-reduced exactly like the PR 3 sparse branch.
+    from ..ops.canon import dedup_min_frontier_active, history_canon_pairs
+    from ..ops.wgl3_sparse import memo_slots_for
+
+    w_loc = (1 << (cfg.k_slots - 5)) // d
+    pairs = history_canon_pairs(rs, table=True,
+                                max_bit=5 + w_loc.bit_length() - 1)
+    memo = memo_slots_for(plan) if plan is not None else 0
+    run, tiling = cached_lattice_chunk(
+        model, cfg, mesh, plan=plan, canon=pairs is not None,
+        min_frontier=(dedup_min_frontier_active()
+                      if pairs is not None else 0),
+        memo_slots=memo)
+    trans_of = _transitions_fn(model, cfg)
     # Carry starts as host values; jit output keeps the table sharded
     # across chunks.
     w = 1 << (cfg.k_slots - 5)
@@ -463,9 +609,12 @@ def check_steps_lattice_long(rs: ReturnSteps, model: Model,
         sl = slice(c * chunk, (c + 1) * chunk)
         trans = trans_of(jnp.asarray(rs.slot_tabs[sl]),
                          jnp.asarray(rs.slot_active[sl]))
+        args = (jnp.asarray(rs.targets[sl]),)
+        if pairs is not None:
+            args = args + (jnp.asarray(pairs[sl]),)
         table, dead, dead_step, maxf, part = run(
-            table, dead, dead_step, maxf, trans,
-            jnp.asarray(rs.targets[sl]), jnp.int32(c * chunk))
+            table, dead, dead_step, maxf, trans, *args,
+            jnp.int32(c * chunk))
         cfgs_dev = part if cfgs_dev is None else cfgs_dev + part
         # jtlint: disable=JTL103 -- per-chunk death fetch: chunk sizes here
         # are large (>=128 scanned steps each), so the fetch amortizes; it
@@ -473,7 +622,7 @@ def check_steps_lattice_long(rs: ReturnSteps, model: Model,
         if bool(np.asarray(dead)):
             break
     if cfgs_dev is None:
-        cfgs_dev = jnp.zeros((4,), jnp.float32)
+        cfgs_dev = jnp.zeros((7,), jnp.float32)
     # jtflow: partials-from lattice.make_lattice_chunk_fn
     parts = np.asarray(jnp.clip(cfgs_dev, 0, 2**31 - 1).astype(jnp.int32))
     out = {
@@ -491,8 +640,14 @@ def check_steps_lattice_long(rs: ReturnSteps, model: Model,
     out["sweep"] = sweep_summary(cfg, live_sum=float(parts[1]),
                                  real_steps=int(parts[2]),
                                  sparse_steps=int(parts[3]),
-                                 tiling=tiling)
+                                 tiling=tiling,
+                                 overflow_rounds=int(parts[4]))
     out["live_tile_ratio"] = out["sweep"]["live_tile_ratio"]
+    if pairs is not None:
+        # Columns 5/6 are zeros in a canon-off build — only attach the
+        # record when the canonicalizing kernel actually ran.
+        wgl3.attach_dedup_record(out, pruned=float(parts[5]),
+                                 base=float(parts[6]))
     out["valid"] = verdict(out)
     record_check_result(out)
     return out

@@ -129,15 +129,23 @@ class _Stats:
         self.buckets: dict[int, int] = {}
         self.sweep_steps_sparse = 0
         self.sweep_steps_dense = 0
+        self.configs_pruned = 0
+        self.sparse_overflow_rounds = 0
 
     def record_sweep(self, result: dict) -> None:
         """Fold a long-sweep result's sparse-engine record (ops/
-        wgl3_sparse.py) into the corpus stats — the scheduler's half of
-        the bench/CLI sweep exposure."""
+        wgl3_sparse.py) and frontier-dedup accounting (ops/canon.py)
+        into the corpus stats — the scheduler's half of the bench/CLI
+        sweep exposure."""
         sweep = result.get("sweep")
         if isinstance(sweep, dict):
             self.sweep_steps_sparse += int(sweep.get("steps_sparse", 0))
             self.sweep_steps_dense += int(sweep.get("steps_dense", 0))
+            self.sparse_overflow_rounds += int(
+                sweep.get("overflow_rounds", 0))
+        dedup = result.get("dedup")
+        if isinstance(dedup, dict):
+            self.configs_pruned += int(dedup.get("configs_pruned", 0))
 
     def record_launch(self, real: int, b: int, r: int) -> None:
         padded = b * r
@@ -162,6 +170,8 @@ class _Stats:
                               if self.steps_real else 0.0),
             "sweep_steps_sparse": self.sweep_steps_sparse,
             "sweep_steps_dense": self.sweep_steps_dense,
+            "configs_pruned": self.configs_pruned,
+            "sparse_overflow_rounds": self.sparse_overflow_rounds,
         }
         return out
 

@@ -254,6 +254,13 @@ class KeyStream:
         from ..ops import wgl3
 
         chunk = tgt.shape[0]
+        # Always the PLAIN (no-canonicalization) chunk fn: the frontier
+        # dedup pass (ops/canon.py) needs to know which pending ops
+        # never return in the REMAINING history, and a live stream
+        # cannot know its future — an op pending now may still complete
+        # later. Post-hoc sweeps of the same key run canon-free too for
+        # short histories (batched kernels), so streamed and post-hoc
+        # metrics stay bit-identical.
         run = wgl3._cached_chunk_run(self.model, self.cfg, chunk)
         t0 = time.monotonic()
         with obs.get_tracer().span("stream.chunk", key=str(self.key),

@@ -28,6 +28,13 @@ Groups (the `group` metadata on KernelLimits fields, ops/limits.py):
                  `stream_flush_ops` / `stream_max_lag_chunks` via a
                  full-speed replay of a fixed keyed op stream through
                  the stable-prefix dispatcher.
+  dedup        — the frontier canonicalization pass + sparse seen memo
+                 (ops/canon.py / ops/wgl3_sparse.py): a symmetry-heavy
+                 history (small value domain, many forever-pending
+                 duplicates) through the chunked dense sweep, tuning
+                 `dedup_mode` / `dedup_hash_slots` /
+                 `dedup_min_frontier`. Exact in every mode, so the
+                 search is free to pick whatever measures fastest.
 
 Every measurement is warmup-then-best-of-N: the warmup call eats the
 compile (the persistent XLA cache makes it cheap on re-tunes), the min
@@ -51,12 +58,17 @@ SEED_SCHED = 0x5C4ED
 SEED_PIPE = 0x919E
 SEED_PALLAS = 0x9A11
 SEED_STREAM = 0x57E4
+SEED_DEDUP = 0xDED0
 
 # Per-knob limit pins applied UNDER the candidate override while probing
 # (e.g. the density threshold only matters once the sparse engine is
 # eligible, so its probe pins the engagement floor to 1).
 KNOB_PINS: dict[str, dict[str, int]] = {
     "sparse_density_threshold_pct": {"sparse_min_tiles": 1},
+    # The memo only runs under the sparse engine; the min-frontier gate
+    # only matters once the table pass is forced on.
+    "dedup_hash_slots": {"sparse_mode": 2, "sparse_min_tiles": 1},
+    "dedup_min_frontier": {"dedup_mode": 2},
 }
 
 
@@ -268,15 +280,27 @@ class PallasProbe:
         self.ctx = ctx
         self.fix = _LongSweepFixture(ctx, SEED_PALLAS,
                                      n_ops=ctx.n(3000, 300))
+        # Second fixture at K=13 (>= 2 work-list blocks): with the
+        # sparse work-list kernel routed by default wherever the
+        # density signal selects it (wgl3_pallas.pallas_sparse_selected,
+        # ISSUE 10), the tuned pallas geometry must be measured through
+        # BOTH kernels — the chosen step chunk sizes the sparse
+        # kernel's colmask blocks and 8-slot metadata windows too.
+        self.fix_sparse = _LongSweepFixture(ctx, SEED_PALLAS + 1,
+                                            n_ops=ctx.n(1200, 120),
+                                            k_slots=13, budget=1 << 28)
 
     def measure(self, knob: str, overrides: dict[str, int]) -> float:
         from ..ops import wgl3_pallas
 
-        return _with_overrides(
-            overrides,
-            lambda: wgl3_pallas.check_steps3_long_pallas(
-                self.fix.rs, self.fix.model, self.fix.cfg),
-            self.ctx.repeats)
+        def both():
+            wgl3_pallas.check_steps3_long_pallas(
+                self.fix.rs, self.fix.model, self.fix.cfg)
+            wgl3_pallas.check_steps3_long_pallas(
+                self.fix_sparse.rs, self.fix_sparse.model,
+                self.fix_sparse.cfg)
+
+        return _with_overrides(overrides, both, self.ctx.repeats)
 
 
 class StreamProbe:
@@ -315,6 +339,80 @@ class StreamProbe:
         return _with_overrides(overrides, replay, self.ctx.repeats)
 
 
+class DedupProbe:
+    """Frontier canonicalization + sparse seen-memo knobs on a
+    SYMMETRY-HEAVY fixture: a small value domain with a sizeable
+    forever-pending population gives the canonicalization pass real
+    equal-effect classes to reduce (a symmetry-free history would
+    measure the knobs as pure no-ops). Every mode is verdict-exact
+    (ops/canon.py), so the search may pick whatever measures fastest —
+    including OFF on machines where the pass never pays."""
+
+    knobs = ("dedup_mode", "dedup_hash_slots", "dedup_min_frontier")
+
+    def __init__(self, ctx: ProbeContext):
+        from ..ops import wgl2, wgl3
+        from ..ops.encode import (encode_register_history,
+                                  encode_return_steps, reslot_events)
+        from ..utils.fuzz import gen_register_history
+
+        self.ctx = ctx
+        k = 13 if ctx.scale < 0.5 else 16
+        h = gen_register_history(random.Random(SEED_DEDUP),
+                                 n_ops=ctx.n(2000, 150), n_procs=8,
+                                 value_range=2, p_info=0.04)
+        enc = encode_register_history(h, k_slots=32)
+        self.cfg = wgl3.dense_config(ctx.model, k, max(enc.max_value, 4),
+                                     budget=1 << 28)
+        if self.cfg is None:
+            raise RuntimeError(f"dedup probe geometry infeasible (k={k})")
+        self.enc = reslot_events(enc, k) if enc.k_slots != k else enc
+        self.rs = encode_return_steps(self.enc)
+        # Second fixture for the SORT-LADDER arm: in auto mode the
+        # TABLE sweep is canon-free (dedup_mode 0 and 1 compile the
+        # same kernel — history_canon_pairs(table=True)), so without
+        # this arm the 0-vs-1 candidates would tie and the tuner could
+        # persist `off` by timing noise, silently disabling the sort
+        # ladder's measured escalation-avoidance win and the seen memo.
+        n_sort = ctx.n(200, 60)
+        hs = gen_register_history(random.Random(SEED_DEDUP + 1),
+                                  n_ops=n_sort, n_procs=8, value_range=1,
+                                  p_info=15.0 / n_sort)
+        enc_s = encode_register_history(hs, k_slots=32)
+        ks = wgl2.sort_k_slots(enc_s)
+        self.rs_sort = encode_return_steps(
+            reslot_events(enc_s, ks) if enc_s.k_slots != ks else enc_s)
+        self.model = ctx.model
+
+    def tiles(self) -> int:
+        lim = limits()
+        w = self.cfg.n_masks // 32
+        return max(1, w // lim.sparse_tile_words)
+
+    def candidates(self, knob: str) -> list[int] | None:
+        if knob == "dedup_mode":
+            return [0, 1, 2]
+        if knob == "dedup_hash_slots":
+            # Bracket THIS geometry's tile count: the memo's engage /
+            # fail-open decision is what the candidates toggle.
+            t = self.tiles()
+            return sorted({max(64, t // 2), max(64, t), max(64, 2 * t),
+                           4096})
+        if knob == "dedup_min_frontier":
+            return [0, 16, 64, 256]
+        return None
+
+    def measure(self, knob: str, overrides: dict[str, int]) -> float:
+        from ..ops import wgl2, wgl3
+
+        def both():
+            wgl3.check_steps3_long(self.rs, self.model, self.cfg)
+            wgl2.check_steps_resumable(self.rs_sort, self.model,
+                                       f_cap=64)
+
+        return _with_overrides(overrides, both, self.ctx.repeats)
+
+
 class ProbeUnavailable(RuntimeError):
     """This probe group cannot run on this backend (recorded as skipped,
     never an error — a CPU tune simply has no pallas lane)."""
@@ -329,4 +427,5 @@ PROBES = {
     "pipeline": PipelineProbe,
     "pallas": PallasProbe,
     "stream": StreamProbe,
+    "dedup": DedupProbe,
 }

@@ -200,10 +200,34 @@ def _perf_summary_html(run_dir) -> str:
     eps, waste, sweep, live = _check_perf_columns(_Run)
     bits = [("check eps", eps), ("pad waste", waste), ("sweep", sweep),
             ("live tiles", live)]
+    bits += _dedup_bits(run_dir)
     bits += _stream_gauge_bits(run_dir)
     shown = [f"{name}: <b>{html.escape(val)}</b>"
              for name, val in bits if val]
     return f"<p class='a'>{' · '.join(shown)}</p>" if shown else ""
+
+
+def _dedup_bits(run_dir) -> list[tuple[str, str]]:
+    """Frontier-dedup telemetry (ISSUE 10, ops/canon.py) for the
+    telemetry strip: configs pruned by canonicalization, the dedup
+    ratio gauge, and the previously-silent sparse work-list overflow
+    rounds — all blank for runs that recorded none."""
+    try:
+        metrics = read_metrics(run_dir / METRICS_FILE)
+    except Exception:
+        return []
+    out: list[tuple[str, str]] = []
+    c = metrics.get("wgl.configs_pruned") or {}
+    if c.get("type") == "counter" and c.get("value"):
+        out.append(("configs pruned", f"{c['value']:,.0f}"))
+    g = metrics.get("wgl.frontier_dedup_ratio") or {}
+    if g.get("type") == "gauge" and g.get("n") \
+            and isinstance(g.get("last"), (int, float)):
+        out.append(("dedup ratio", f"{g['last']:.1%}"))
+    c = metrics.get("wgl.sparse_overflow_rounds") or {}
+    if c.get("type") == "counter" and c.get("value"):
+        out.append(("sparse overflow rounds", f"{c['value']:,.0f}"))
+    return out
 
 
 def _stream_gauge_bits(run_dir) -> list[tuple[str, str]]:

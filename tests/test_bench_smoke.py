@@ -209,3 +209,27 @@ def test_sparse_lane_contract():
     assert sweep["mode"] in ("sparse", "mixed")
     assert sweep["steps_sparse"] > 0
     assert sweep["steps_sparse"] + sweep["steps_dense"] <= lane["events"]
+
+
+def test_dedup_lane_contract():
+    """The bench's frontier-dedup lane at tiny scale (ISSUE 10): the
+    gated sort-arm events/s present, verdict equivalence asserted
+    inside the lane, raw vs unique configs/s reported separately,
+    pruning > 0 on the symmetry-heavy fixtures, and the sort arm's
+    escalation count never WORSE with dedup on (the CPU-provable
+    algorithmic win; the events/s ordering itself is machine-dependent
+    and gated round-over-round by bench_compare, not here)."""
+    model = CASRegister()
+    lane = bench.bench_dedup(model, n_ops=150, k_slots=13, sort_ops=80)
+    for key in ("off_events_per_sec", "on_events_per_sec",
+                "raw_configs_per_sec", "unique_configs_per_sec",
+                "frontier_dedup_ratio", "configs_pruned",
+                "speedup_vs_off", "table_off_s", "table_on_s"):
+        assert key in lane, key
+    json.dumps(lane)
+    assert lane["configs_pruned"] > 0
+    assert 0.0 < lane["frontier_dedup_ratio"] <= 1.0
+    assert lane["max_frontier_on"] <= lane["max_frontier_off"]
+    assert lane["unique_configs_per_sec"] > 0
+    assert lane["sort_escalations_on"] <= lane["sort_escalations_off"]
+    assert lane["sort_f_cap_on"] <= lane["sort_f_cap_off"]

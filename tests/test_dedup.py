@@ -1,0 +1,399 @@
+"""Frontier canonicalization / dedup (ops/canon.py, ISSUE 10):
+differential battery vs the dedup-off kernels.
+
+The pass is a verdict-preserving quotient: symmetry-reducing
+equal-effect forever-pending ops must leave every VERDICT field (valid /
+survived / overflow / dead_step) bit-identical to dedup-off across the
+dense, sparse, lattice-sharded, and resumable-sort paths — while the
+SEARCH-SIZE metrics (max_frontier, configs_explored) may only shrink.
+These tests pin that on the golden histories and fuzz corpora (valid and
+invalid), across the sparse crossover mid-sweep, through the seen-memo's
+fail-open path (dedup_hash_slots smaller than the tile count), at shard
+boundaries on the 8-device virtual mesh, and through the wgl2 resumable
+ladder (where the win is fewer capacity escalations).
+
+Geometry note (tier-1 wall): the dense/sparse cases share the
+(k=12, max_value>=4, chunk=64) compiled shapes with
+tests/test_sparse_sweep.py, and the lattice cases its (k=13, chunk=32)
+shapes, so the new suite adds dedup-variant compiles only.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from jepsen_etcd_demo_tpu import obs
+from jepsen_etcd_demo_tpu.models import CASRegister
+from jepsen_etcd_demo_tpu.ops import wgl3, wgl3_pallas
+from jepsen_etcd_demo_tpu.ops.canon import canon_pairs, pair_capacity
+from jepsen_etcd_demo_tpu.ops.encode import (encode_register_history,
+                                             encode_return_steps,
+                                             reslot_events)
+from jepsen_etcd_demo_tpu.ops.limits import (KernelLimits, limits,
+                                             set_limits)
+from jepsen_etcd_demo_tpu.ops.wgl2 import check_steps_resumable
+from jepsen_etcd_demo_tpu.ops.wgl3_sparse import (check_steps3_long_sparse,
+                                                  memo_slots_for,
+                                                  sparse_plan)
+from jepsen_etcd_demo_tpu.parallel import lattice
+from jepsen_etcd_demo_tpu.utils.fuzz import (gen_register_history,
+                                             mutate_history)
+from golden import GOLDEN
+
+MODEL = CASRegister()
+# Canonicalization preserves VERDICTS exactly; the search-size metrics
+# (max_frontier / configs_explored) shrink by design and are asserted
+# as inequalities instead.
+VERDICT_FIELDS = ("valid", "survived", "overflow", "dead_step")
+
+
+@pytest.fixture
+def restore_limits():
+    prev = limits()
+    yield
+    set_limits(prev)
+
+
+def _pin(**kw):
+    set_limits(replace(limits(), **kw))
+
+
+def _steps(h, k):
+    enc = encode_register_history(h, k_slots=32)
+    enc = reslot_events(enc, k) if enc.k_slots != k else enc
+    return encode_return_steps(enc)
+
+
+def _sym_history(rng, n_ops=90, n_procs=6, p_info=0.05):
+    """Symmetry-heavy fixture: a tiny value domain plus a forever-
+    pending population makes equal-effect classes near-certain."""
+    return gen_register_history(rng, n_ops=n_ops, n_procs=n_procs,
+                                value_range=2, p_info=p_info)
+
+
+def _off(rs, cfg, chunk):
+    _pin(dedup_mode=1, sparse_mode=1)
+    return wgl3.check_steps3_long(rs, MODEL, cfg, chunk=chunk)
+
+
+def _assert_verdicts(ref, got, ctx=""):
+    for f in VERDICT_FIELDS:
+        assert ref[f] == got[f], (ctx, f, ref, got)
+    assert got["max_frontier"] <= ref["max_frontier"], (ctx, ref, got)
+    assert got["configs_explored"] <= ref["configs_explored"], (ctx,)
+
+
+def test_canon_pairs_shape_and_monotonicity():
+    """The exchange network: eligibility is monotone (a forever-pending
+    class never loses members), pads are identity, and max_bit filters
+    for the lattice's shard-local application."""
+    rng = random.Random(0xCA90)
+    h = _sym_history(rng, n_ops=120, p_info=0.1)
+    rs = _steps(h, 12).padded_to(128)
+    pairs = canon_pairs(rs)
+    assert pairs is not None
+    R, P, two = pairs.shape
+    assert (R, two) == (128, 2) and P == pair_capacity(P)
+    counts = (pairs[:, :, 0] >= 0).sum(axis=1)
+    # pads are identity
+    assert (counts[rs.n_steps:] == 0).all()
+    # monotone: the per-step pair count never decreases over real steps
+    real = counts[: rs.n_steps]
+    assert (np.diff(real) >= 0).all(), real
+    assert real[-1] > 0
+    # every pair is (lo < hi), both in range
+    live = pairs[pairs[:, :, 0] >= 0]
+    assert (live[:, 0] < live[:, 1]).all()
+    assert (live[:, 1] < rs.k_slots).all()
+    # max_bit filtering drops high-bit pairs and nothing else
+    cut = int(live[:, 1].max())
+    filtered = canon_pairs(rs, max_bit=cut)
+    flive = (filtered[filtered[:, :, 0] >= 0] if filtered is not None
+             else np.empty((0, 2), np.int32))
+    assert len(flive) < len(live)
+    assert (flive[:, 1] < cut).all() if len(flive) else True
+
+
+def test_golden_histories_dedup(restore_limits):
+    """Every golden verdict through the forced-dedup chunked sweep."""
+    for name, hist, expected in GOLDEN:
+        rs = _steps(hist, 12)
+        cfg = wgl3.dense_config(MODEL, 12, max(rs.max_value, 4))
+        _pin(dedup_mode=2, sparse_mode=1)
+        out = wgl3.check_steps3_long(rs, MODEL, cfg, chunk=64)
+        assert out["valid"] == expected, name
+
+
+def test_fuzz_dense_dedup_matches_off(restore_limits):
+    """Fuzzed symmetry-heavy histories (half mutated): forced-dedup vs
+    dedup-off dense sweeps agree on every verdict field, the frontier
+    only shrinks, and the pruned-configs accounting is live — the CPU
+    tier-1 acceptance proxy (pruned > 0 with identical verdicts)."""
+    rng = random.Random(0xDE0F)
+    n_invalid = 0
+    total_pruned = 0
+    for i in range(6):
+        h = _sym_history(rng, n_ops=rng.randrange(40, 120))
+        if i % 2:
+            h = mutate_history(rng, h)
+        cfg = wgl3.dense_config(MODEL, 12, 4)
+        rs = _steps(h, 12)
+        ref = _off(rs, cfg, 64)
+        _pin(dedup_mode=2, sparse_mode=1)
+        with obs.capture() as cap:
+            got = wgl3.check_steps3_long(rs, MODEL, cfg, chunk=64)
+        n_invalid += ref["valid"] is False
+        _assert_verdicts(ref, got, ctx=i)
+        if "dedup" in got:
+            total_pruned += got["dedup"]["configs_pruned"]
+            snap = cap.metrics.snapshot()
+            assert snap["wgl.configs_pruned"]["value"] == \
+                got["dedup"]["configs_pruned"]
+            if got["dedup"]["canon_base"]:
+                assert snap["wgl.frontier_dedup_ratio"]["last"] == \
+                    got["dedup"]["frontier_dedup_ratio"]
+    assert n_invalid >= 2
+    assert total_pruned > 0
+
+
+def test_fuzz_sparse_dedup_matches_off(restore_limits):
+    """Sparse engine + canonicalization + the seen memo vs the
+    dedup-off dense sweep — including the crossover mid-sweep (auto
+    mode, low threshold) and the memo's fail-open path (slot capacity
+    below the tile count disables it; verdicts never move)."""
+    rng = random.Random(0x5DED)
+    for i in range(4):
+        h = _sym_history(rng, n_ops=rng.randrange(50, 110))
+        if i % 2:
+            h = mutate_history(rng, h)
+        cfg = wgl3.dense_config(MODEL, 12, 4)
+        rs = _steps(h, 12)
+        ref = _off(rs, cfg, 64)
+        for pins in (
+                # forced sparse, memo on
+                dict(dedup_mode=2, sparse_mode=2, sparse_min_tiles=2,
+                     sparse_tile_words=8, dedup_hash_slots=4096),
+                # auto-mode crossover mid-sweep
+                dict(dedup_mode=2, sparse_mode=0, sparse_min_tiles=2,
+                     sparse_tile_words=8, dedup_hash_slots=4096,
+                     sparse_density_threshold_pct=10),
+                # memo fail-open: 1-word tiles inflate the tile count
+                # past the 64-slot memo floor, so the memo disables and
+                # every live tile re-sweeps (the pre-dedup behavior)
+                dict(dedup_mode=2, sparse_mode=2, sparse_min_tiles=2,
+                     sparse_tile_words=1, dedup_hash_slots=64),
+        ):
+            _pin(**pins)
+            plan = sparse_plan(cfg)
+            assert plan is not None
+            got = check_steps3_long_sparse(rs, MODEL, cfg, plan,
+                                           chunk=64)
+            _assert_verdicts(ref, got, ctx=(i, tuple(pins)))
+
+
+def test_sparse_memo_engages_and_fails_open(restore_limits):
+    """memo_slots_for: the memo is sized to the tile count when it
+    fits dedup_hash_slots, 0 (fail-open) when it does not or dedup is
+    off."""
+    _pin(sparse_mode=2, sparse_min_tiles=2)
+    cfg = wgl3.dense_config(MODEL, 12, 4)
+    plan = sparse_plan(cfg)
+    assert plan is not None
+    assert memo_slots_for(plan) == plan.n_tiles
+    # 1-word tiles push the tile count past a floor-sized memo: fail
+    # open to no-memo.
+    _pin(sparse_mode=2, sparse_min_tiles=2, sparse_tile_words=1,
+         dedup_hash_slots=64)
+    plan2 = sparse_plan(cfg)
+    assert plan2 is not None and plan2.n_tiles > 64
+    assert memo_slots_for(plan2) == 0
+    # dedup off disables the memo regardless of capacity.
+    _pin(sparse_mode=2, sparse_min_tiles=2, dedup_mode=1)
+    assert memo_slots_for(sparse_plan(cfg)) == 0
+
+
+def test_sparse_overflow_rounds_surfaced(restore_limits):
+    """The previously-silent sparse fallback: an overflow-sized fixture
+    (work-list capacity far below the live frontier, prefer-sparse)
+    must force dense rounds AND surface them — in the result's sweep
+    record and the pre-registered wgl.sparse_overflow_rounds counter —
+    with verdicts still bit-identical."""
+    rng = random.Random(0x0F70)
+    h = gen_register_history(rng, n_ops=120, n_procs=10, p_info=0.05)
+    cfg = wgl3.dense_config(MODEL, 12, 4)
+    rs = _steps(h, 12)
+    ref = _off(rs, cfg, 64)
+    _pin(sparse_mode=2, sparse_min_tiles=2, sparse_worklist_cap=2,
+         dedup_mode=1)
+    plan = sparse_plan(cfg)
+    assert plan is not None and plan.cap == 2
+    assert plan.thresh_density == plan.n_tiles > plan.cap
+    with obs.capture() as cap:
+        got = check_steps3_long_sparse(rs, MODEL, cfg, plan, chunk=64)
+    for f in VERDICT_FIELDS:
+        assert ref[f] == got[f], f
+    ovf = got["sweep"]["overflow_rounds"]
+    assert ovf > 0, got["sweep"]
+    snap = cap.metrics.snapshot()
+    assert snap["wgl.sparse_overflow_rounds"]["value"] == ovf
+    stats = obs.sweep_stats(cap.metrics)
+    assert stats["sparse_overflow_rounds"] == ovf
+
+
+def test_lattice_shard_boundary_dedup(restore_limits):
+    """Shard-local canonicalization on the 8-device virtual mesh (K=13
+    puts tile-index AND device-index bits in play; device-bit pairs are
+    filtered, which is sound): verdicts bit-identical to the
+    single-device dedup-off sweep, frontier no larger."""
+    rng = random.Random(0x1DED)
+    for i in range(2):
+        h = _sym_history(rng, n_ops=80, p_info=0.06)
+        if i % 2:
+            h = mutate_history(rng, h)
+        cfg = wgl3.dense_config(MODEL, 13, 4, budget=1 << 28)
+        rs = _steps(h, 13)
+        ref = _off(rs, cfg, 32)
+        _pin(dedup_mode=2, sparse_mode=2, sparse_min_tiles=2)
+        got = lattice.check_steps_lattice_long(rs, MODEL, cfg, chunk=32)
+        _assert_verdicts(ref, got, ctx=("lattice", i))
+        assert got["kernel"] == "wgl3-dense-lattice-sparse"
+
+
+def test_wgl2_resumable_dedup(restore_limits):
+    """The sort ladder with canonicalization: identical verdicts, a
+    frontier that never grows past the dedup-off run's, and no EXTRA
+    capacity escalations — the combinatorial-history win."""
+    rng = random.Random(0x2DED)
+    shrunk = 0
+    for i in range(4):
+        h = _sym_history(rng, n_ops=rng.randrange(50, 110), p_info=0.08)
+        if i % 2:
+            h = mutate_history(rng, h)
+        rs = _steps(h, 12)
+        _pin(dedup_mode=1)
+        off = check_steps_resumable(rs, MODEL, f_cap=64, chunk=32)
+        _pin(dedup_mode=2)
+        on = check_steps_resumable(rs, MODEL, f_cap=64, chunk=32)
+        assert off["valid"] == on["valid"], (i, off, on)
+        assert off["dead_step"] == on["dead_step"], i
+        assert on["max_frontier"] <= off["max_frontier"], i
+        assert on["escalations"] <= off["escalations"], i
+        shrunk += on["max_frontier"] < off["max_frontier"]
+    assert shrunk >= 1, "symmetry-heavy fixtures should shrink somewhere"
+
+
+def test_dedup_auto_is_noop_without_symmetry(restore_limits):
+    """A history with NO equal-effect forever-pending ops takes the
+    plain (pre-dedup, byte-identical) kernels even in auto mode — the
+    result carries no dedup record at all."""
+    set_limits(KernelLimits())
+    rng = random.Random(0xA0DE)
+    h = gen_register_history(rng, n_ops=60, n_procs=4, p_info=0.0)
+    rs = _steps(h, 12)
+    assert canon_pairs(rs) is None
+    cfg = wgl3.dense_config(MODEL, 12, 4)
+    out = wgl3.check_steps3_long(rs, MODEL, cfg, chunk=64)
+    assert "dedup" not in out
+    assert out["valid"] is True
+
+
+def test_dedup_min_frontier_gates_table_pass(restore_limits):
+    """Forced table canon with a sky-high dedup_min_frontier compiles
+    the canon kernel but prunes nothing (the per-step gate never
+    clears) — verdicts and frontier match dedup-off exactly."""
+    rng = random.Random(0x90DE)
+    h = _sym_history(rng, n_ops=80, p_info=0.08)
+    cfg = wgl3.dense_config(MODEL, 12, 4)
+    rs = _steps(h, 12)
+    ref = _off(rs, cfg, 64)
+    _pin(dedup_mode=2, sparse_mode=1, dedup_min_frontier=1 << 20)
+    got = wgl3.check_steps3_long(rs, MODEL, cfg, chunk=64)
+    for f in VERDICT_FIELDS + ("max_frontier", "configs_explored"):
+        assert ref[f] == got[f], f
+    assert got["dedup"]["configs_pruned"] == 0
+
+
+def test_auto_mode_scopes_canon_to_where_it_pays(restore_limits):
+    """AUTO (dedup_mode=0, the default): the packed-TABLE sweeps stay
+    canon-free even on a symmetric history (their cost is fixed in the
+    table size — measured pure overhead), while the resumable sort
+    ladder DOES canonicalize (frontier size drives its cost; the
+    measured 4x win). Force (2) turns the table pass on."""
+    rng = random.Random(0x90DE)   # same symmetric fixture as the gate
+    h = _sym_history(rng, n_ops=80, p_info=0.08)  # test above — pairs real
+    rs = _steps(h, 12)
+    assert canon_pairs(rs) is not None    # the symmetry is real
+    cfg = wgl3.dense_config(MODEL, 12, 4)
+    set_limits(KernelLimits())
+    auto = wgl3.check_steps3_long(rs, MODEL, cfg, chunk=64)
+    assert "dedup" not in auto            # table sweep: canon-free
+    _pin(dedup_mode=2, sparse_mode=1)
+    forced = wgl3.check_steps3_long(rs, MODEL, cfg, chunk=64)
+    assert forced["dedup"]["configs_pruned"] > 0
+    for f in VERDICT_FIELDS:
+        assert auto[f] == forced[f], f
+    # the sort ladder canonicalizes in auto: its frontier shrinks vs
+    # dedup-off while verdicts hold
+    _pin(dedup_mode=1)
+    s_off = check_steps_resumable(rs, MODEL, f_cap=64, chunk=32)
+    set_limits(KernelLimits())
+    s_auto = check_steps_resumable(rs, MODEL, f_cap=64, chunk=32)
+    assert s_auto["valid"] == s_off["valid"]
+    assert s_auto["max_frontier"] <= s_off["max_frontier"]
+
+
+def test_pallas_sparse_routed_by_default(restore_limits):
+    """The ISSUE 10 routing flip: in AUTO mode (sparse_mode=0) a
+    geometry the density signal selects sparse for routes
+    check_steps3_long_pallas through the sparse work-list kernel — no
+    sparse_mode=2 pin — and verdicts match the dedup-off dense sweep
+    (interpret mode; the Mosaic path is the slow-marked TPU test)."""
+    rng = random.Random(0x9DEF)
+    h = gen_register_history(rng, n_ops=32, n_procs=8)
+    cfg = wgl3.dense_config(MODEL, 13, 4, budget=1 << 28)
+    assert wgl3_pallas.pallas_sparse_blocks(cfg) >= 2
+    rs = _steps(h, 13)
+    ref = _off(rs, cfg, 32)
+    _pin(sparse_mode=0, sparse_min_tiles=2, max_r_pallas=32,
+         dedup_mode=1)
+    assert wgl3_pallas.pallas_sparse_selected(cfg)
+    got = wgl3_pallas.check_steps3_long_pallas(rs, MODEL, cfg,
+                                               interpret=True)
+    assert got["kernel"] == "wgl3-dense-pallas-sparse-chunked"
+    for f in VERDICT_FIELDS + ("max_frontier", "configs_explored"):
+        assert ref[f] == got[f], f
+    # default limits: the measured crossover keeps auto OFF inside the
+    # pallas envelope (the XLA signal needs K >= 19 at stock limits)
+    set_limits(KernelLimits())
+    assert not wgl3_pallas.pallas_sparse_selected(cfg)
+    # dense-only pins it off even with a low crossover
+    _pin(sparse_mode=1, sparse_min_tiles=2)
+    assert not wgl3_pallas.pallas_sparse_selected(cfg)
+
+
+@pytest.mark.slow
+def test_pallas_sparse_mosaic_differential(restore_limits):
+    """Real-TPU (Mosaic-compiled) differential for the sparse work-list
+    kernel — the ISSUE 10 hardening lane. Skipped off-TPU; tier-1
+    covers the same kernel in interpret mode above."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        pytest.skip("Mosaic path needs a real TPU backend")
+    rng = random.Random(0x70D0)
+    for trial in range(3):
+        h = gen_register_history(rng, n_ops=200, n_procs=8, p_info=0.01)
+        if trial % 2:
+            h = mutate_history(rng, h)
+        cfg = wgl3.dense_config(MODEL, 13, 4, budget=1 << 28)
+        rs = _steps(h, 13)
+        ref = _off(rs, cfg, None)
+        _pin(sparse_mode=2, dedup_mode=1, max_r_pallas=128)
+        got = wgl3_pallas.check_steps3_long_pallas_sparse(rs, MODEL, cfg)
+        for f in VERDICT_FIELDS + ("max_frontier", "configs_explored"):
+            assert ref[f] == got[f], (trial, f, ref, got)
+        assert got["sweep"]["steps_sparse"] > 0

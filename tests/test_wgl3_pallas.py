@@ -241,7 +241,11 @@ def test_resumable_long_sweep_matches_xla_chunked():
     from jepsen_etcd_demo_tpu.ops.limits import KernelLimits, set_limits
     from jepsen_etcd_demo_tpu.utils.fuzz import mutate_history
 
-    prev = set_limits(KernelLimits(max_r_pallas=64, pallas_step_chunk=32))
+    # dedup_mode pinned OFF: the pallas kernels run no canonicalization
+    # pass, and this test compares the SEARCH metrics bit-for-bit
+    # (tests/test_dedup.py owns the canonicalized comparisons).
+    prev = set_limits(KernelLimits(max_r_pallas=64, pallas_step_chunk=32,
+                                   dedup_mode=1))
     try:
         for trial in range(3):
             h = gen_register_history(random.Random(trial), n_ops=300,

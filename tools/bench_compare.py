@@ -54,6 +54,8 @@ LANES: list[tuple[str, tuple]] = [
     ("cache_hit_rate", ("cache_hit_rate",)),
     ("sparse_dense_eps", ("detail", "sparse", "dense_events_per_sec")),
     ("sparse_sparse_eps", ("detail", "sparse", "sparse_events_per_sec")),
+    ("dedup_off_eps", ("detail", "dedup", "off_events_per_sec")),
+    ("dedup_on_eps", ("detail", "dedup", "on_events_per_sec")),
     ("tuned_default_eps", ("detail", "tuned", "default_events_per_sec")),
     ("tuned_tuned_eps", ("detail", "tuned", "tuned_events_per_sec")),
     ("streaming_speedup", ("detail", "streaming", "speedup_total")),
@@ -69,6 +71,13 @@ INFO_LANES: list[tuple[str, tuple]] = [
     ("kernel_flops", ("kernel_phases", "flops")),
     ("kernel_bytes", ("kernel_phases", "bytes")),
     ("device_mem_peak", ("kernel_phases", "device_mem_peak")),
+    # Dedup-lane configs rates (ISSUE 10): raw (dedup-off) and unique
+    # (canonical) configs/s are reported but NEVER gated — pruning
+    # legitimately moves them, and the lane's gate is events/s above.
+    ("dedup_raw_configs", ("detail", "dedup", "raw_configs_per_sec")),
+    ("dedup_unique_configs", ("detail", "dedup",
+                              "unique_configs_per_sec")),
+    ("dedup_ratio", ("detail", "dedup", "frontier_dedup_ratio")),
 ]
 
 
