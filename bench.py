@@ -825,6 +825,183 @@ def bench_streaming(model, n_keys: int = 16, ops_per_key: int = 400,
     }
 
 
+def bench_elle(n_txns: int = 10_000, n_keys: int = 100,
+               corpus: int = 24, corpus_txns: int = 40) -> dict:
+    """Elle transactional-checker lane (ISSUE 11 tentpole): ONE 10k-txn
+    sparse list-append history (single-key txns over `n_keys` keys —
+    the dependency graph decomposes into per-key components, the shape
+    real multi-key workloads produce) checked end to end under three
+    closure routes, plus a small mixed-validity corpus certified across
+    EVERY route:
+
+      * dense arm — limits().elle_mode=1: the seed [N, N] matrix-
+        squaring closure on the whole graph (measured ONCE — at 10k
+        nodes this is ~14 squarings of a [10112, 10112] f32 matmul);
+      * auto arm (the GATED headline) — elle_mode=0: weak-component
+        decomposition, vmapped bucketed batch launches for the small
+        components, the tiled work-list kernel for big ones; best of
+        REPEATS, events/s and txns/s reported;
+      * tiled arm — elle_mode=2: the blocked work-list kernel forced on
+        the whole graph (informational — on an interleaved graph most
+        tiles are live, so this bounds the kernel, not the route);
+      * oracle — the pinned pure-Python Tarjan/SCC cycle check on the
+        same dependency graph (bench_baseline.json pinning, like every
+        oracle denominator), plus the shared host inference wall.
+
+    Verdicts: the 10k arms must agree (valid=True), and the corpus —
+    half mutated to likely-anomalous — must produce BIT-IDENTICAL
+    anomaly verdicts across dense / batched-auto / tiled / streamed /
+    host-Tarjan-fallback routes (the acceptance criterion's 'all
+    routes')."""
+    import time as _time
+    from dataclasses import replace
+
+    from jepsen_etcd_demo_tpu import obs
+    from jepsen_etcd_demo_tpu.checkers.elle import ElleChecker, ElleGraph
+    from jepsen_etcd_demo_tpu.ops.limits import limits, set_limits
+    from jepsen_etcd_demo_tpu.stream import ElleStreamSession
+    from jepsen_etcd_demo_tpu.utils.fuzz import (append_txn_ops,
+                                                 gen_append_txns,
+                                                 mutate_append_txns)
+
+    rng = random.Random(0xE11E)
+    # Per-key txn runs are CONTIGUOUS (the workload-rotating-through-
+    # keys shape): the dependency graph is block-diagonal, so the tiled
+    # arm's occupancy skipping has real empty tiles to skip and the
+    # auto arm's decomposition has real components — while the dense
+    # arm still pays the full [N, N] closure either way.
+    txns = []
+    per_key = max(1, n_txns // n_keys)
+    for k in range(n_keys):
+        txns.extend(gen_append_txns(rng, n_txns=per_key, n_keys=1,
+                                    max_len=1, first_key=k))
+    n_txns = len(txns)
+    history = append_txn_ops(txns)
+    checker = ElleChecker()
+
+    # Shared host inference wall (pairing + incremental graph build) —
+    # identical across arms, measured once so the closure arms' deltas
+    # are attributable to the closure route alone.
+    t0 = _time.perf_counter()
+    graph = ElleGraph()
+    from jepsen_etcd_demo_tpu.checkers.elle import _pair_txns
+
+    for t in _pair_txns(history):
+        graph.add_txn(*t)
+    ww, wr, rw = graph.edge_matrices()
+    infer_s = _time.perf_counter() - t0
+    full = ww | wr | rw
+    n_nodes = full.shape[0]
+    edges = int(full.sum())
+
+    lane = {"txns": n_txns, "events": len(history), "keys": n_keys,
+            "graph_nodes": n_nodes, "graph_edges": edges,
+            "infer_s": round(infer_s, 4)}
+
+    def timed_check(mode: int, repeats: int):
+        prev = set_limits(replace(limits(), elle_mode=mode))
+        try:
+            out = checker.check({}, history)       # warm the kernels
+            best = float("inf")
+            for _ in range(repeats):
+                t0 = _time.perf_counter()
+                out = checker.check({}, history)
+                best = min(best, _time.perf_counter() - t0)
+        finally:
+            set_limits(prev)
+        return best, out
+
+    dense_s, dense_out = timed_check(1, repeats=1)
+    auto_s, auto_out = timed_check(0, repeats=REPEATS)
+    tiled_s, tiled_out = timed_check(2, repeats=1)
+    for name, out in (("dense", dense_out), ("auto", auto_out),
+                      ("tiled", tiled_out)):
+        assert out["valid"] is True, f"elle lane {name} arm: {out}"
+    assert dense_out == auto_out == tiled_out, \
+        "elle route verdict drift on the 10k history"
+
+    # Pinned Tarjan/SCC oracle on the same dependency graph.
+    from jepsen_etcd_demo_tpu.ops.cycles import _host_cycle_mask
+
+    sig = {"lane": "elle", "txns": n_txns, "nodes": n_nodes,
+           "edges": edges,
+           "checksum": int(np.flatnonzero(full).sum() & 0x7FFFFFFF)}
+    oracle_s = _pinned_oracle("elle", sig)
+    pinned = oracle_s is not None
+    if not pinned:
+        t0 = _time.perf_counter()
+        assert not _host_cycle_mask(full).any()
+        oracle_s = _time.perf_counter() - t0
+        _pin_oracle("elle", sig, oracle_s)
+
+    lane.update({
+        "dense_s": round(dense_s, 4),
+        "auto_s": round(auto_s, 4),
+        "tiled_s": round(tiled_s, 4),
+        "oracle_s": round(oracle_s, 4),
+        "oracle_pinned": pinned,
+        "events_per_sec": round(len(history) / auto_s, 1),
+        "txns_per_sec": round(n_txns / auto_s, 1),
+        "speedup_vs_dense": round(dense_s / auto_s, 2) if auto_s else 0.0,
+        "vs_oracle": (round((infer_s + oracle_s) / auto_s, 2)
+                      if auto_s else 0.0),
+        "kernel": "elle-closure-batch",
+    })
+
+    # Mixed-validity certification across EVERY route: dense, batched
+    # auto, tiled, streamed, and the host Tarjan fallback (cell budget
+    # pinned below any graph so every closure takes the SCC oracle).
+    crng = random.Random(0xE11F)
+    cases = []
+    for i in range(corpus):
+        t = gen_append_txns(crng, n_txns=corpus_txns, n_keys=4, max_len=3)
+        if i % 2:
+            t = mutate_append_txns(crng, t)
+        cases.append(append_txn_ops(t))
+    routes = {"dense": {"elle_mode": 1}, "auto": {"elle_mode": 0},
+              "tiled": {"elle_mode": 2},
+              "tarjan": {"elle_mode": 0, "elle_cell_budget": 1 << 12}}
+    verdicts: dict[str, list] = {}
+    for name, overrides in routes.items():
+        prev = set_limits(replace(limits(), **overrides))
+        try:
+            with obs.capture() as rcap:
+                verdicts[name] = [checker.check({}, h) for h in cases]
+            if name == "tarjan":
+                # The certification's independence claim: the pinned
+                # budget must actually route every closure to the host
+                # SCC oracle, not re-run a device route.
+                rstats = obs.elle_stats(rcap.metrics)
+                assert rstats["graphs_oracle"] > 0, rstats
+                assert rstats["graphs_dense"] == 0, rstats
+        finally:
+            set_limits(prev)
+    streamed = []
+    for h in cases:
+        session = ElleStreamSession(checker)
+        for op in h:
+            session.feed(op)
+        res = session.finalize()
+        assert res is not None, "elle lane corpus must stream"
+        one = dict(res["elle"])
+        one.pop("streamed", None)
+        streamed.append(one)
+    verdicts["streamed"] = streamed
+    ref = verdicts["tarjan"]
+    mismatches = sum(
+        1 for name, outs in verdicts.items()
+        for a, b in zip(outs, ref)
+        if (a["valid"], a["anomaly_types"]) != (b["valid"],
+                                               b["anomaly_types"]))
+    invalid = sum(1 for r in ref if r["valid"] is False)
+    assert invalid >= corpus // 4, f"tame elle mutation sweep: {invalid}"
+    assert mismatches == 0, f"elle route certification: {verdicts}"
+    lane["corpus"] = {"histories": corpus, "invalid": invalid,
+                      "routes": sorted(verdicts), "mismatches": 0}
+    lane["verdicts_identical"] = True
+    return lane
+
+
 def _profile_record() -> dict:
     """The profile stamp every bench record carries (degraded path
     included — a degraded run still states which profile it intended to
@@ -1151,6 +1328,7 @@ def main():
                 "padding_waste": 0.0,
                 "cache_hit_rate": 0.0,
                 "sweep": obs.sweep_stats(None),
+                "elle": obs.elle_stats(None),
                 # Which tuning profile the run INTENDED to use (ISSUE 4:
                 # tools/print_profile.py prints the full resolved view).
                 "profile": _profile_record(),
@@ -1222,6 +1400,10 @@ def main():
             # end-to-end wall on one generated run, verdicts asserted
             # bit-identical, overlap_ratio measured.
             stream_lane = bench_streaming(model)
+            # Elle transactional-checker lane (ISSUE 11): dense vs
+            # tiled/batched closure on one 10k-txn sparse history,
+            # verdicts certified bit-identical across every route.
+            elle_lane = bench_elle()
             # Inside the capture: the 100k lane's compile/execute/encode
             # seconds must land in the same kernel_phases breakdown as
             # every other lane when it actually runs.
@@ -1246,6 +1428,7 @@ def main():
             "padding_waste": 0.0,
             "cache_hit_rate": 0.0,
             "sweep": obs.sweep_stats(cap.metrics),
+            "elle": obs.elle_stats(cap.metrics),
             "profile": _profile_record(),
             "health": health_rec,
             "degraded": True,
@@ -1285,6 +1468,7 @@ def main():
         "dedup": dedup_lane,
         "tuned": tuned_lane,
         "streaming": stream_lane,
+        "elle": elle_lane,
     }
     if "roofline" in corpus:
         detail["roofline"] = corpus["roofline"]
@@ -1318,6 +1502,10 @@ def main():
         # capture (doc/perf.md): live-tile-ratio gauge + per-mode step/
         # check counters — zeros permitted, never absent.
         "sweep": obs.sweep_stats(cap.metrics),
+        # Elle closure-engine accounting over the same capture
+        # (ISSUE 11): per-route graph counts, launches, tiled rounds,
+        # streamed txns — zeros permitted, never absent.
+        "elle": obs.elle_stats(cap.metrics),
         # The tuning profile this round resolved (ISSUE 4): hash +
         # non-default fields with provenance; detail.tuned measures it.
         "profile": _profile_record(),

@@ -48,11 +48,22 @@ Anomalies (elle's taxonomy):
                              anomalies elle reports as G0/G1c/G-single/
                              G2-item-realtime
 
-Cycle search runs on the dense adjacency matrix via MXU matrix-squaring
-closure (ops/cycles.py); the found cycle is reconstructed host-side as the
-witness. :info txns are treated soundly: their appends may legitimately be
-observed (never G1a) but contribute no graph edges (their order is
-unknowable), so no anomaly can be fabricated from an indeterminate txn.
+Cycle search runs on the routed transitive-closure engine
+(ops/cycles.py): cycle-presence probes fetch only the diagonal, the
+classification ladder's same-size tier graphs close in ONE vmapped
+batched launch, and big sparse graphs decompose into weak components
+checked batched/tiled (ops/cycles_tiled.py). The found cycle is
+reconstructed host-side as the witness. :info txns are treated soundly:
+their appends may legitimately be observed (never G1a) but contribute no
+graph edges (their order is unknowable), so no anomaly can be fabricated
+from an indeterminate txn.
+
+The inference itself lives in :class:`ElleGraph` — an INCREMENTAL state
+machine fed one completed txn at a time. The post-hoc checker feeds it
+the whole paired history; the streaming session (stream/elle.py) feeds
+it as completions land and re-checks the grown graph periodically, and
+both finalize through the same `_check_graph`, so streamed and post-hoc
+verdicts are bit-identical by construction.
 """
 
 from __future__ import annotations
@@ -63,7 +74,8 @@ from typing import Any, Sequence
 import numpy as np
 
 from .base import Checker
-from ..ops.cycles import bfs_path, extract_cycle, reach_and_cycles
+from ..ops import cycles
+from ..ops.cycles import bfs_path, extract_cycle, reach_and_cycles  # noqa: F401 (re-exported API)
 from ..ops.op import Op
 
 
@@ -101,6 +113,229 @@ def _pair_txns(history: Sequence[Op]):
     return txns
 
 
+class ElleGraph:
+    """Incremental list-append dependency-graph inference — ONE state
+    machine behind both the post-hoc checker and the streaming session
+    (stream/elle.py), so the two can never drift.
+
+    Feed completed txns in history order with :meth:`add_txn`; per-key
+    derived state (direct anomalies + ww/wr/rw edge contributions) is
+    recomputed lazily for DIRTY keys only on :meth:`refresh` — a key is
+    dirty when a new read, a new committed append, or a newly-known
+    failed append touches it, which is exactly when its derived record
+    can change. Every edge and every direct anomaly is derivable from
+    per-key state alone, so the incremental recompute is equal by
+    construction to the one-shot pass over the full history."""
+
+    def __init__(self):
+        self.oks: list[tuple] = []           # the _pair_txns 5-tuples
+        self.append_of: dict[tuple, int] = {}
+        self.failed_vals: set[tuple] = set()
+        self.multi_appends: dict[tuple, list] = defaultdict(list)
+        self.appends_by_key: dict[Any, list] = {}
+        self.reads: dict[Any, list] = {}     # k -> [(reader, vs tuple)]
+        self.internal: list[dict] = []       # txn-ordered
+        self._dirty: set = set()
+        self._per_key: dict[Any, dict] = {}
+
+    # -- feeding ----------------------------------------------------------
+    def add_txn(self, value, typ, comp_value, inv_pos: int = -1,
+                comp_pos: int = -1) -> None:
+        """One completed txn, in history order (the _pair_txns tuple
+        shape). :ok txns join the graph; :fail txns contribute their
+        append values to the aborted-read set; :info txns contribute
+        nothing (their order is unknowable)."""
+        if typ == "fail":
+            for mop in value:
+                if mop[0] == "append":
+                    self.failed_vals.add((mop[1], mop[2]))
+                    self._dirty.add(mop[1])
+            return
+        if typ != "ok":
+            return
+        i = len(self.oks)
+        self.oks.append((value, typ, comp_value, inv_pos, comp_pos))
+        own: dict[Any, list] = defaultdict(list)
+        for mop in comp_value:
+            if mop[0] == "append":
+                k, v = mop[1], mop[2]
+                if (k, v) in self.append_of:
+                    raise TxnEncodeError(
+                        f"append value {v!r} reused for key {k!r}")
+                self.append_of[(k, v)] = i
+                self.multi_appends[(i, k)].append(v)
+                self.appends_by_key.setdefault(k, []).append((v, i))
+                self._dirty.add(k)
+                own[k].append(v)
+            elif mop[0] == "r" and mop[2] is not None:
+                k = mop[1]
+                # Internal consistency: a read of k must observe the
+                # txn's own earlier appends to k as the list's suffix
+                # (elle's :internal — the txn's own completed micro-op
+                # order, before any cross-txn inference).
+                o = own[k]
+                vs = list(mop[2])
+                if o and vs[len(vs) - len(o):] != o:
+                    self.internal.append(
+                        {"key": k, "expected_suffix": list(o),
+                         "read": vs, "txn": i})
+                self.reads.setdefault(k, []).append((i, tuple(mop[2])))
+                self._dirty.add(k)
+
+    # -- per-key derivation ----------------------------------------------
+    def refresh(self) -> None:
+        for k in self._dirty:
+            if k in self.reads:
+                self._per_key[k] = self._derive_key(k)
+        self._dirty.clear()
+
+    def _derive_key(self, k) -> dict:
+        """The full per-key derived record: direct anomaly lists (reader
+        order), the observed version order, and this key's ww/wr/rw edge
+        contributions — the one copy of the inference both the post-hoc
+        and the streamed paths run."""
+        append_of, multi_appends = self.append_of, self.multi_appends
+        rec: dict[str, Any] = {"duplicates": [], "G1a": [],
+                               "lost-append": [], "G1b": [],
+                               "incompatible-order": []}
+        obs = self.reads[k]
+        for reader, vs in obs:
+            if len(set(vs)) != len(vs):
+                rec["duplicates"].append(
+                    {"key": k, "read": list(vs), "reader": reader})
+            for v in vs:
+                if (k, v) in self.failed_vals \
+                        and (k, v) not in append_of:
+                    rec["G1a"].append(
+                        {"key": k, "value": v, "reader": reader})
+            # A committed txn's appends to k are atomic: they occupy a
+            # contiguous run of the true list, and any read is a prefix
+            # of that list. So an observed value must have the writer's
+            # previous append IMMEDIATELY before it, and — unless the
+            # read ends there — the writer's next append immediately
+            # after it. A violation proves an acked append vanished
+            # (lost-append), regardless of which txn wrote the value
+            # that sits there instead.
+            for p, v in enumerate(vs):
+                owner = append_of.get((k, v))
+                if owner is None or owner == reader:
+                    continue
+                own = multi_appends[(owner, k)]
+                i = own.index(v)
+                if i > 0 and (p == 0 or vs[p - 1] != own[i - 1]):
+                    rec["lost-append"].append(
+                        {"key": k, "missing": own[i - 1],
+                         "observed": v, "read": list(vs),
+                         "writer": owner, "reader": reader})
+                if (i + 1 < len(own) and p + 1 < len(vs)
+                        and vs[p + 1] != own[i + 1]):
+                    rec["lost-append"].append(
+                        {"key": k, "missing": own[i + 1],
+                         "observed": v, "read": list(vs),
+                         "writer": owner, "reader": reader})
+            if vs:
+                owner = append_of.get((k, vs[-1]))
+                if owner is not None:
+                    own = multi_appends[(owner, k)]
+                    if own and vs[-1] != own[-1] and owner != reader:
+                        rec["G1b"].append(
+                            {"key": k, "value": vs[-1],
+                             "reader": reader, "writer": owner})
+        # Prefix-compatibility: ascending by length, every read must
+        # extend the previous longest (two equal-length reads that
+        # differ fail the prefix test directly).
+        longest: tuple = ()
+        for _, vs in sorted(obs, key=lambda rv: len(rv[1])):
+            if vs[:len(longest)] != longest:
+                rec["incompatible-order"].append(
+                    {"key": k, "read_a": list(longest),
+                     "read_b": list(vs)})
+                break
+            longest = vs
+        rec["order"] = longest
+
+        # Edge contributions. ww: consecutive observed versions order
+        # their writers. wr: the read's last value orders its writer
+        # before the reader. rw (anti-dependency): a read returns the
+        # WHOLE list, so a committed append serialized before it must
+        # appear in it — contrapositive: every committed append of a
+        # value ABSENT from the observed list is serialized after the
+        # read, including acked appends no read ever observed (ADVICE
+        # r2). The absent-writer set depends only on (key, observed
+        # tuple): memoized so many readers of one prefix share a scan;
+        # self-edges dropped (a txn is not its own anti-dependency).
+        ww_pairs: set = set()
+        for a, b in zip(longest, longest[1:]):
+            wa, wb = append_of.get((k, a)), append_of.get((k, b))
+            if wa is not None and wb is not None and wa != wb:
+                ww_pairs.add((wa, wb))
+        wr_pairs: set = set()
+        rw_pairs: set = set()
+        absent: dict[tuple, list] = {}
+        appends = self.appends_by_key.get(k, ())
+        for reader, vs in obs:
+            if vs:
+                wa = append_of.get((k, vs[-1]))
+                if wa is not None and wa != reader:
+                    wr_pairs.add((wa, reader))
+            tgt = absent.get(vs)
+            if tgt is None:
+                seen = set(vs)
+                tgt = [wb for v, wb in appends if v not in seen]
+                absent[vs] = tgt
+            for wb in tgt:
+                if wb != reader:
+                    rw_pairs.add((reader, wb))
+        rec["ww"], rec["wr"], rec["rw"] = ww_pairs, wr_pairs, rw_pairs
+        return rec
+
+    # -- assembled views --------------------------------------------------
+    def direct_anomalies(self) -> dict[str, list]:
+        """Fresh anomaly dict of every non-cycle anomaly found so far —
+        internal in txn order, then per-key lists in key-first-read
+        order (the exact order the one-shot pass produced)."""
+        self.refresh()
+        anomalies: dict[str, list] = defaultdict(list)
+        anomalies["internal"].extend(self.internal)
+        if not self.internal:
+            del anomalies["internal"]
+        for k in self.reads:
+            rec = self._per_key[k]
+            for t in ("duplicates", "G1a", "lost-append", "G1b",
+                      "incompatible-order"):
+                if rec[t]:
+                    anomalies[t].extend(rec[t])
+        return anomalies
+
+    def edge_matrices(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(ww, wr, rw) boolean matrices over the ok txns so far."""
+        self.refresh()
+        n = len(self.oks)
+        ww = np.zeros((n, n), bool)
+        wr = np.zeros((n, n), bool)
+        rw = np.zeros((n, n), bool)
+        for k in self.reads:
+            rec = self._per_key[k]
+            for m, pairs in ((ww, rec["ww"]), (wr, rec["wr"]),
+                             (rw, rec["rw"])):
+                if pairs:
+                    idx = np.fromiter((x for p in pairs for x in p),
+                                      dtype=np.intp,
+                                      count=2 * len(pairs)).reshape(-1, 2)
+                    m[idx[:, 0], idx[:, 1]] = True
+        return ww, wr, rw
+
+    def rt_matrix(self) -> np.ndarray | None:
+        """Wall-clock order over the ok txns (A completed before B
+        invoked => A precedes B) — the strict-serializability edges."""
+        n = len(self.oks)
+        if not n:
+            return None
+        inv_pos = np.array([t[3] for t in self.oks])
+        comp_pos = np.array([t[4] for t in self.oks])
+        return comp_pos[:, None] < inv_pos[None, :]
+
+
 class ElleChecker(Checker):
     """checker/elle equivalent over list-append txn histories.
 
@@ -116,159 +351,28 @@ class ElleChecker(Checker):
 
     def check(self, test: dict, history: Sequence[Op],
               opts: dict | None = None) -> dict[str, Any]:
-        txns = _pair_txns(history)
-        oks = [t for t in txns if t[1] == "ok"]
-        n = len(oks)
-        anomalies: dict[str, list] = defaultdict(list)
+        # A valid verdict the run's streaming elle session already
+        # settled (stream/elle.py — the same ElleGraph fed live) skips
+        # the post-hoc pass entirely; invalid/absent re-runs post-hoc,
+        # exactly the Linearizable stream-settling discipline.
+        pre = ((opts or {}).get("stream_results") or {}).get("elle")
+        if (isinstance(pre, dict) and pre.get("streamed")
+                and pre.get("valid") is True
+                and pre.get("realtime") == self.realtime):
+            return pre
+        graph = ElleGraph()
+        for txn in _pair_txns(history):
+            graph.add_txn(*txn)
+        return self._check_graph(graph)
 
-        # Ownership maps per key.
-        append_of: dict[tuple, int] = {}      # (k, v) -> ok txn idx
-        failed_vals: set[tuple] = set()
-        multi_appends: dict[tuple, list] = defaultdict(list)  # per (txn,k)
-        for i, (_, _, value, *_pos) in enumerate(oks):
-            for mop in value:
-                if mop[0] == "append":
-                    k, v = mop[1], mop[2]
-                    if (k, v) in append_of:
-                        raise TxnEncodeError(
-                            f"append value {v!r} reused for key {k!r}")
-                    append_of[(k, v)] = i
-                    multi_appends[(i, k)].append(v)
-        for value, typ, *_rest in txns:
-            if typ == "fail":
-                for mop in value:
-                    if mop[0] == "append":
-                        failed_vals.add((mop[1], mop[2]))
-
-        # Internal consistency: within one txn, a read of k must observe
-        # the txn's own earlier appends to k as the list's suffix (elle's
-        # :internal anomaly — checked on the txn's own completed micro-op
-        # order, before any cross-txn inference).
-        for i, (_, _, value, *_pos) in enumerate(oks):
-            own: dict[Any, list] = defaultdict(list)
-            for mop in value:
-                if mop[0] == "append":
-                    own[mop[1]].append(mop[2])
-                elif mop[0] == "r" and mop[2] is not None:
-                    o = own[mop[1]]
-                    vs = list(mop[2])
-                    if o and vs[len(vs) - len(o):] != o:
-                        anomalies["internal"].append(
-                            {"key": mop[1], "expected_suffix": list(o),
-                             "read": vs, "txn": i})
-
-        # Reads grouped per key: (reader_idx, observed tuple).
-        reads: dict[Any, list] = defaultdict(list)
-        for i, (_, _, value, *_pos) in enumerate(oks):
-            for mop in value:
-                if mop[0] == "r" and mop[2] is not None:
-                    reads[mop[1]].append((i, tuple(mop[2])))
-
-        # Direct (non-cycle) anomalies and the per-key observed version
-        # order.
-        order: dict[Any, tuple] = {}
-        for k, obs in reads.items():
-            for reader, vs in obs:
-                if len(set(vs)) != len(vs):
-                    anomalies["duplicates"].append(
-                        {"key": k, "read": list(vs), "reader": reader})
-                for v in vs:
-                    if (k, v) in failed_vals and (k, v) not in append_of:
-                        anomalies["G1a"].append(
-                            {"key": k, "value": v, "reader": reader})
-                # A committed txn's appends to k are atomic: they occupy a
-                # contiguous run of the true list, and any read is a
-                # prefix of that list. So an observed value must have the
-                # writer's previous append IMMEDIATELY before it, and —
-                # unless the read ends there — the writer's next append
-                # immediately after it. A violation proves an acked
-                # append vanished (lost-append), regardless of which txn
-                # wrote the value that sits there instead.
-                for p, v in enumerate(vs):
-                    owner = append_of.get((k, v))
-                    if owner is None or owner == reader:
-                        continue
-                    own = multi_appends[(owner, k)]
-                    i = own.index(v)
-                    if i > 0 and (p == 0 or vs[p - 1] != own[i - 1]):
-                        anomalies["lost-append"].append(
-                            {"key": k, "missing": own[i - 1],
-                             "observed": v, "read": list(vs),
-                             "writer": owner, "reader": reader})
-                    if (i + 1 < len(own) and p + 1 < len(vs)
-                            and vs[p + 1] != own[i + 1]):
-                        anomalies["lost-append"].append(
-                            {"key": k, "missing": own[i + 1],
-                             "observed": v, "read": list(vs),
-                             "writer": owner, "reader": reader})
-                if vs:
-                    owner = append_of.get((k, vs[-1]))
-                    if owner is not None:
-                        own = multi_appends[(owner, k)]
-                        if own and vs[-1] != own[-1] and owner != reader:
-                            anomalies["G1b"].append(
-                                {"key": k, "value": vs[-1],
-                                 "reader": reader, "writer": owner})
-            # Prefix-compatibility: ascending by length, every read must
-            # extend the previous longest (two equal-length reads that
-            # differ fail the prefix test directly).
-            longest = ()
-            for _, vs in sorted(obs, key=lambda rv: len(rv[1])):
-                if vs[:len(longest)] != longest:
-                    anomalies["incompatible-order"].append(
-                        {"key": k, "read_a": list(longest),
-                         "read_b": list(vs)})
-                    break
-                longest = vs
-            order[k] = longest
-
-        # Dependency edges over ok txns.
-        ww = np.zeros((n, n), bool)
-        wr = np.zeros((n, n), bool)
-        rw = np.zeros((n, n), bool)
-        for k, longest in order.items():
-            for a, b in zip(longest, longest[1:]):
-                wa, wb = append_of.get((k, a)), append_of.get((k, b))
-                if wa is not None and wb is not None and wa != wb:
-                    ww[wa, wb] = True
-        appends_by_key: dict[Any, list] = defaultdict(list)
-        for (k, v), i in append_of.items():
-            appends_by_key[k].append((v, i))
-        # rw (anti-dependency): a read returns the WHOLE list, so a
-        # committed append serialized before it must appear in it.
-        # Contrapositive: every committed append of a value ABSENT from
-        # the observed list is serialized after the read — including
-        # acked appends no read ever observed (the case the old
-        # next-observed-value rule missed, ADVICE r2: T1 appends x=1 :ok,
-        # T2 later reads x=[] — rw T2->T1 plus rt T1->T2 is the
-        # G-single-realtime cycle). The absent-writer set depends only on
-        # (key, observed tuple): memoized so many readers of the same
-        # prefix share one scan, and applied as one vectorized row
-        # assignment (self-edges cleared — a txn is not its own
-        # anti-dependency).
-        absent_writers: dict[tuple, np.ndarray] = {}
-        for k, obs in reads.items():
-            for reader, vs in obs:
-                if vs:
-                    wa = append_of.get((k, vs[-1]))
-                    if wa is not None and wa != reader:
-                        wr[wa, reader] = True
-                tgt = absent_writers.get((k, vs))
-                if tgt is None:
-                    seen = set(vs)
-                    tgt = np.array([wb for v, wb in appends_by_key.get(k, ())
-                                    if v not in seen], dtype=np.intp)
-                    absent_writers[(k, vs)] = tgt
-                if tgt.size:
-                    rw[reader, tgt] = True
-                    rw[reader, reader] = False
-
-        rt = None
-        if self.realtime and n:
-            inv_pos = np.array([t[3] for t in oks])
-            comp_pos = np.array([t[4] for t in oks])
-            rt = comp_pos[:, None] < inv_pos[None, :]
-        self._find_cycles(ww, wr, rw, oks, anomalies, rt)
+    def _check_graph(self, graph: ElleGraph) -> dict[str, Any]:
+        """Verdict assembly from an (incrementally or batch) fed graph —
+        the one finalization path post-hoc and streamed checks share."""
+        n = len(graph.oks)
+        anomalies = graph.direct_anomalies()
+        ww, wr, rw = graph.edge_matrices()
+        rt = graph.rt_matrix() if self.realtime else None
+        self._find_cycles(ww, wr, rw, graph.oks, anomalies, rt)
 
         types = sorted(anomalies)
         edge_counts = {"ww": int(ww.sum()), "wr": int(wr.sum()),
@@ -300,8 +404,7 @@ class ElleChecker(Checker):
         # serializable ladder first (its anomaly names are stronger); only
         # when the cycle NEEDS a realtime edge does the "-realtime" ladder
         # name it.
-        _, cyc = reach_and_cycles(ww | wr | rw | rt)
-        if not cyc.any():
+        if not cycles.cycle_mask(ww | wr | rw | rt).any():
             return
         if not self._classify(ww, wr, rw, None, "", witness, anomalies):
             self._classify(ww, wr, rw, rt, "-realtime", witness, anomalies)
@@ -315,36 +418,50 @@ class ElleChecker(Checker):
             return adj if rt is None else adj | rt
 
         # Full graph first: acyclic full graph implies every subset is
-        # acyclic — ONE closure launch on the (common) valid path.
+        # acyclic — ONE cycle-presence probe (diagonal-only fetch,
+        # component-decomposed for big graphs) on the common valid path.
         full = with_rt(ww | wr | rw)
-        reach_f, cyc_f = reach_and_cycles(full)
+        cyc_f = cycles.cycle_mask(full)
         if not cyc_f.any():
             return False
+        # The two sub-ladder tiers share the full graph's size: ONE
+        # vmapped batched launch closes both — except past the dense
+        # crossover / cell budget, where each tier routes individually
+        # (decomposition / tiled / host oracle) instead of stacking two
+        # full-size copies.
         g0 = with_rt(ww)
-        reach_g0, cyc_g0 = reach_and_cycles(g0)
+        g1 = with_rt(ww | wr)
+        if cycles.batchable(full.shape[0]):
+            cyc_g0, cyc_g1 = cycles.cycle_masks_batch([g0, g1])
+        else:
+            cyc_g0 = cycles.cycle_mask(g0)
+            cyc_g1 = cycles.cycle_mask(g1)
         if cyc_g0.any():
             anomalies["G0" + suffix].append(witness(
-                extract_cycle(g0, reach_g0, cyc_g0)))
-        g1 = with_rt(ww | wr)
-        reach_g1, cyc_g1 = reach_and_cycles(g1)
+                cycles.extract_cycle_any(g0, cyc_g0)))
         if cyc_g1.any() and not cyc_g0.any():
             anomalies["G1c" + suffix].append(witness(
-                extract_cycle(g1, reach_g1, cyc_g1)))
+                cycles.extract_cycle_any(g1, cyc_g1)))
         if not cyc_g1.any():
             # Cycles need rw edges. G-single holds iff SOME rw edge is
             # closed by a (ww|wr|rt)-only path (exactly one
             # anti-dependency) — exact, unlike counting rw edges on one
             # arbitrary extracted cycle, which can mis-classify when 1-rw
-            # and 2-rw cycles coexist.
-            for a, b in zip(*np.nonzero(rw & ~g1)):
-                if reach_g1[b, a]:
+            # and 2-rw cycles coexist. Reachability answers come from
+            # reach_pairs (per-component closures), never a full [N, N]
+            # slab fetch.
+            edges = list(zip(*np.nonzero(rw & ~g1)))
+            hits = cycles.reach_pairs(
+                g1, [(int(b), int(a)) for a, b in edges])
+            for (a, b), hit in zip(edges, hits):
+                if hit:
                     back = bfs_path(g1, int(b), int(a))  # [b, ..., a]
                     anomalies["G-single" + suffix].append(
                         witness([int(a)] + back))
                     break
             else:
                 anomalies["G2-item" + suffix].append(witness(
-                    extract_cycle(full, reach_f, cyc_f)))
+                    cycles.extract_cycle_any(full, cyc_f)))
         return True
 
 

@@ -108,6 +108,24 @@ SWEEP_GAUGE = "wgl.live_tile_ratio"
 # every sweep key).
 # jtflow: metrics preregistered
 DEDUP_GAUGE = "wgl.frontier_dedup_ratio"
+# Elle transitive-closure engine (ops/cycles.py / ops/cycles_tiled.py /
+# stream/elle.py, ISSUE 11): per-route graph counts (dense squaring /
+# vmapped batch / tiled work-list / host-oracle fallback), launch and
+# tiled-round accounting, and the streaming session's txn/re-check
+# counters — pre-registered so every capture's metrics.json carries
+# them (zeros permitted, never absent; elle_stats() is the bench/web
+# reader).
+# jtflow: metrics preregistered
+ELLE_COUNTERS = ("elle.graphs_dense", "elle.graphs_batched",
+                 "elle.graphs_tiled", "elle.graphs_oracle",
+                 "elle.closure_launches", "elle.tiled_rounds_sparse",
+                 "elle.tiled_rounds_dense", "elle.stream_txns",
+                 "elle.stream_rechecks")
+# Batched-launch fill ratio (real graphs / padded batch) and the tiled
+# kernel's last eligible-product density — the elle engine's occupancy
+# telemetry.
+# jtflow: metrics preregistered
+ELLE_GAUGES = ("elle.batch_fill", "elle.tile_density")
 # Streaming check engine (stream/engine.py): fraction of return steps
 # swept while the run was still live, and the watermark's lag behind
 # the recorder (history entries recorded but not yet stable) — pre-
@@ -146,8 +164,10 @@ class Capture:
         self.metrics = MetricsRegistry(enabled=enabled)
         if enabled:
             for name in PHASE_COUNTERS + SCHED_COUNTERS + SWEEP_COUNTERS \
-                    + COST_COUNTERS:
+                    + COST_COUNTERS + ELLE_COUNTERS:
                 self.metrics.counter(name)
+            for name in ELLE_GAUGES:
+                self.metrics.gauge(name)
             self.metrics.gauge(PHASE_GAUGE)
             self.metrics.gauge(SWEEP_GAUGE)
             self.metrics.gauge(DEDUP_GAUGE)
@@ -510,6 +530,44 @@ def sweep_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
     g = snap.get(DEDUP_GAUGE)
     if g and g.get("last") is not None:
         out["frontier_dedup_ratio"] = round(float(g["last"]), 4)
+    return out
+
+
+def elle_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
+    """The elle closure engine's bench/web contract fields, from a
+    registry snapshot: per-route graph counts, launch/round accounting,
+    the streamed-session counters, and the occupancy gauges. Zeros when
+    no registry / no elle checks — like every reader here, the contract
+    is "zeros permitted, never absent"."""
+    out = {"graphs_dense": 0, "graphs_batched": 0, "graphs_tiled": 0,
+           "graphs_oracle": 0, "closure_launches": 0,
+           "tiled_rounds_sparse": 0, "tiled_rounds_dense": 0,
+           "stream_txns": 0, "stream_rechecks": 0,
+           "batch_fill": 0.0, "tile_density": 0.0}
+    if metrics is None or not metrics.enabled:
+        return out
+    snap = metrics.snapshot()
+
+    def counter_value(key: str) -> int:
+        rec = snap.get(key)
+        return int(rec["value"]) if rec \
+            and rec.get("type") == "counter" else 0
+
+    out["graphs_dense"] = counter_value("elle.graphs_dense")
+    out["graphs_batched"] = counter_value("elle.graphs_batched")
+    out["graphs_tiled"] = counter_value("elle.graphs_tiled")
+    out["graphs_oracle"] = counter_value("elle.graphs_oracle")
+    out["closure_launches"] = counter_value("elle.closure_launches")
+    out["tiled_rounds_sparse"] = counter_value("elle.tiled_rounds_sparse")
+    out["tiled_rounds_dense"] = counter_value("elle.tiled_rounds_dense")
+    out["stream_txns"] = counter_value("elle.stream_txns")
+    out["stream_rechecks"] = counter_value("elle.stream_rechecks")
+    g = snap.get("elle.batch_fill")
+    if g and g.get("last") is not None:
+        out["batch_fill"] = round(float(g["last"]), 4)
+    g = snap.get("elle.tile_density")
+    if g and g.get("last") is not None:
+        out["tile_density"] = round(float(g["last"]), 4)
     return out
 
 

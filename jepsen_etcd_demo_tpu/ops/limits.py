@@ -231,6 +231,64 @@ class KernelLimits:
     # Skipping is always sound (canonicalization is an optimization,
     # not a correctness pass); orthogonal to dedup_mode.
     dedup_min_frontier: int = _f(64, "tunable", 0, 1 << 20, group="dedup")
+    # [arch] Route override for the elle transitive-closure engine
+    # (ops/cycles.py): 0 = auto (dense squaring below
+    # elle_dense_max_nodes; component decomposition + bucketed batch +
+    # tiled work-list kernel above), 1 = dense-only (the seed [N, N]
+    # matrix-squaring path regardless of size — the bench's baseline
+    # arm), 2 = prefer-tiled (the blocked work-list kernel even for
+    # small graphs — the bench/test lane for exercising the tiled path
+    # deterministically). Exact in every mode: the closure fixpoint is
+    # unique, so anomaly verdicts never depend on the route.
+    elle_mode: int = _f(0, "arch", 0, 2)
+    # [tunable] Node-count crossover below which a single dependency
+    # graph routes to the dense [N, N] matrix-squaring kernel: under it
+    # the straight-line MXU/BLAS closure beats the decompose/gather
+    # overhead; above it the graph is decomposed into weak components
+    # checked batched (small) or tiled (large). 2048 encodes ONE CPU
+    # measurement; the elle tune probe group measures it per machine.
+    elle_dense_max_nodes: int = _f(2048, "tunable", 128, 1 << 16,
+                                   group="elle")
+    # [tunable] Tile edge of the blocked transitive-closure kernel
+    # (ops/cycles_tiled.py); rounded to a multiple of 128 (the MXU/lane
+    # geometry). Smaller tiles sharpen occupancy skipping on very
+    # sparse closures, larger tiles amortize per-product dispatch.
+    elle_tile: int = _f(256, "tunable", 128, 1024, group="elle")
+    # [tunable] Batch-axis bucket floor of the corpus-of-graphs closure
+    # launches (ops/cycles.py reach_and_cycles_batch): graphs grouped
+    # into padded-size buckets pad their batch axis to {2^k, 1.5*2^k}
+    # buckets from this floor, so corpora of varying graph counts reuse
+    # the same compiled vmapped shapes (the sched bucket discipline
+    # applied to dependency graphs).
+    elle_batch_floor: int = _f(8, "tunable", 1, 128, group="elle")
+    # [tunable] Static capacity (in tile products) of the tiled closure
+    # kernel's gather work list. XLA shapes are static, so each sparse
+    # round pads its eligible (i, k, j) product set to this many
+    # entries; a round whose eligible count exceeds it runs the dense
+    # block sweep for that round instead (never drops reachability).
+    elle_worklist_cap: int = _f(4096, "tunable", 64, 1 << 16)
+    # [tunable] Eligible-product density (percent of nb^3 block
+    # products live) above which a tiled closure round runs the dense
+    # block sweep instead of gather->matmul->scatter — the
+    # direction-optimizing crossover of the wgl3_sparse engine applied
+    # to the closure's block products, taken per round.
+    elle_density_threshold_pct: int = _f(35, "tunable", 1, 100,
+                                         group="elle")
+    # [worker] Padded-cell ceiling (n_pad^2) for one device closure
+    # launch, dense or tiled: past it the f32 reachability matrix
+    # outgrows what a single launch should allocate, and the closure
+    # routes to the exact host Tarjan/SCC oracle instead (same
+    # verdicts, no device allocation). 2^28 cells = 16384^2 = 1 GiB
+    # f32. The floor sits BELOW the smallest padded graph (128^2 =
+    # 2^14) so the oracle route can be force-pinned for certification
+    # (the bench elle lane's "tarjan" arm).
+    elle_cell_budget: int = _f(1 << 28, "worker", 1 << 12, 1 << 34,
+                               conservative="down")
+    # [tunable] Completed txns per incremental dependency-graph
+    # re-check of the streaming elle session (stream/elle.py): smaller
+    # flushes tighten the --fail-fast falsification bound, larger ones
+    # amortize the incremental closure launches.
+    elle_stream_flush: int = _f(64, "tunable", 1, 1 << 16, group="elle")
     # [tunable] Return steps per streamed check chunk (stream/engine.py):
     # the stable-prefix dispatcher accumulates this many stable return
     # steps before feeding one resumable dense chunk to the device.

@@ -12,6 +12,8 @@ See engine.py for the architecture; doc/perf.md "Streaming checks" for
 the watermark rule and knobs.
 """
 
+from .elle import ElleStreamSession
 from .engine import KeyStream, StreamSession, session_for_test
 
-__all__ = ["KeyStream", "StreamSession", "session_for_test"]
+__all__ = ["ElleStreamSession", "KeyStream", "StreamSession",
+           "session_for_test"]

@@ -1,0 +1,283 @@
+"""Streaming elle: incremental dependency graphs against the live run.
+
+The transactional checker used to be strictly post-hoc: the whole txn
+history was recorded, then checkers/elle.py paired it and built the
+ww/wr/rw dependency graph from scratch — so a G1c or G-single anomaly
+produced in the first seconds of a run was not reported until the run's
+time budget expired. This module is the elle face of the ISSUE 5
+streaming engine (ISSUE 11 tentpole layer 3):
+
+  * **Watermark = completion.** Elle inference consumes COMPLETED txns
+    (an open invoke contributes nothing — its eventual edges are
+    unknowable), so a txn becomes stable the moment its completion is
+    recorded, in the recorder's order. History positions are assigned
+    at feed time exactly as the post-hoc pairer assigns them
+    (enumerate over the full record, nemesis rows included), so the
+    realtime edge set is bit-identical.
+  * **Incremental graph.** Completed txns feed the SAME
+    :class:`checkers.elle.ElleGraph` the post-hoc checker uses — per-key
+    derived state (direct anomalies + edge contributions) recomputed
+    for dirty keys only, never the whole history.
+  * **Periodic re-check.** Every ``limits().elle_stream_flush``
+    completed txns (or after an idle interval under ``--fail-fast``
+    eager flush) the grown graph re-checks: direct anomalies are read
+    off the refreshed per-key records, and cycle presence runs through
+    the routed closure engine (ops/cycles.py — diagonal-only fetch,
+    fixpoint early exit, which warm re-checks convert into one or two
+    squaring rounds). Dependency edges only ACCUMULATE as txns
+    complete, so an anomaly found on a prefix is an anomaly of the
+    full history — the fail-fast trigger is sound.
+  * **Finalize = the post-hoc path.** The check phase drains the queue,
+    resolves still-open invokes as :info (exactly `_pair_txns`), and
+    runs ``ElleChecker._check_graph`` on the accumulated graph — the
+    same code over the same state, so streamed and post-hoc results
+    are bit-identical by construction (tests/test_elle_kernels.py pins
+    golden + fuzz histories, valid and anomalous).
+
+Valid streamed verdicts settle in ElleChecker.check via
+``opts["stream_results"]["elle"]``; invalid runs re-check post-hoc so
+witness artifacts are unchanged — the Linearizable settling discipline.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from .. import obs
+from ..ops import cycles
+from ..ops.limits import limits
+from ..ops.op import Op
+
+log = logging.getLogger(__name__)
+
+_DONE = object()
+
+
+class ElleStreamSession:
+    """Run-facing streaming session for the elle txn checkers: a queue +
+    consumer thread feeding completed txns into an incremental
+    ElleGraph, with periodic closure re-checks driving ``--fail-fast``.
+    API-compatible with stream.engine.StreamSession (the runner treats
+    sessions uniformly)."""
+
+    def __init__(self, checker):
+        from ..checkers.elle import ElleGraph
+
+        self.checker = checker
+        self.aborted = False        # set by the runner's fail-fast watcher
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._graph = ElleGraph()
+        self._pending: dict[Any, tuple[int, Op]] = {}
+        self._pos = 0               # history position (the pairer's index)
+        self._since_flush = 0
+        self._txns = 0
+        self._txns_live = 0
+        self._rechecks = 0
+        self._recheck_s = 0.0
+        self._falsified = False
+        self._broken: Optional[str] = None
+        self._results: Optional[dict] = None
+        self._run_live = threading.Event()
+        self._run_live.set()
+        self._done_sent = False
+        self._eager_flush_s: Optional[float] = None
+        self._last_flush = time.monotonic()
+        self._thread = threading.Thread(target=self._consume,
+                                        name="elle-stream-check",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- event-loop side --------------------------------------------------
+    def feed(self, op: Op) -> None:
+        """HistoryRecorder listener: stamp the history position (EVERY
+        recorded op consumes one — the post-hoc pairer enumerates the
+        full history, nemesis rows included, so positions must match),
+        then enqueue."""
+        pos = self._pos
+        self._pos += 1
+        if op.process == "nemesis":
+            return
+        self._q.put((pos, op))
+
+    def finish_input(self) -> None:
+        """The run is over; the consumer exits once the queue drains.
+        Idempotent."""
+        self._run_live.clear()
+        if not self._done_sent:
+            self._done_sent = True
+            self._q.put(_DONE)
+
+    def enable_eager_flush(self, interval_s: float = 0.5) -> None:
+        """Fail-fast mode: re-check the grown graph after ~interval_s of
+        feed idleness even when a full elle_stream_flush batch never
+        accumulates, so a quiet anomalous run still trips the abort."""
+        self._eager_flush_s = float(interval_s)
+
+    def falsified(self) -> bool:
+        """True once an incremental re-check found any anomaly — the
+        --fail-fast trigger (sound: elle edges only accumulate, so a
+        prefix anomaly is a full-history anomaly)."""
+        return self._falsified
+
+    # -- consumer thread --------------------------------------------------
+    def _consume(self) -> None:
+        while True:
+            try:
+                item = self._q.get(timeout=self._eager_flush_s)
+            except queue.Empty:
+                try:
+                    if self._broken is None and self._since_flush \
+                            and time.monotonic() - self._last_flush \
+                            >= (self._eager_flush_s or 0.5):
+                        self._recheck()
+                except Exception as e:
+                    self._broken = f"{type(e).__name__}: {e}"
+                    log.exception("elle stream eager re-check crashed; "
+                                  "falling back to post-hoc")
+                continue
+            if item is _DONE:
+                return
+            if self._broken is not None:
+                continue   # drain cheaply; post-hoc owns the check now
+            pos, op = item
+            try:
+                self._feed_one(pos, op)
+            except Exception as e:
+                # Malformed pairing / non-txn shapes — exactly what the
+                # post-hoc checker will report on the same history; and
+                # an unexplained crash must never kill the thread
+                # silently either way.
+                self._broken = f"{type(e).__name__}: {e}"
+                log.warning("elle streaming check abandoned: %s",
+                            self._broken)
+
+    def _feed_one(self, pos: int, op: Op) -> None:
+        from ..checkers.elle import TxnEncodeError
+
+        if op.f != "txn":
+            raise TxnEncodeError(f"non-txn op {op.f!r} in txn history")
+        if op.type == "invoke":
+            if op.process in self._pending:
+                raise TxnEncodeError(
+                    f"process {op.process} double-invoke")
+            self._pending[op.process] = (pos, op)
+            return
+        if op.type not in ("ok", "fail", "info"):
+            return
+        got = self._pending.pop(op.process, None)
+        if got is None:
+            raise TxnEncodeError(f"completion without invoke: {op}")
+        inv_pos, inv = got
+        self._graph.add_txn(
+            inv.value, op.type,
+            op.value if op.type == "ok" else inv.value, inv_pos, pos)
+        self._txns += 1
+        if self._run_live.is_set():
+            self._txns_live += 1
+        obs.get_metrics().counter("elle.stream_txns").add(1)
+        self._since_flush += 1
+        if self._since_flush >= limits().elle_stream_flush:
+            self._recheck()
+
+    def _recheck(self) -> None:
+        """One incremental falsification probe over the graph-so-far:
+        refreshed direct anomalies, then cycle presence of the full
+        edge set through the routed closure (diagonal-only fetch)."""
+        self._since_flush = 0
+        self._last_flush = time.monotonic()
+        if self._falsified:
+            return             # sticky — the verdict can only stay bad
+        t0 = time.monotonic()
+        g = self._graph
+        bad = any(v for v in g.direct_anomalies().values())
+        if not bad and g.oks:
+            ww, wr, rw = g.edge_matrices()
+            full = ww | wr | rw
+            if self.checker.realtime:
+                rt = g.rt_matrix()
+                if rt is not None:
+                    full = full | rt
+            bad = bool(cycles.cycle_mask(full).any())
+        self._rechecks += 1
+        self._recheck_s += time.monotonic() - t0
+        obs.get_metrics().counter("elle.stream_rechecks").add(1)
+        if bad:
+            self._falsified = True
+            obs.get_tracer().event("stream.falsified", key="elle",
+                                   txns=self._txns)
+
+    # -- check-phase side -------------------------------------------------
+    def finalize(self) -> Optional[dict]:
+        """Join the consumer, resolve still-open invokes as :info, and
+        run the shared finalization path. Returns
+        ``{"elle": result}`` (the opts["stream_results"] shape), or
+        None when the session abandoned streaming. Idempotent."""
+        if self._results is not None:
+            return self._results or None
+        self.finish_input()
+        self._thread.join()
+        results: dict = {}
+        if self._broken is None:
+            try:
+                for inv_pos, inv in self._pending.values():
+                    self._graph.add_txn(inv.value, "info", inv.value,
+                                        inv_pos, -1)
+                self._pending.clear()
+                t0 = time.monotonic()
+                res = self.checker._check_graph(self._graph)
+                self._recheck_s += time.monotonic() - t0
+                res["streamed"] = True
+                results["elle"] = res
+            except Exception:
+                log.exception("elle stream finalize failed; post-hoc "
+                              "takes over")
+                results = {}
+        overlap = self._txns_live / self._txns if self._txns else 0.0
+        obs.get_metrics().gauge("stream.overlap_ratio").set(overlap)
+        self._stats = {
+            "overlap_ratio": round(overlap, 4),
+            "txns": self._txns,
+            "txns_overlapped": self._txns_live,
+            "rechecks": self._rechecks,
+            "recheck_s": round(self._recheck_s, 4),
+            "failfast_aborted": self.aborted,
+        }
+        if self._broken:
+            self._stats["fallback"] = self._broken
+        self._results = results
+        return results or None
+
+    def stats(self) -> dict:
+        """The results.json ``stream`` record (finalize() must have
+        run)."""
+        stats = dict(getattr(self, "_stats", {}))
+        stats["failfast_aborted"] = self.aborted
+        return stats
+
+
+def find_elle_checker(checker):
+    """The first ElleChecker instance in a checker topology (walking
+    nested Compose trees — the runner composes the workload checker
+    under {perf, indep}) — the streamable shape. ElleRwChecker is
+    excluded: the rw-register inference derives version orders
+    globally, so it stays post-hoc. Keyed (IndependentChecker)
+    topologies are not walked — the elle checkers consume whole txn
+    histories, never (key, value) splits."""
+    from ..checkers.compose import Compose
+    from ..checkers.elle import ElleChecker, ElleRwChecker
+
+    if isinstance(checker, ElleChecker) \
+            and not isinstance(checker, ElleRwChecker):
+        return checker
+    if isinstance(checker, Compose):
+        for sub in checker.checkers.values():
+            found = find_elle_checker(sub)
+            if found is not None:
+                return found
+    return None

@@ -594,14 +594,22 @@ class StreamSession:
         return stats
 
 
-def session_for_test(test: dict) -> Optional[StreamSession]:
+def session_for_test(test: dict):
     """Build the streaming session for a composed test, or None when its
-    checker topology is not streamable (no jax Linearizable, or a model
-    whose prepare_history rewrites the history statefully — the stream
-    feeds RAW ops, so only identity-translation models qualify). The
-    caller falls back to post-hoc checking, with zero behavior change."""
+    checker topology is not streamable (no jax Linearizable or elle
+    checker, or a model whose prepare_history rewrites the history
+    statefully — the stream feeds RAW ops, so only identity-translation
+    models qualify). The caller falls back to post-hoc checking, with
+    zero behavior change. Transactional topologies (an ElleChecker in
+    the tree) stream through the incremental dependency-graph session
+    (stream/elle.py) instead of the WGL chunk dispatcher."""
     found = _find_streamable(test.get("checker"))
     if found is None:
+        from .elle import ElleStreamSession, find_elle_checker
+
+        elle = find_elle_checker(test.get("checker"))
+        if elle is not None:
+            return ElleStreamSession(elle)
         return None
     lin, keyed = found
     if type(lin.model).prepare_history is not Model.prepare_history:

@@ -35,6 +35,12 @@ Groups (the `group` metadata on KernelLimits fields, ops/limits.py):
                  `dedup_mode` / `dedup_hash_slots` /
                  `dedup_min_frontier`. Exact in every mode, so the
                  search is free to pick whatever measures fastest.
+  elle         — the elle transitive-closure engine (ops/cycles.py /
+                 ops/cycles_tiled.py / stream/elle.py):
+                 `elle_dense_max_nodes` / `elle_tile` /
+                 `elle_batch_floor` / `elle_density_threshold_pct` /
+                 `elle_stream_flush` on fixed-seed dependency graphs
+                 and a fixed txn stream (every route verdict-exact).
 
 Every measurement is warmup-then-best-of-N: the warmup call eats the
 compile (the persistent XLA cache makes it cheap on re-tunes), the min
@@ -59,6 +65,7 @@ SEED_PIPE = 0x919E
 SEED_PALLAS = 0x9A11
 SEED_STREAM = 0x57E4
 SEED_DEDUP = 0xDED0
+SEED_ELLE = 0xE17E
 
 # Per-knob limit pins applied UNDER the candidate override while probing
 # (e.g. the density threshold only matters once the sparse engine is
@@ -413,6 +420,102 @@ class DedupProbe:
         return _with_overrides(overrides, both, self.ctx.repeats)
 
 
+class ElleProbe:
+    """Elle transitive-closure engine knobs (ops/cycles.py /
+    ops/cycles_tiled.py / stream/elle.py) on fixed-seed fixtures: a
+    corpus of small random dependency graphs (the batched corpus-of-
+    graphs lane), one big BLOCK-STRUCTURED sparse graph (contiguous
+    per-key chains — real empty tiles for the tiled kernel's occupancy
+    work list to skip), and a fixed serial txn stream for the
+    streaming flush cadence. Every route is verdict-exact (the closure
+    fixpoint is unique), so the search picks whatever measures
+    fastest."""
+
+    knobs = ("elle_dense_max_nodes", "elle_tile", "elle_batch_floor",
+             "elle_density_threshold_pct", "elle_stream_flush")
+
+    def __init__(self, ctx: ProbeContext):
+        import numpy as np
+
+        from ..utils.fuzz import append_txn_ops, gen_append_txns
+
+        self.ctx = ctx
+        rng = np.random.default_rng(SEED_ELLE)
+        # Small-graph corpus: the batched bucketed launches.
+        self.small = []
+        for _ in range(max(8, ctx.n(48, 8))):
+            n = int(rng.integers(16, max(32, ctx.n(300, 40))))
+            a = rng.random((n, n)) < 3.0 / n
+            np.fill_diagonal(a, False)
+            self.small.append(a)
+        # One big block-diagonal sparse graph: per-key chains with a
+        # few intra-block cross edges — the tiled kernel's regime.
+        nb = max(600, ctx.n(5000, 600))
+        blk = 100
+        big = np.zeros((nb, nb), bool)
+        for b0 in range(0, nb - 1, blk):
+            hi = min(nb, b0 + blk)
+            for i in range(b0, hi - 1):
+                big[i, i + 1] = True
+            extra = rng.integers(b0, hi, size=(max(2, blk // 8), 2))
+            for s, d in extra:
+                if s < d:
+                    big[s, d] = True
+        self.big = big
+        # Streaming fixture: a fixed serial append-txn op stream.
+        import random as _random
+
+        self.ops = append_txn_ops(gen_append_txns(
+            _random.Random(SEED_ELLE), n_txns=ctx.n(1500, 150),
+            n_keys=8, max_len=2))
+
+    def candidates(self, knob: str) -> list[int] | None:
+        if knob == "elle_tile":
+            return [128, 256, 512]
+        if knob == "elle_dense_max_nodes":
+            # Bracket the big fixture's node count: the dense-vs-
+            # decomposed routing decision is what candidates toggle.
+            n = self.big.shape[0]
+            return sorted({max(128, n // 4), max(128, n // 2), n, 2048})
+        return None
+
+    def measure(self, knob: str, overrides: dict[str, int]) -> float:
+        from ..ops import cycles
+
+        if knob == "elle_stream_flush":
+            from ..checkers.elle import ElleChecker
+            from ..stream.elle import ElleStreamSession
+
+            checker = ElleChecker()
+
+            def replay():
+                session = ElleStreamSession(checker)
+                for op in self.ops:
+                    session.feed(op)
+                res = session.finalize()
+                assert res, "elle stream probe fixture must stream"
+                return res
+
+            return _with_overrides(overrides, replay, self.ctx.repeats)
+        if knob == "elle_batch_floor":
+            return _with_overrides(
+                overrides, lambda: cycles.cycle_masks_batch(self.small),
+                self.ctx.repeats)
+        if knob in ("elle_tile", "elle_density_threshold_pct"):
+            from ..ops import cycles_tiled
+
+            return _with_overrides(
+                overrides,
+                lambda: cycles_tiled.cycle_mask_tiled(self.big),
+                self.ctx.repeats)
+        # elle_dense_max_nodes: the auto route end to end on the big
+        # graph — dense squaring below the crossover, decomposition
+        # above it.
+        return _with_overrides(
+            overrides, lambda: cycles.cycle_mask(self.big),
+            self.ctx.repeats)
+
+
 class ProbeUnavailable(RuntimeError):
     """This probe group cannot run on this backend (recorded as skipped,
     never an error — a CPU tune simply has no pallas lane)."""
@@ -428,4 +531,5 @@ PROBES = {
     "pallas": PallasProbe,
     "stream": StreamProbe,
     "dedup": DedupProbe,
+    "elle": ElleProbe,
 }

@@ -202,6 +202,7 @@ def _perf_summary_html(run_dir) -> str:
             ("live tiles", live)]
     bits += _dedup_bits(run_dir)
     bits += _stream_gauge_bits(run_dir)
+    bits += _elle_bits(run_dir)
     shown = [f"{name}: <b>{html.escape(val)}</b>"
              for name, val in bits if val]
     return f"<p class='a'>{' · '.join(shown)}</p>" if shown else ""
@@ -227,6 +228,32 @@ def _dedup_bits(run_dir) -> list[tuple[str, str]]:
     c = metrics.get("wgl.sparse_overflow_rounds") or {}
     if c.get("type") == "counter" and c.get("value"):
         out.append(("sparse overflow rounds", f"{c['value']:,.0f}"))
+    return out
+
+
+def _elle_bits(run_dir) -> list[tuple[str, str]]:
+    """Elle closure-engine telemetry (ISSUE 11, ops/cycles.py) for the
+    strip: graphs per route (dense / batched / tiled / oracle) and the
+    streamed-session txn count — blank for runs without txn checks."""
+    try:
+        metrics = read_metrics(run_dir / METRICS_FILE)
+    except Exception:
+        return []
+
+    def counter(name: str) -> int:
+        c = metrics.get(name) or {}
+        return int(c.get("value") or 0) if c.get("type") == "counter" \
+            else 0
+
+    routes = [(r, counter(f"elle.graphs_{r}"))
+              for r in ("dense", "batched", "tiled", "oracle")]
+    out: list[tuple[str, str]] = []
+    if any(v for _, v in routes):
+        out.append(("elle graphs",
+                    " / ".join(f"{v} {r}" for r, v in routes if v)))
+    txns = counter("elle.stream_txns")
+    if txns:
+        out.append(("elle streamed txns", f"{txns:,}"))
     return out
 
 

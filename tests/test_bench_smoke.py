@@ -233,3 +233,29 @@ def test_dedup_lane_contract():
     assert lane["unique_configs_per_sec"] > 0
     assert lane["sort_escalations_on"] <= lane["sort_escalations_off"]
     assert lane["sort_f_cap_on"] <= lane["sort_f_cap_off"]
+
+
+def test_elle_lane_contract(tmp_path, monkeypatch):
+    """The bench's elle lane at tiny scale (ISSUE 11): dense/auto/tiled
+    arm walls and the auto-route rates present, route verdicts certified
+    identical inside the lane (dense / batched auto / tiled / streamed /
+    host-Tarjan), oracle pinning redirected to a scratch baseline so the
+    committed 10k pin is untouched."""
+    monkeypatch.setattr(bench, "BASELINE_FILE",
+                        tmp_path / "bench_baseline.json")
+    lane = bench.bench_elle(n_txns=300, n_keys=6, corpus=8,
+                            corpus_txns=24)
+    for key in ("dense_s", "auto_s", "tiled_s", "oracle_s", "infer_s",
+                "events_per_sec", "txns_per_sec", "speedup_vs_dense",
+                "vs_oracle", "graph_nodes", "graph_edges", "corpus",
+                "kernel"):
+        assert key in lane, key
+    json.dumps(lane)
+    assert lane["verdicts_identical"] is True
+    assert lane["corpus"]["mismatches"] == 0
+    assert lane["corpus"]["invalid"] >= 2
+    assert sorted(lane["corpus"]["routes"]) == [
+        "auto", "dense", "streamed", "tarjan", "tiled"]
+    assert lane["txns_per_sec"] > 0
+    # The tiny-scale pin landed in the scratch file, not the repo's.
+    assert (tmp_path / "bench_baseline.json").exists()

@@ -1,0 +1,541 @@
+"""ISSUE 11: the elle closure engine — batched, tiled, streamed.
+
+Differential tests of every closure route (dense squaring, vmapped
+batched, tiled work-list, host Tarjan fallback, streamed incremental)
+against the pure-Python Tarjan/SCC oracle, on golden anomaly histories
+and fuzz corpora, at tile-boundary and bucket-boundary graph sizes,
+plus the fixpoint early exit, the work-list overflow crossover, the
+kernel-LRU bounding satellite, and the pallas blocked-accumulate round
+in interpret mode (and, slow-marked, on a real TPU)."""
+
+from __future__ import annotations
+
+import random
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from jepsen_etcd_demo_tpu import obs
+from jepsen_etcd_demo_tpu.checkers.elle import (ElleChecker, ElleGraph,
+                                                TxnEncodeError,
+                                                tarjan_has_cycle)
+from jepsen_etcd_demo_tpu.ops import cycles, cycles_tiled
+from jepsen_etcd_demo_tpu.ops.cycles import _host_cycle_mask
+from jepsen_etcd_demo_tpu.ops.limits import limits, set_limits
+from jepsen_etcd_demo_tpu.ops.op import Op
+from jepsen_etcd_demo_tpu.stream.elle import ElleStreamSession
+from jepsen_etcd_demo_tpu.utils.fuzz import (append_txn_ops,
+                                             gen_append_txns,
+                                             mutate_append_txns)
+
+# Tile (128) and size-bucket (128 / 192 / 256 ladder) boundaries: the
+# off-by-one shapes padding bugs live at.
+BOUNDARY_SIZES = (2, 3, 127, 128, 129, 191, 192, 193, 255, 256, 257)
+
+
+def rand_graph(rng, n: int, density: float) -> np.ndarray:
+    adj = rng.random((n, n)) < density
+    np.fill_diagonal(adj, False)
+    return adj
+
+
+def with_limits(**overrides):
+    return set_limits(replace(limits(), **overrides))
+
+
+# -- route differentials vs the Tarjan oracle ----------------------------
+
+def test_dense_route_vs_tarjan_boundary_and_fuzz():
+    rng = np.random.default_rng(0xE11E)
+    for n in BOUNDARY_SIZES:
+        adj = rand_graph(rng, n, 2.5 / n)
+        want = _host_cycle_mask(adj)
+        got = cycles.cycle_mask(adj, route="dense")
+        assert (got == want).all(), n
+        assert cycles.has_cycle(adj) == tarjan_has_cycle(adj), n
+    for trial in range(15):
+        n = int(rng.integers(2, 200))
+        adj = rand_graph(rng, n, float(rng.uniform(0.005, 0.1)))
+        assert (cycles.cycle_mask(adj, route="dense")
+                == _host_cycle_mask(adj)).all(), trial
+
+
+def test_tiled_route_bit_identical_to_dense():
+    rng = np.random.default_rng(0x711D)
+    for n in (127, 128, 129, 255, 300):
+        adj = rand_graph(rng, n, 2.0 / n)
+        reach_d, cyc_d = cycles.reach_and_cycles(adj, route="dense")
+        reach_t, cyc_t = cycles_tiled.reach_and_cycles_tiled(adj)
+        assert (cyc_t == cyc_d).all(), n
+        assert (reach_t == reach_d).all(), n
+
+
+def test_tiled_worklist_overflow_forces_dense_rounds_exactly():
+    """A one-product work list overflows immediately: every round runs
+    the dense block sweep, counted in the stats — and the closure stays
+    bit-identical (overflow reroutes, never drops)."""
+    rng = np.random.default_rng(0x0F10)
+    adj = rand_graph(rng, 200, 0.02)
+    want = cycles.cycle_mask(adj, route="dense")
+    prev = with_limits(elle_worklist_cap=64,
+                       elle_density_threshold_pct=1)
+    try:
+        _R, cyc, stats = cycles_tiled.closure_tiled(adj, pallas=False)
+    finally:
+        set_limits(prev)
+    assert (cyc == want).all()
+    assert stats["rounds_dense"] == stats["rounds"] > 0
+    assert stats["rounds_sparse"] == 0
+
+
+def test_tiled_sparse_rounds_engage_on_blocky_graph():
+    """A block-diagonal graph at tile size 128 leaves most tiles empty:
+    the work-list rounds must engage (and match the dense verdict)."""
+    n = 512
+    adj = np.zeros((n, n), bool)
+    for b0 in range(0, n, 128):
+        for i in range(b0, b0 + 127):
+            adj[i, i + 1] = True
+    adj[127, 0] = True   # one in-block cycle
+    prev = with_limits(elle_tile=128, elle_density_threshold_pct=90,
+                       elle_worklist_cap=8192)
+    try:
+        _R, cyc, stats = cycles_tiled.closure_tiled(adj, pallas=False)
+    finally:
+        set_limits(prev)
+    assert stats["rounds_sparse"] > 0
+    assert (cyc == cycles.cycle_mask(adj, route="dense")).all()
+
+
+def test_fixpoint_early_exit_on_shallow_graph():
+    """A depth-2 DAG converges in far fewer rounds than the log2 bound
+    — the early exit is what makes warm incremental re-checks cheap."""
+    n = 600    # log2 bound would be 10 rounds
+    adj = np.zeros((n, n), bool)
+    adj[0, 1:300] = True
+    adj[1:300, 300] = True
+    _R, cyc, stats = cycles_tiled.closure_tiled(adj, pallas=False)
+    assert not cyc.any()
+    assert stats["rounds"] <= 3
+
+
+def test_auto_route_decomposes_and_matches_oracle():
+    """Interleaved per-key chains: the auto route decomposes into weak
+    components (batched below the dense crossover) and must agree with
+    the oracle — including after one chain is closed into a cycle."""
+    n, k = 2000, 20
+    adj = np.zeros((n, n), bool)
+    for key in range(k):
+        idx = np.arange(key, n, k)
+        for a, b in zip(idx, idx[1:]):
+            adj[a, b] = True
+    prev = with_limits(elle_dense_max_nodes=256)
+    try:
+        with obs.capture() as cap:
+            assert not cycles.cycle_mask(adj).any()
+            adj[idx[-1], idx[0]] = True
+            cyc = cycles.cycle_mask(adj)
+        assert (cyc == _host_cycle_mask(adj)).all()
+        stats = obs.elle_stats(cap.metrics)
+        assert stats["graphs_batched"] > 0
+        assert stats["closure_launches"] > 0
+    finally:
+        set_limits(prev)
+
+
+def test_batched_bucket_boundaries_match_dense():
+    rng = np.random.default_rng(0xBA7C)
+    adjs = [rand_graph(rng, n, 2.5 / n) for n in BOUNDARY_SIZES]
+    # Batch-bucket boundary: counts around the {2^k, 1.5*2^k} ladder.
+    masks = cycles.cycle_masks_batch(adjs)
+    both = cycles.reach_and_cycles_batch(adjs)
+    for n, adj, mask, (reach_b, cyc_b) in zip(BOUNDARY_SIZES, adjs,
+                                              masks, both):
+        reach_d, cyc_d = cycles.reach_and_cycles(adj, route="dense")
+        assert (mask == cyc_d).all(), n
+        assert (cyc_b == cyc_d).all(), n
+        assert (reach_b == reach_d).all(), n
+
+
+def test_reach_pairs_matches_full_closure():
+    rng = np.random.default_rng(0x9A13)
+    adj = rand_graph(rng, 150, 0.02)
+    reach, _ = cycles.reach_and_cycles(adj, route="dense")
+    pairs = [(int(rng.integers(150)), int(rng.integers(150)))
+             for _ in range(40)]
+    # Force the decomposed path too (crossover below the graph size).
+    prev = with_limits(elle_dense_max_nodes=128)
+    try:
+        got = cycles.reach_pairs(adj, pairs)
+    finally:
+        set_limits(prev)
+    for (s, d), hit in zip(pairs, got):
+        assert hit == reach[s, d], (s, d)
+
+
+def test_weak_components_partition():
+    adj = np.zeros((7, 7), bool)
+    adj[0, 1] = adj[1, 2] = True      # {0,1,2}
+    adj[4, 3] = True                  # {3,4}
+    comps = cycles.weak_components(adj)
+    assert [c.tolist() for c in comps] == [[0, 1, 2], [3, 4], [5], [6]]
+
+
+def test_oracle_fallback_route_over_cell_budget():
+    rng = np.random.default_rng(0x0CA1)
+    adj = rand_graph(rng, 200, 0.02)
+    want = cycles.cycle_mask(adj, route="dense")
+    prev = with_limits(elle_cell_budget=1 << 14)   # 128^2: nothing fits
+    try:
+        with obs.capture() as cap:
+            got = cycles.cycle_mask(adj)
+        assert obs.elle_stats(cap.metrics)["graphs_oracle"] > 0
+    finally:
+        set_limits(prev)
+    assert (got == want).all()
+
+
+# -- satellites: kernel LRU bounding, diagonal-only probes ----------------
+
+def test_closure_kernel_lru_bounded_with_hit_accounting():
+    """ISSUE 11 satellite: the per-size closure wrappers live in the
+    sched kernel LRU — bounded by kernel_cache_entries, hits counted —
+    instead of an unbounded functools.lru_cache."""
+    from jepsen_etcd_demo_tpu.sched import kernel_cache
+
+    cache = kernel_cache()
+    prev = with_limits(kernel_cache_entries=16)
+    try:
+        h0 = cache.stats()["hits"]
+        adj = np.zeros((10, 10), bool)
+        adj[0, 1] = True
+        cycles.cycle_mask(adj, route="dense")
+        cycles.cycle_mask(adj, route="dense")   # second call: LRU hit
+        assert cache.stats()["hits"] > h0
+        # Eviction happens on INSERT: a fresh padded size (no other
+        # test uses n_pad=1280) forces a miss, which must evict the
+        # shared cache down to the capacity.
+        big = np.zeros((1200, 1200), bool)
+        big[0, 1] = True
+        cycles.cycle_mask(big, route="dense")
+        assert cache.stats()["entries"] <= 16
+    finally:
+        set_limits(prev)
+
+
+def test_has_cycle_agrees_with_reach_slab():
+    """The diagonal-only probe (O(N) fetch) and the packed-slab fetch
+    must answer identically."""
+    rng = np.random.default_rng(0xD1A6)
+    for _ in range(6):
+        n = int(rng.integers(2, 140))
+        adj = rand_graph(rng, n, float(rng.uniform(0.01, 0.08)))
+        _reach, cyc = cycles.reach_and_cycles(adj, route="dense")
+        assert cycles.has_cycle(adj) == bool(cyc.any())
+
+
+# -- checker-level route certification ------------------------------------
+
+def corpus(seed: int, n: int, txns: int, mutate_half=True):
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        t = gen_append_txns(rng, n_txns=txns, n_keys=4, max_len=3)
+        if mutate_half and i % 2:
+            t = mutate_append_txns(rng, t)
+        out.append(append_txn_ops(t))
+    return out
+
+
+ROUTES = {"dense": {"elle_mode": 1}, "auto": {"elle_mode": 0},
+          "tiled": {"elle_mode": 2},
+          "tarjan": {"elle_mode": 0, "elle_cell_budget": 1 << 12}}
+
+
+@pytest.mark.parametrize("realtime", [False, True])
+def test_checker_verdicts_identical_across_routes(realtime):
+    cases = corpus(0xC0DE + realtime, n=10, txns=30)
+    checker = ElleChecker(realtime=realtime)
+    results = {}
+    for name, overrides in ROUTES.items():
+        prev = with_limits(**overrides)
+        try:
+            with obs.capture() as cap:
+                results[name] = [checker.check({}, h) for h in cases]
+            if name == "tarjan":
+                # The oracle route must actually run — a budget floor
+                # above the smallest padded graph would silently re-run
+                # the dense route and certify nothing.
+                stats = obs.elle_stats(cap.metrics)
+                assert stats["graphs_oracle"] > 0, stats
+                assert stats["graphs_dense"] == 0, stats
+        finally:
+            set_limits(prev)
+    ref = results.pop("tarjan")
+    assert any(r["valid"] is False for r in ref), "tame mutation sweep"
+    for name, outs in results.items():
+        assert outs == ref, f"route {name} drifted from the oracle route"
+
+
+def test_checker_small_dense_crossover_boundary():
+    """A graph right at elle_dense_max_nodes takes the dense route; one
+    past it decomposes — same verdicts either side."""
+    h = append_txn_ops(gen_append_txns(random.Random(3), n_txns=40,
+                                       n_keys=3))
+    checker = ElleChecker()
+    want = checker.check({}, h)
+    prev = with_limits(elle_dense_max_nodes=128)  # below the txn count
+    try:
+        got = checker.check({}, h)
+    finally:
+        set_limits(prev)
+    assert got == want
+
+
+# -- streaming ------------------------------------------------------------
+
+@pytest.mark.parametrize("realtime", [False, True])
+def test_stream_bit_identical_to_post_hoc(realtime):
+    checker = ElleChecker(realtime=realtime)
+    for h in corpus(0x57E1 + realtime, n=8, txns=40):
+        post = checker.check({}, h)
+        session = ElleStreamSession(checker)
+        for op in h:
+            session.feed(op)
+        res = session.finalize()
+        assert res is not None
+        streamed = dict(res["elle"])
+        assert streamed.pop("streamed") is True
+        assert streamed == post
+
+
+def test_stream_falsifies_mid_run():
+    """An anomalous prefix trips falsified() before the run ends — the
+    --fail-fast trigger (sound: elle edges only accumulate)."""
+    import time
+
+    rng = random.Random(0xFA57)
+    t = mutate_append_txns(rng, gen_append_txns(rng, n_txns=30,
+                                                n_keys=2, max_len=3))
+    h = append_txn_ops(t)
+    checker = ElleChecker()
+    assert checker.check({}, h)["valid"] is False, "fixture must be bad"
+    prev = with_limits(elle_stream_flush=1)
+    try:
+        session = ElleStreamSession(checker)
+        for op in h:
+            session.feed(op)
+        for _ in range(200):
+            if session.falsified():
+                break
+            time.sleep(0.01)
+        assert session.falsified()
+    finally:
+        set_limits(prev)
+    session.finalize()
+
+
+def test_stream_valid_run_never_falsifies():
+    prev = with_limits(elle_stream_flush=4)
+    try:
+        checker = ElleChecker()
+        session = ElleStreamSession(checker)
+        for op in append_txn_ops(gen_append_txns(random.Random(5),
+                                                 n_txns=60, n_keys=3)):
+            session.feed(op)
+        res = session.finalize()
+    finally:
+        set_limits(prev)
+    assert not session.falsified()
+    assert res["elle"]["valid"] is True
+    assert session.stats()["rechecks"] > 0
+    assert session.stats()["txns"] == 60
+
+
+def test_stream_settles_valid_verdict_in_checker():
+    checker = ElleChecker()
+    h = append_txn_ops(gen_append_txns(random.Random(6), n_txns=30))
+    session = ElleStreamSession(checker)
+    for op in h:
+        session.feed(op)
+    res = session.finalize()
+    settled = checker.check({}, h, {"stream_results": res})
+    assert settled.get("streamed") is True
+    # An invalid streamed result must NOT settle (post-hoc re-runs).
+    bad = {"elle": {"streamed": True, "valid": False,
+                    "realtime": False}}
+    rerun = checker.check({}, h, {"stream_results": bad})
+    assert "streamed" not in rerun and rerun["valid"] is True
+
+
+def test_stream_abandons_on_malformed_history():
+    """A non-txn op abandons the session (finalize None); the post-hoc
+    checker reports the same shape as an error — zero drift."""
+    checker = ElleChecker()
+    session = ElleStreamSession(checker)
+    bad = [Op(type="invoke", f="read", value=None, process=0)]
+    for op in bad:
+        session.feed(op)
+    assert session.finalize() is None
+    with pytest.raises(TxnEncodeError):
+        checker.check({}, bad)
+
+
+def test_stream_still_open_txns_resolve_as_info():
+    """An invoke with no completion must finalize exactly like the
+    post-hoc pairer (pending-forever :info, no fabricated edges)."""
+    checker = ElleChecker()
+    h = append_txn_ops(gen_append_txns(random.Random(8), n_txns=20))
+    h.append(Op(type="invoke", f="txn",
+                value=[("append", "k0", 999)], process=500))
+    post = checker.check({}, h)
+    session = ElleStreamSession(checker)
+    for op in h:
+        session.feed(op)
+    res = session.finalize()
+    streamed = dict(res["elle"])
+    streamed.pop("streamed")
+    assert streamed == post
+
+
+def test_session_for_test_finds_elle_topology():
+    from jepsen_etcd_demo_tpu.checkers.compose import Compose
+    from jepsen_etcd_demo_tpu.checkers.timeline import TimelineChecker
+    from jepsen_etcd_demo_tpu.stream import session_for_test
+
+    test = {"checker": Compose({"elle": ElleChecker(),
+                                "timeline": TimelineChecker()})}
+    session = session_for_test(test)
+    assert isinstance(session, ElleStreamSession)
+    session.finish_input()
+    session.finalize()
+    assert session_for_test({"checker": TimelineChecker()}) is None
+
+
+# -- incremental graph internals ------------------------------------------
+
+def test_elle_graph_incremental_matches_batch_feed():
+    """Feeding txn-by-txn with interleaved refreshes must equal one
+    batch feed — the dirty-key recompute is exact."""
+    from jepsen_etcd_demo_tpu.checkers.elle import _pair_txns
+
+    rng = random.Random(0x16C4)
+    t = mutate_append_txns(rng, gen_append_txns(rng, n_txns=40,
+                                                n_keys=3, max_len=3))
+    txns = _pair_txns(append_txn_ops(t))
+    inc, bat = ElleGraph(), ElleGraph()
+    for i, txn in enumerate(txns):
+        inc.add_txn(*txn)
+        if i % 3 == 0:
+            inc.refresh()           # interleaved refreshes
+            inc.direct_anomalies()
+    for txn in txns:
+        bat.add_txn(*txn)
+    assert inc.direct_anomalies() == bat.direct_anomalies()
+    for a, b in zip(inc.edge_matrices(), bat.edge_matrices()):
+        assert (a == b).all()
+
+
+# -- pallas blocked accumulate --------------------------------------------
+
+def test_pallas_round_interpret_differential():
+    rng = np.random.default_rng(0x9A77)
+    for n in (129, 250):
+        adj = rand_graph(rng, n, 2.0 / n)
+        c_xla = cycles_tiled.cycle_mask_tiled(adj, pallas=False)
+        c_pal = cycles_tiled.cycle_mask_tiled(adj, pallas=True,
+                                              interpret=True)
+        assert (c_xla == c_pal).all(), n
+
+
+@pytest.mark.slow
+def test_pallas_round_tpu_differential():
+    """Real-TPU Mosaic differential of the blocked accumulate round."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        pytest.skip("requires a TPU backend")
+    rng = np.random.default_rng(0x7977)
+    adj = rand_graph(rng, 300, 0.01)
+    c_xla = cycles_tiled.cycle_mask_tiled(adj, pallas=False)
+    c_pal = cycles_tiled.cycle_mask_tiled(adj, pallas=True)
+    assert (c_xla == c_pal).all()
+
+
+# -- telemetry contract ----------------------------------------------------
+
+def test_elle_stats_zeros_never_absent():
+    empty = obs.elle_stats(None)
+    with obs.capture() as cap:
+        quiet = obs.elle_stats(cap.metrics)
+    assert set(empty) == set(quiet)
+    assert all(v == 0 for v in quiet.values())
+    with obs.capture() as cap:
+        ElleChecker().check({}, append_txn_ops(
+            gen_append_txns(random.Random(9), n_txns=20)))
+        stats = obs.elle_stats(cap.metrics)
+    assert stats["graphs_dense"] > 0
+    assert stats["closure_launches"] > 0
+
+
+def test_tune_elle_probe_smoke():
+    from jepsen_etcd_demo_tpu.tune.probes import ElleProbe, ProbeContext
+
+    probe = ElleProbe(ProbeContext(scale=0.02, repeats=1))
+    assert probe.candidates("elle_tile") == [128, 256, 512]
+    s = probe.measure("elle_batch_floor", {"elle_batch_floor": 4})
+    assert s > 0
+
+
+# -- runner integration (stream/elle.py wired end to end) ------------------
+
+def _append_opts(tmp_path, **kw):
+    opts = {"time_limit": 1.2, "rate": 150.0, "store_root": str(tmp_path),
+            "recovery_wait": 0.05, "nemesis_interval": 0.2,
+            "workload": "append", "seed": 11, "no_nemesis": True}
+    opts.update(kw)
+    return opts
+
+
+def test_append_run_streamed_settles_valid(tmp_path):
+    """--check-mode stream on the append workload: the elle session
+    streams the live txns, the valid verdict settles (streamed marker),
+    and the run result carries the stream record."""
+    import asyncio
+
+    from jepsen_etcd_demo_tpu.compose import fake_test
+    from jepsen_etcd_demo_tpu.runner import run_test
+
+    test = fake_test(_append_opts(tmp_path, check_mode="stream"))
+    result = asyncio.run(run_test(test))
+    assert result["valid"] is True
+    assert result["check_mode"] == "stream"
+    assert result["indep"]["elle"].get("streamed") is True
+    assert result["stream"]["txns"] > 10
+    assert result["stream"]["rechecks"] >= 1
+
+
+def test_append_run_streamed_failfast_aborts(tmp_path):
+    """--fail-fast on a run with injected lost appends: the incremental
+    dependency graph falsifies the run far short of the time limit."""
+    import asyncio
+    import time
+
+    from jepsen_etcd_demo_tpu.compose import fake_test
+    from jepsen_etcd_demo_tpu.runner import run_test
+
+    prev = with_limits(elle_stream_flush=8)
+    try:
+        time_limit = 25.0
+        test = fake_test(_append_opts(
+            tmp_path, check_mode="stream", fail_fast=True,
+            lost_write_prob=0.5, time_limit=time_limit, seed=4))
+        t0 = time.monotonic()
+        result = asyncio.run(run_test(test))
+        wall = time.monotonic() - t0
+    finally:
+        set_limits(prev)
+    assert result["valid"] is False
+    assert result["stream"]["failfast_aborted"] is True
+    assert wall < time_limit * 0.6, wall

@@ -60,6 +60,10 @@ LANES: list[tuple[str, tuple]] = [
     ("tuned_tuned_eps", ("detail", "tuned", "tuned_events_per_sec")),
     ("streaming_speedup", ("detail", "streaming", "speedup_total")),
     ("streaming_overlap", ("detail", "streaming", "overlap_ratio")),
+    # Elle lane (ISSUE 11): the auto (tiled/batched) route's rates are
+    # the gated headline.
+    ("elle_txns_eps", ("detail", "elle", "txns_per_sec")),
+    ("elle_events_eps", ("detail", "elle", "events_per_sec")),
 ]
 # Long-history lanes: seconds, LOWER is better — handled via inversion.
 LONG_LANES_PATH = ("detail", "long_history")
@@ -78,6 +82,13 @@ INFO_LANES: list[tuple[str, tuple]] = [
     ("dedup_unique_configs", ("detail", "dedup",
                               "unique_configs_per_sec")),
     ("dedup_ratio", ("detail", "dedup", "frontier_dedup_ratio")),
+    # Elle lane single-shot arms (ISSUE 11): the dense and whole-graph
+    # tiled closures are measured once each (no best-of), and the
+    # speedup is a ratio of two measurements — informational; gating
+    # stays on the auto route's best-of rates above.
+    ("elle_speedup_vs_dense", ("detail", "elle", "speedup_vs_dense")),
+    ("elle_dense_s", ("detail", "elle", "dense_s")),
+    ("elle_tiled_s", ("detail", "elle", "tiled_s")),
 ]
 
 
