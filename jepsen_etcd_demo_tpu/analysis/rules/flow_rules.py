@@ -25,8 +25,17 @@ widening and the PR 7 ``/metrics`` family collision belong to:
   JTL406 contracts-sync          contracts.json stale against the tree
                                  (regenerate-and-diff, the limits-doc
                                  discipline)
+  JTL407 plan-contract           the KernelPlan registry
+                                 (plan/registry.py PLAN_FAMILIES)
+                                 diffed against contracts.json: every
+                                 spec family must resolve to a plan
+                                 entry (module / factory / donation
+                                 set / packed schema / carry / mesh
+                                 axes all matching) and every
+                                 dispatchable family must appear in
+                                 the spec
 
-All six are ProjectRules sharing ONE FlowIndex per lint invocation
+All seven are ProjectRules sharing ONE FlowIndex per lint invocation
 (the engine's ProjectContext); a direct ``check_project(root)`` call
 builds its own, which is how the fixture mini-projects under
 tests/lint_fixtures/flow_*/ are exercised.
@@ -505,3 +514,139 @@ class ContractsSyncRule(FlowRule):
         from ..flow.contracts import CONTRACTS_FILE
 
         return [CONTRACTS_FILE]
+
+
+@register
+class PlanContractRule(FlowRule):
+    id = "JTL407"
+    name = "plan-contract"
+    scopes = None
+    rationale = (
+        "the KernelPlan layer (plan/) was seeded FROM contracts.json; "
+        "a registry family whose module/factory/donation set drifts "
+        "from the spec dispatches a kernel under the wrong contract "
+        "(a donated operand read back, a packed width misread), a spec "
+        "family with no registry entry is a kernel the plan spine "
+        "silently cannot dispatch, and a registry family outside the "
+        "spec is an unreviewed backend — exactly the refactor-drift "
+        "this layer's one-plan-under-every-kernel promise forbids")
+    hint = ("keep plan/registry.py PLAN_FAMILIES in step with "
+            "contracts.json (regenerate with `jepsen-tpu lint "
+            "--write-contracts`, then mirror the kernel's entry); "
+            "declared carries must exist in the contracts `carries` "
+            "section and mesh axes in `meshes`")
+
+    def check_project(self, root: Path, ctx=None) -> list[Finding]:
+        import json
+
+        root = Path(root)
+        contracts_path = root / "contracts.json"
+        if not contracts_path.is_file():
+            return []           # JTL406 owns the missing-spec failure
+        try:
+            contracts = json.loads(
+                contracts_path.read_text(encoding="utf-8"))
+        except ValueError:
+            return []           # JTL406 reports the invalid file
+        facts = self._facts(root, ctx)
+        found = self._find_registry(facts.index)
+        if found is None:
+            if (root / PACKAGE_NAME).is_dir():
+                return [Finding(
+                    rule=self.id, path="contracts.json", line=1,
+                    message=("contracts.json declares kernel families "
+                             "but no module defines a PLAN_FAMILIES "
+                             "registry — the plan layer cannot "
+                             "dispatch any of them"),
+                    hint=self.hint)]
+            return []           # foreign tree / fixture without a plan
+        mod, node, families = found
+        if families is None:
+            return [mod.finding(
+                self, node.lineno,
+                "PLAN_FAMILIES is not a pure literal — JTL407 cannot "
+                "verify the plan registry against contracts.json")]
+        return list(self._diff(mod, node, families, contracts))
+
+    def _find_registry(self, index):
+        """(module, Dict node, {family: (entry, key line)}) of the
+        first PLAN_FAMILIES pure-literal dict in the tree."""
+        from ..flow.facts import _module_consts, contract_modules
+
+        for mod in contract_modules(index):
+            node = _module_consts(mod).get("PLAN_FAMILIES")
+            if not isinstance(node, ast.Dict):
+                continue
+            try:
+                value = ast.literal_eval(node)
+            except (ValueError, TypeError):
+                return (mod, node, None)    # non-literal: flagged below
+            fams = {}
+            for k in node.keys:
+                if isinstance(k, ast.Constant) \
+                        and isinstance(k.value, str):
+                    ent = (value.get(k.value)
+                           if isinstance(value, dict) else None)
+                    fams[k.value] = (ent, k.lineno)
+            return (mod, node, fams)
+        return None
+
+    def _diff(self, mod, node, families, contracts) -> Iterator[Finding]:
+        spec = contracts.get("kernels", {})
+        carries = set(contracts.get("carries", {}))
+        meshes = set(contracts.get("meshes", {}))
+        for fam in sorted(set(spec) - set(families)):
+            yield mod.finding(
+                self, node.lineno,
+                f"kernel family {fam!r} is in contracts.json but has "
+                f"no KernelPlan registry entry — the plan layer "
+                f"cannot dispatch it")
+        for fam in sorted(set(families) - set(spec)):
+            yield mod.finding(
+                self, families[fam][1],
+                f"plan registry dispatches backend {fam!r}, which "
+                f"contracts.json does not declare — dispatch target "
+                f"outside the spec")
+        for fam in sorted(set(spec) & set(families)):
+            ent, line = families[fam]
+            dec = spec[fam]
+            if not isinstance(ent, dict):
+                yield mod.finding(
+                    self, line,
+                    f"plan registry entry {fam!r} is not a pure dict "
+                    f"literal — JTL407 cannot verify it against the "
+                    f"spec")
+                continue
+            for fld in ("module", "factory"):
+                if ent.get(fld) != dec.get(fld):
+                    yield mod.finding(
+                        self, line,
+                        f"{fam}: registry {fld} {ent.get(fld)!r} != "
+                        f"contracts {dec.get(fld)!r}")
+            if sorted(ent.get("donates", [])) != sorted(
+                    dec.get("donates", [])):
+                yield mod.finding(
+                    self, line,
+                    f"{fam}: registry donates "
+                    f"{sorted(ent.get('donates', []))} != contracts "
+                    f"{sorted(dec.get('donates', []))}")
+            if (ent.get("packed") or None) != dec.get("packed"):
+                yield mod.finding(
+                    self, line,
+                    f"{fam}: registry packed {ent.get('packed')!r} != "
+                    f"contracts {dec.get('packed')!r}")
+            if ent.get("carry") and ent["carry"] not in carries:
+                yield mod.finding(
+                    self, line,
+                    f"{fam}: registry carry {ent['carry']!r} is not a "
+                    f"contracts carries entry ({sorted(carries)})")
+            for ax in ent.get("axes", []):
+                if ax not in meshes:
+                    yield mod.finding(
+                        self, line,
+                        f"{fam}: registry mesh axis {ax!r} is not "
+                        f"declared by any mesh construction "
+                        f"(contracts meshes: {sorted(meshes)})")
+
+    def covered_paths(self, root: Path) -> list[str]:
+        return ["contracts.json"]

@@ -153,6 +153,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "/healthz (backend supervisor) plus the normal "
                         "store index on 127.0.0.1:PORT")
     _add_sweep_mode_flag(t)
+    _add_mesh_shape_flag(t)
 
     a = sub.add_parser("analyze", help="re-check a stored history")
     a.add_argument("run_dir", help="store/<name>/<ts> directory")
@@ -167,6 +168,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable the content-addressed encoded-tensor "
                         "cache (re-encode from history.jsonl every time)")
     _add_sweep_mode_flag(a)
+    _add_mesh_shape_flag(a)
 
     c = sub.add_parser(
         "corpus",
@@ -195,6 +197,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="simulate with N virtual CPU devices per process "
                         "(CI / one-machine dryrun)")
     _add_sweep_mode_flag(c)
+    _add_mesh_shape_flag(c)
 
     u = sub.add_parser(
         "tune",
@@ -232,6 +235,17 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--store", default="store")
 
+    pl = sub.add_parser(
+        "plan",
+        help="dump the resolved KernelPlan registry + provenance for a "
+             "kernel family (plan/; doc/perf.md 'KernelPlan & "
+             "pod-scale') — the plan layer's tools/print_profile.py")
+    pl.add_argument("--family", default=None,
+                    help="one kernel family (contracts.json name, e.g. "
+                         "wgl3-chunk); default: every family")
+    pl.add_argument("--print", action="store_true", dest="print_plan",
+                    help="(default — the verb only prints)")
+
     # Stub for --help only: `lint` is intercepted in main() BEFORE this
     # parser runs, so the jtlint path never imports the run/check stack
     # (analysis/ is jax-free and must stay fast — tier-1 runs it).
@@ -248,6 +262,58 @@ def build_parser() -> argparse.ArgumentParser:
 # sparse active-tile engine's dense/sparse routing for the dense lattice
 # kernels (ops/wgl3_sparse.py; doc/perf.md "Sparse sweeps").
 SWEEP_MODES = {"auto": 0, "dense": 1, "sparse": 2}
+
+
+# The env var parallel/mesh.py reads for the default N-D mesh shape
+# (duplicated as a literal here so the CLI layer stays jax-free until a
+# command actually runs; parallel.mesh.MESH_SHAPE_ENV is the authority).
+MESH_SHAPE_ENV = "JEPSEN_TPU_MESH_SHAPE"
+
+# What _apply_mesh_shape displaced (same restore discipline as the
+# sweep-mode flag: no cross-invocation leak, operator exports survive).
+_MESH_ENV_DISPLACED: tuple | None = None
+
+
+def _add_mesh_shape_flag(parser) -> None:
+    parser.add_argument(
+        "--mesh-shape", default=None, metavar="HxC",
+        help="N-D device mesh shape for the sharded lanes, outer axis "
+             "first (e.g. 2x4 = 2 hosts x 4 chips; plain N = 1-D). "
+             "Elastic: more devices requested than visible re-derives "
+             "the largest valid shape instead of failing "
+             "(parallel/mesh.py; doc/perf.md 'KernelPlan & pod-scale')")
+
+
+def _apply_mesh_shape(args) -> None:
+    global _MESH_ENV_DISPLACED
+    import os
+
+    spec = getattr(args, "mesh_shape", None)
+    if spec is None:
+        if _MESH_ENV_DISPLACED is not None:
+            (orig,) = _MESH_ENV_DISPLACED
+            if orig is None:
+                os.environ.pop(MESH_SHAPE_ENV, None)
+            else:
+                os.environ[MESH_SHAPE_ENV] = orig
+            _MESH_ENV_DISPLACED = None
+        return
+    # Grammar check WITHOUT importing parallel.mesh (which imports jax):
+    # the mesh builders re-parse through parse_mesh_shape at use time.
+    parts = spec.lower().split("x")
+    if not parts or not all(pt.isdigit() and int(pt) >= 1 for pt in parts):
+        raise SystemExit(f"error: --mesh-shape {spec!r} is not NxM "
+                         f"positive integers (e.g. 2x4)")
+    if len(parts) > 2:
+        # The sharded lanes build at most 2-D ("host", chips) meshes —
+        # fail here with the flag named, not from inside jax Mesh
+        # construction mid-run.
+        raise SystemExit(f"error: --mesh-shape {spec!r} has "
+                         f"{len(parts)} dimensions; at most 2 (HxC) "
+                         f"are supported")
+    if _MESH_ENV_DISPLACED is None:
+        _MESH_ENV_DISPLACED = (os.environ.get(MESH_SHAPE_ENV),)
+    os.environ[MESH_SHAPE_ENV] = spec
 
 
 def _add_sweep_mode_flag(parser) -> None:
@@ -338,6 +404,7 @@ def _test_opts(args) -> dict:
 def cmd_test(args) -> int:
     enable_compilation_cache(args.store)
     _apply_sweep_mode(args)
+    _apply_mesh_shape(args)
     live_server = None
     if getattr(args, "live_port", None):
         # The live observability plane (web/server.py, ISSUE 8) only
@@ -384,6 +451,7 @@ def cmd_analyze(args) -> int:
 
     enable_compilation_cache()
     _apply_sweep_mode(args)
+    _apply_mesh_shape(args)
     run = RunDir(args.run_dir)
     history = run.read_history()
     try:
@@ -483,6 +551,7 @@ def cmd_corpus(args) -> int:
 
     enable_compilation_cache(args.store_root)
     _apply_sweep_mode(args)
+    _apply_mesh_shape(args)
     # --reencode means "re-encode from source" — it must bypass cache
     # LOOKUPS too (an encoder fix is its stated purpose), while still
     # refreshing the entries for later replays.
@@ -662,6 +731,23 @@ def cmd_tune(args) -> int:
     return 0
 
 
+def cmd_plan(args) -> int:
+    """`jepsen-tpu plan --print`: the resolved plan registry for one
+    (or every) kernel family — backend module/factory, donation set,
+    packed schema, carry, mesh axes, current-platform device counts,
+    and the registry↔contracts sync verdict. Exit 1 when the registry
+    drifted (the same diff JTL407 and the tier-1 sync test report)."""
+    from ..plan import plan_report
+
+    try:
+        report = plan_report(args.family)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+    print(json.dumps(report, indent=2))
+    return 0 if report["sync"] == "ok" else 1
+
+
 def cmd_serve(args) -> int:
     from ..web.server import serve
     serve(args.store, host=args.host, port=args.port)
@@ -722,6 +808,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return cmd_corpus(args)
     if args.command == "tune":
         return cmd_tune(args)
+    if args.command == "plan":
+        return cmd_plan(args)
     if args.command == "serve":
         return cmd_serve(args)
     return 2

@@ -152,6 +152,24 @@ def _batch_closure_fn(n_pad: int, batch: int):
     return _kernel_cache().get((BATCH_KERNEL, n_pad, batch), build)
 
 
+def _planned_closure(n_pad: int):
+    """The single-graph closure, resolved through the KernelPlan layer
+    (plan/dispatch.py — the elle lane's entry onto the one plan spine;
+    the plan key carries the padded size, so bucketed shapes keep their
+    own LRU entries)."""
+    from ..plan import plan_elle_single, resolve
+
+    return resolve(plan_elle_single(n_pad))
+
+
+def _planned_batch_closure(n_pad: int, batch: int):
+    """The vmapped corpus-of-graphs closure, through the plan layer
+    (family elle-closure-batch)."""
+    from ..plan import plan_elle_batch, resolve
+
+    return resolve(plan_elle_batch(n_pad, batch))
+
+
 def _pad_graph(adj: np.ndarray, n_pad: int) -> np.ndarray:
     n = adj.shape[0]
     a = np.zeros((n_pad, n_pad), np.float32)
@@ -196,8 +214,8 @@ def reach_and_cycles(adj: np.ndarray, route: str | None = None
     m = obs.get_metrics()
     m.counter("elle.graphs_dense").add(1)
     m.counter("elle.closure_launches").add(1)
-    packed, _cyc, _rounds = _closure_fn(n_pad)(jnp.asarray(_pad_graph(adj,
-                                                                      n_pad)))
+    packed, _cyc, _rounds = _planned_closure(n_pad)(
+        jnp.asarray(_pad_graph(adj, n_pad)))
     # Single packed fetch: [N, N+1] slab (reach plus the cycle column).
     out = np.asarray(packed)[:n]
     return out[:, :n] > 0.5, out[:, n_pad] > 0.5
@@ -224,7 +242,7 @@ def cycle_mask(adj: np.ndarray, route: str | None = None) -> np.ndarray:
         m = obs.get_metrics()
         m.counter("elle.graphs_dense").add(1)
         m.counter("elle.closure_launches").add(1)
-        _packed, cyc, _rounds = _closure_fn(n_pad)(
+        _packed, cyc, _rounds = _planned_closure(n_pad)(
             jnp.asarray(_pad_graph(adj, n_pad)))
         return np.asarray(cyc)[:n]
     if r == "tiled":
@@ -346,7 +364,7 @@ def cycle_masks_batch(adjs) -> list[np.ndarray]:
         out[i] = _host_cycle_mask(adjs[i])
     adjs = {i: adjs[i] for i in ok}
     for part, n_pad, b, stacked in _batched_launches(adjs):
-        _packed, cyc, _rounds = _batch_closure_fn(n_pad, b)(
+        _packed, cyc, _rounds = _planned_batch_closure(n_pad, b)(
             jnp.asarray(stacked))
         m.counter("elle.graphs_batched").add(len(part))
         m.counter("elle.closure_launches").add(1)
@@ -372,7 +390,7 @@ def reach_and_cycles_batch(adjs) -> list[tuple[np.ndarray, np.ndarray]]:
         out[i] = _host_reach_and_cycles(adjs[i])
     adjs = {i: adjs[i] for i in ok}
     for part, n_pad, b, stacked in _batched_launches(adjs):
-        packed, _cyc, _rounds = _batch_closure_fn(n_pad, b)(
+        packed, _cyc, _rounds = _planned_batch_closure(n_pad, b)(
             jnp.asarray(stacked))
         m.counter("elle.graphs_batched").add(len(part))
         m.counter("elle.closure_launches").add(1)

@@ -496,13 +496,22 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
                 f"(JEPSEN_TPU_LIMIT_SORT_ROW_BUDGET) on a roomier "
                 f"backend so capacity escalations go further per chunk")
 
+    # Chunk kernels resolve through the KernelPlan layer (family
+    # wgl2-chunk; plan/dispatch.py) — the sort ladder's entry onto the
+    # one plan spine. The plan is rebuilt per dispatch because `cfg`
+    # rebinds on every capacity escalation (the resolve is an LRU hit
+    # for every chunk at the same capacity); the canon flag rides the
+    # plan's extra args.
+    from ..plan import plan_resumable
+
     def dispatch(c0: int, pre: _Carry2) -> _Carry2:
+        run = plan_resumable(model, cfg, canon=pairs_dev is not None)
         sl = slice(c0, c0 + chunk)
         idxs = jnp.arange(c0, c0 + chunk, dtype=jnp.int32)
         if pairs_dev is not None:
-            return cached_chunk2(model, cfg, canon=True)(
+            return run.dispatch(
                 pre, tabs[sl], act[sl], tgt[sl], idxs, pairs_dev[sl])
-        return cached_chunk2(model, cfg)(
+        return run.dispatch(
             pre, tabs[sl], act[sl], tgt[sl], idxs)
 
     chunk_starts = list(range(0, padded.targets.shape[0], chunk))

@@ -40,19 +40,37 @@ from ..obs import instrument_kernel
 from ..ops import wgl3
 from ..ops.limits import limits
 from ..ops.wgl3 import DenseConfig
-from .mesh import make_mesh
+from .mesh import (host_count, make_mesh, mesh_key as _mesh_key,
+                   pod_mesh, resolve_axis as _resolve_axis)
 
 _CACHE: dict[tuple, Any] = {}
 
 
 def batch_mesh(n_devices: int | None = None) -> Mesh:
-    """1-axis ("batch",) mesh over all (or the first n) devices."""
+    """The corpus batch-axis mesh. Single host: the 1-axis ("batch",)
+    mesh every existing compiled shape keys on (or an explicit N-D
+    shape via --mesh-shape / JEPSEN_TPU_MESH_SHAPE, axes
+    ("host", "batch")). Multi-host (a pod, jax.process_count() > 1):
+    the process-major ("host", "batch") pod mesh — NamedShardings over
+    BOTH axes partition the corpus across DCN and ICI together
+    (sharding specs name the axis tuple, so the 1-D and 2-D forms
+    share every kernel)."""
+    from .mesh import requested_shape
+
+    if n_devices is None:
+        shape = requested_shape()
+        if shape is not None:
+            if len(shape) > 2:
+                raise ValueError(
+                    f"--mesh-shape {'x'.join(map(str, shape))}: the "
+                    f"batch lane builds at most 2-D ('host', 'batch') "
+                    f"meshes")
+            if len(shape) > 1:
+                return make_mesh(axes=("host", "batch"), shape=shape)
+            return make_mesh(shape[0], axes=("batch",))
+        if host_count() > 1:
+            return pod_mesh(axes=("host", "batch"))
     return make_mesh(n_devices, axes=("batch",))
-
-
-def _mesh_key(mesh: Mesh) -> tuple:
-    return (tuple(mesh.axis_names), tuple(mesh.shape.values()),
-            tuple(d.id for d in mesh.devices.flat))
 
 
 def sharded_batch_checker3_packed(model: Model, cfg: DenseConfig,
@@ -61,7 +79,10 @@ def sharded_batch_checker3_packed(model: Model, cfg: DenseConfig,
     check(slot_tabs[B,R,K,4], slot_active[B,R,K], targets[B,R]) ->
     DEVICE i32[B, 6] (wgl3.PACKED_FIELDS_XLA — the verdict fields plus
     the live-tile occupancy telemetry column), with B partitioned over
-    `axis`. B must be a multiple of the axis size."""
+    `axis` — a name, or a TUPLE of names on an N-D pod mesh
+    (("host", "batch") partitions jointly; default = every mesh axis).
+    B must be a multiple of the total device count."""
+    axis = _resolve_axis(mesh, axis)
     key = ("dense-sharded", model.cache_key(), cfg, _mesh_key(mesh), axis)
     if key not in _CACHE:
         fn = jax.vmap(wgl3._check_one_fn(model, cfg))
@@ -86,10 +107,12 @@ def sharded_batch_checker2(model: Model, cfg2, mesh: Mesh,
     """The SORT kernel (ops/wgl2.py — the non-dense production path:
     queue/multi-register geometries), batch-sharded like the dense
     kernel: jitted check(slot_tabs[B,R,K,4], slot_active[B,R,K],
-    targets[B,R]) -> dict of [B] arrays partitioned over `axis`. B must
-    be a multiple of the axis size."""
+    targets[B,R]) -> dict of [B] arrays partitioned over `axis` (name
+    or tuple; default = every mesh axis). B must be a multiple of the
+    total device count."""
     from ..ops import wgl2
 
+    axis = _resolve_axis(mesh, axis)
     key = ("sort-sharded", model.cache_key(), cfg2, _mesh_key(mesh), axis)
     if key not in _CACHE:
         fn = jax.vmap(wgl2._check_one_fn(model, cfg2))
@@ -119,6 +142,7 @@ def sharded_batch_checker_pallas(model: Model, cfg: DenseConfig, mesh: Mesh,
     make_batch_checker_pallas)."""
     from ..ops import wgl3_pallas
 
+    axis = _resolve_axis(mesh, axis)
     key = ("pallas-sharded", model.cache_key(), cfg, _mesh_key(mesh), axis,
            interpret, group)
     if key in _CACHE:
@@ -142,7 +166,7 @@ def sharded_batch_checker_pallas(model: Model, cfg: DenseConfig, mesh: Mesh,
     else:
         launcher = wgl3_pallas.cached_pallas_launcher(model, cfg,
                                                       interpret=interpret)
-    d = mesh.shape[axis]
+    d = _axis_size(mesh, axis)
 
     @functools.lru_cache(maxsize=None)
     def sharded_launch(b_loc: int, r: int):
@@ -170,6 +194,17 @@ def sharded_batch_checker_pallas(model: Model, cfg: DenseConfig, mesh: Mesh,
     return check
 
 
+def _axis_size(mesh: Mesh, axis) -> int:
+    """Device count along `axis` — a name, or a tuple of names (the
+    N-D pod form: the product across every named axis)."""
+    if isinstance(axis, tuple):
+        d = 1
+        for a in axis:
+            d *= mesh.shape[a]
+        return d
+    return mesh.shape[axis]
+
+
 def batch_multiple(model: Model, cfg: DenseConfig, mesh: Mesh,
                    n_steps: int | None = None,
                    batch: int | None = None,
@@ -179,7 +214,8 @@ def batch_multiple(model: Model, cfg: DenseConfig, mesh: Mesh,
     (each device's shard must split into whole groups)."""
     from ..ops import wgl3_pallas
 
-    d = mesh.shape[axis]
+    axis = _resolve_axis(mesh, axis)
+    d = _axis_size(mesh, axis)
     sp = max(8, (cfg.n_states + 7) // 8 * 8)
     G = limits().pallas_group
     local_batch = None if batch is None else (batch + d - 1) // d
@@ -192,33 +228,17 @@ def batch_multiple(model: Model, cfg: DenseConfig, mesh: Mesh,
 
 def sharded_packed_batch_checker(model: Model, cfg: DenseConfig, mesh: Mesh,
                                  n_steps: int | None = None,
-                                 batch: int | None = None,
-                                 axis: str = "batch"):
-    """Mesh-sharded twin of wgl3_pallas.packed_batch_checker — THE routing
-    point for multi-device dense launches: (packed_check_fn, kernel_name).
-    Routes to the pallas shard_map form on a live TPU backend when the
-    PER-DEVICE shard fits the pallas envelope — grouped per shard under
-    the same conditions as the single-device router — else the sharded
-    XLA kernel. `batch` must already be padded to batch_multiple()."""
-    from ..ops import wgl3_pallas
+                                 batch: int | None = None):
+    """Mesh-sharded dense routing, now a shim over the KernelPlan layer
+    (plan/dispatch.py plan_dense_batch — the one copy of the
+    per-device-envelope pallas-vs-XLA/grouped policy): returns
+    (packed_check_fn, kernel_name). `batch` must already be padded to
+    batch_multiple()."""
+    from ..plan import plan_dense_batch, resolve
 
-    if n_steps is not None and n_steps > limits().long_scan_max:
-        raise ValueError(
-            f"n_steps={n_steps} exceeds one scan program; chunk host-side")
-    d = mesh.shape[axis]
-    local_batch = None if batch is None else (batch + d - 1) // d
-    if wgl3_pallas.use_pallas(cfg, n_steps, local_batch):
-        G = limits().pallas_group
-        sp = max(8, (cfg.n_states + 7) // 8 * 8)
-        if (sp == 8 and G > 1 and local_batch is not None
-                and local_batch >= G and local_batch % G == 0):
-            return (sharded_batch_checker_pallas(model, cfg, mesh, axis,
-                                                 group=G),
-                    "wgl3-dense-pallas-grouped-sharded")
-        return (sharded_batch_checker_pallas(model, cfg, mesh, axis),
-                "wgl3-dense-pallas-sharded")
-    return (sharded_batch_checker3_packed(model, cfg, mesh, axis),
-            "wgl3-dense-sharded")
+    p = plan_dense_batch(model, cfg, n_steps=n_steps, batch=batch,
+                         mesh=mesh)
+    return resolve(p), p.label
 
 
 def pad_batch_arrays(arrays, multiple: int):

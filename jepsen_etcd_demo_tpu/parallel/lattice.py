@@ -58,18 +58,34 @@ from ..ops import wgl3
 from ..ops.encode import ReturnSteps
 from ..ops.limits import limits
 from ..ops.wgl3 import DenseConfig, _LO_MASK
-from .mesh import make_mesh
+from .mesh import (host_count, make_mesh, mesh_key as _mesh_key,
+                   mesh_total, pod_mesh, requested_shape,
+                   resolve_axis as _resolve_axis)
 
 _CACHE: dict[tuple, Any] = {}
 
 
 def lattice_mesh(n_devices: int | None = None) -> Mesh:
+    """The table-word-axis mesh. Single host: the 1-axis ("lattice",)
+    mesh (or an explicit N-D shape via --mesh-shape, axes
+    ("host", "lattice")). Multi-host: the process-major
+    ("host", "lattice") pod mesh — the sweep's collectives name the
+    axis TUPLE, so the word axis shards (and psum/pmax/ppermute
+    all-reduce) across DCN and ICI jointly."""
+    if n_devices is None:
+        shape = requested_shape()
+        if shape is not None:
+            if len(shape) > 2:
+                raise ValueError(
+                    f"--mesh-shape {'x'.join(map(str, shape))}: the "
+                    f"lattice lane builds at most 2-D "
+                    f"('host', 'lattice') meshes")
+            if len(shape) > 1:
+                return make_mesh(axes=("host", "lattice"), shape=shape)
+            return make_mesh(shape[0], axes=("lattice",))
+        if host_count() > 1:
+            return pod_mesh(axes=("host", "lattice"))
     return make_mesh(n_devices, axes=("lattice",))
-
-
-def _mesh_key(mesh: Mesh) -> tuple:
-    return (tuple(mesh.axis_names), tuple(mesh.shape.values()),
-            tuple(d.id for d in mesh.devices.flat))
 
 
 def lattice_dense_config(model: Model, k_slots: int, max_value: int,
@@ -469,8 +485,12 @@ def make_lattice_chunk_fn(model: Model, cfg: DenseConfig, mesh: Mesh,
     — the sharded twin of wgl3._chunk_fn. The table stays a
     mesh-sharded jax.Array between host-loop chunks; the tiling rides
     along so the caller's sweep_summary denominator is EXACTLY the
-    tiling the kernel swept."""
-    d = mesh.shape[axis]
+    tiling the kernel swept. `axis` may be a tuple of mesh axis names
+    (the N-D pod mesh: the word axis shards over the product, and
+    every collective in the step reduces across both axes); default =
+    every axis of `mesh`."""
+    axis = _resolve_axis(mesh, axis)
+    d = mesh_total(mesh)
     step, w_loc, tiling = _build_local_step(
         model, cfg, axis, d, plan=plan, canon=canon,
         min_frontier=min_frontier, memo_slots=memo_slots)
@@ -523,6 +543,7 @@ def cached_lattice_chunk(model: Model, cfg: DenseConfig, mesh: Mesh,
                          axis: str = "lattice", plan=None,
                          canon: bool = False, min_frontier: int = 0,
                          memo_slots: int = 0):
+    axis = _resolve_axis(mesh, axis)
     key = ("lattice-chunk", model.cache_key(), cfg, _mesh_key(mesh), axis,
            plan, canon, min_frontier, memo_slots)
     if key not in _CACHE:

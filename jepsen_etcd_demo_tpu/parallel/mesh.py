@@ -1,33 +1,142 @@
-"""Mesh construction helpers."""
+"""Mesh construction — N-D, multi-host-aware, and ELASTIC.
+
+Every sharded lane (parallel/dense.py batch axis, parallel/lattice.py
+word axis, parallel/multislice.py DCN corpus axis) builds its mesh
+here. Three properties this module owns (ROADMAP item 3, SNIPPETS.md
+[2]/[3] — ``shard_map`` + ``NamedSharding`` over an N-D
+``(hosts, chips)`` mesh):
+
+  * **N-D**: ``make_mesh`` accepts any axis tuple and shape —
+    ``make_mesh(axes=("host", "lattice"), shape=(hosts, chips))`` is
+    the pod form; the single-host 1-axis meshes the existing kernels
+    compile are the degenerate case, so their compiled shapes are
+    byte-identical to the pre-pod build.
+  * **Multi-host**: ``pod_mesh`` lays ALL global devices out
+    process-major, so the outer axis is exactly the one that crosses
+    DCN (the multislice_mesh convention generalized); collectives may
+    name a TUPLE of axes (``("host", "lattice")``) and reduce across
+    both — jax flattens the product row-major, matching the layout.
+  * **Elastic**: a request for more devices than the platform has is
+    NOT an error by default — the shape is re-derived to the largest
+    valid mesh that fits (and the downgrade logged), so a plan
+    written for 16 chips re-buckets on an 8-chip host instead of
+    crashing. Compiled-shape safety is the caller's key discipline:
+    every kernel-LRU / tuned-profile key carries ``mesh_key(mesh)``
+    (axes + shape + device ids), so a re-shard can only MISS a cache,
+    never serve a stale compiled launch (plan/dispatch.py,
+    tests/test_plan_elastic.py). ``strict=True`` restores the old
+    raise for callers that pinned a count deliberately
+    (tests / certification dryruns).
+"""
 
 from __future__ import annotations
 
+import logging
+import os
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
+log = logging.getLogger(__name__)
+
+# Env override for the default N-D mesh shape ("HxC", e.g. "2x4") — the
+# CLI's --mesh-shape flag rides this so subprocesses inherit it.
+MESH_SHAPE_ENV = "JEPSEN_TPU_MESH_SHAPE"
+
 
 def device_count() -> int:
     return len(jax.devices())
 
 
+def host_count() -> int:
+    """JAX processes in the distributed system (1 = single host)."""
+    return jax.process_count()
+
+
+def largest_pow2(n: int) -> int:
+    """Largest power of two <= n (>=1)."""
+    return 1 << (max(1, n).bit_length() - 1)
+
+
+def parse_mesh_shape(spec: str) -> tuple[int, ...]:
+    """"2x4" / "8" -> (2, 4) / (8,). The CLI flag grammar."""
+    try:
+        shape = tuple(int(p) for p in spec.lower().split("x"))
+    except ValueError:
+        raise ValueError(
+            f"mesh shape {spec!r} is not NxM integers (e.g. 2x4)") from None
+    if not shape or any(s < 1 for s in shape):
+        raise ValueError(f"mesh shape {spec!r} must be positive integers")
+    return shape
+
+
+def requested_shape() -> Optional[tuple[int, ...]]:
+    """The operator-requested default mesh shape (CLI --mesh-shape via
+    the env override), or None. Parsed on every call — the flag applies
+    per invocation, never cached across them."""
+    spec = os.environ.get(MESH_SHAPE_ENV)
+    return parse_mesh_shape(spec) if spec else None
+
+
+def elastic_shape(shape: Sequence[int], have: int) -> tuple[int, ...]:
+    """The largest valid mesh shape <= `shape` that fits on `have`
+    devices, shrinking OUTER axes first (the host/corpus axes — inner
+    axes are the collective-heavy ICI ones whose width the kernels
+    keyed their geometry on). Every axis stays >= 1; the result's
+    product always fits within `have`."""
+    shape = [int(s) for s in shape]
+    for i in range(len(shape)):
+        rest = int(np.prod(shape[i + 1:])) if i + 1 < len(shape) else 1
+        if rest > have:
+            shape[i] = 1
+            continue
+        shape[i] = max(1, min(shape[i], have // rest))
+    return tuple(shape)
+
+
 def make_mesh(n_devices: Optional[int] = None,
               axes: Sequence[str] = ("batch",),
-              shape: Optional[Sequence[int]] = None) -> Mesh:
-    """Mesh over the first n devices. 1-axis by default ("batch"); pass
-    axes=("batch", "frontier") with a shape to split ICI between the corpus
-    axis and the frontier axis."""
+              shape: Optional[Sequence[int]] = None,
+              strict: bool = False) -> Mesh:
+    """Mesh over the visible devices — N-D when `axes`/`shape` say so,
+    ELASTIC by default: a request exceeding the platform re-derives the
+    largest valid shape and logs the downgrade instead of raising.
+    ``strict=True`` restores the historical hard failure (callers that
+    pinned a device count deliberately — certification dryruns, tests).
+
+    With neither `n_devices` nor `shape`, the mesh is 1-D over every
+    device on the first axis (trailing axes size 1) — exactly the
+    pre-pod behavior every existing compiled shape keys on."""
     all_devs = jax.devices()
-    want = n_devices or len(all_devs)
-    if want > len(all_devs):
-        raise ValueError(
-            f"make_mesh: need {want} devices, have {len(all_devs)} "
-            f"({all_devs[0].platform}). Hint: force a virtual CPU mesh "
-            f"before any backend init — JAX_PLATFORMS=cpu plus "
-            f"jax.config.update('jax_num_cpu_devices', {want}) (see "
-            f"tests/conftest.py / __graft_entry__.dryrun_multichip).")
+    if jax.process_count() > 1:
+        # Multi-host: process-major order, like pod_mesh — the outer
+        # axis of an explicit N-D shape must be the one that crosses
+        # DCN, or the tuple-axis collective flattening argument (and
+        # the ICI-only premise of the inner axes) breaks.
+        all_devs = sorted(all_devs, key=lambda d: (d.process_index, d.id))
+    have = len(all_devs)
+    want = n_devices if n_devices is not None else (
+        int(np.prod(shape)) if shape is not None else have)
+    if want > have:
+        if strict:
+            raise ValueError(
+                f"make_mesh: need {want} devices, have {have} "
+                f"({all_devs[0].platform}). Hint: force a virtual CPU mesh "
+                f"before any backend init — JAX_PLATFORMS=cpu plus "
+                f"jax.config.update('jax_num_cpu_devices', {want}) (see "
+                f"tests/conftest.py / __graft_entry__.dryrun_multichip).")
+        if shape is not None:
+            shape = elastic_shape(shape, have)
+        log.warning(
+            "make_mesh: %d device(s) requested but only %d visible — "
+            "re-deriving the largest valid mesh (%s over %s); pass "
+            "strict=True to fail instead", want, have, tuple(axes),
+            tuple(shape) if shape is not None else (have,))
+        want = min(want, have)
+        if shape is not None:
+            want = int(np.prod(shape))
     devs = all_devs[:want]
     if shape is None:
         shape = [len(devs)] + [1] * (len(axes) - 1)
@@ -37,3 +146,51 @@ def make_mesh(n_devices: Optional[int] = None,
             f"devices but {len(devs)} were selected")
     arr = np.array(devs).reshape(tuple(shape))
     return Mesh(arr, tuple(axes))
+
+
+# jtflow: mesh-axes host
+def pod_mesh(axes: Sequence[str] = ("host", "batch"),
+             local_shape: Optional[Sequence[int]] = None) -> Mesh:
+    """N-D multi-host mesh: ALL global devices laid out process-major,
+    outer axis = the hosts (the DCN axis), inner axes = each host's
+    chips over ICI. On a single process this is a (1, chips) mesh —
+    callers that key compiled shapes on the 1-D single-host form should
+    route through their existing 1-D helper when host_count() == 1.
+
+    `local_shape` splits the per-host chips over the trailing axes
+    (len(axes) - 1 of them); default = all chips on the first inner
+    axis."""
+    devs = jax.devices()
+    n_proc = jax.process_count()
+    per = len(devs) // n_proc
+    order = sorted(devs, key=lambda d: (d.process_index, d.id))
+    if local_shape is None:
+        local_shape = [per] + [1] * (len(axes) - 2)
+    arr = np.array(order).reshape((n_proc, *local_shape))
+    return Mesh(arr, tuple(axes))
+
+
+def mesh_key(mesh: Mesh) -> tuple:
+    """The cache-key identity of a mesh: axis names + shape + device
+    ids. EVERY kernel-LRU / tuned-profile key that resolves a compiled
+    launch for a sharded kernel must include this — it is what makes a
+    re-shard (device count changed between runs) a cache MISS instead
+    of a stale compiled launch (doc/perf.md "KernelPlan & pod-scale")."""
+    return (tuple(mesh.axis_names), tuple(mesh.shape.values()),
+            tuple(d.id for d in mesh.devices.flat))
+
+
+def mesh_total(mesh: Mesh) -> int:
+    """Total device count of a mesh (the product over every axis)."""
+    return int(np.prod(list(mesh.shape.values())))
+
+
+def resolve_axis(mesh: Mesh, axis):
+    """Auto-upgrade a 1-D string axis default to the full axis tuple on
+    an N-D pod mesh: a bare "batch"/"lattice" on a ("host", ...) mesh
+    would shard over one axis and silently replicate the other. ONE
+    copy, shared by parallel/dense.py and parallel/lattice.py (their
+    sharding specs and collectives name whatever this returns)."""
+    if isinstance(axis, str) and len(mesh.axis_names) > 1:
+        return tuple(mesh.axis_names)
+    return axis

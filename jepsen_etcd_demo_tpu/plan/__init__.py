@@ -1,0 +1,18 @@
+"""KernelPlan — one plan/dispatch spine under every kernel.
+
+``plan.registry`` declares the family table (verified against
+contracts.json by jtflow JTL407 + the tier-1 sync test),
+``plan.core`` the KernelPlan runtime object, ``plan.dispatch`` the
+routing planners and the resolve/dispatch choke point. See
+doc/perf.md "KernelPlan & pod-scale".
+"""
+
+from .core import (CONTRACTS_FILE, KernelPlan, MeshSpec,  # noqa: F401
+                   PlanContractError, build_plan, check_registry,
+                   load_contracts, plan_report, verify_registry)
+from .dispatch import (dispatch, dispatch_long,  # noqa: F401
+                       launch_multiple, plan_dense_batch, plan_elle_batch,
+                       plan_elle_single, plan_long_sweep, plan_resumable,
+                       plan_stream_chunk, resolve)
+from .registry import (PLAN_FAMILIES, backend_callable,  # noqa: F401
+                       family_entry)

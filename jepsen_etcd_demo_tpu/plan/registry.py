@@ -1,0 +1,295 @@
+"""The KernelPlan family registry — the plan layer's half of the
+contract that ``contracts.json`` (analysis/flow/contracts.py) is the
+other half of.
+
+``PLAN_FAMILIES`` is a PURE LITERAL, deliberately: jtflow's JTL407
+(analysis/rules/flow_rules.py) parses it straight out of the AST and
+diffs it against the checked-in ``contracts.json`` — every kernel
+family the spec declares must resolve to a registry entry here (same
+module, factory, donation set, packed schema, carry, mesh axes), and
+every family this layer can dispatch must appear in the spec. The
+runtime twin (``plan.core.verify_registry``) runs the same diff from
+the tier-1 sync test, so the plan layer cannot silently drift from the
+contract it was seeded from in either representation.
+
+Entry fields (per family, keyed by the kernel's ``instrument_kernel``
+name):
+
+  module   repo-relative path of the backend module (== contracts)
+  factory  the factory function contracts.json records  (== contracts)
+  donates  donated operand positions                    (== contracts)
+  packed   packed-result schema ref or None             (== contracts)
+  carry    resumable-carry NamedTuple name or None (must exist in the
+           contracts ``carries`` section when set)
+  axes     mesh axis names the kernel shards over (every name must be
+           declared in the contracts ``meshes`` section)
+  role     how dispatch drives it: "launch" (call with stacked
+           arrays), "chunk" (host-loop resumable chunk fn), "prep"/
+           "transitions" (internal half of a two-stage launch),
+           "launcher" (shape-parameterized pallas launcher)
+  entry    attribute dispatch resolves when it differs from `factory`
+           (e.g. the packed form of a dict-result factory); not part
+           of the contracts diff
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+# jtflow directives quoted here are prose, not annotations (comments
+# only bind from real comment tokens — analysis/flow/facts.py).
+
+PLAN_FAMILIES = {
+    "elle-closure": {
+        "module": "jepsen_etcd_demo_tpu/ops/cycles.py",
+        "factory": "_closure_fn",
+        "donates": [],
+        "packed": None,
+        "carry": None,
+        "axes": [],
+        "role": "launch",
+    },
+    "elle-closure-batch": {
+        "module": "jepsen_etcd_demo_tpu/ops/cycles.py",
+        "factory": "_batch_closure_fn",
+        "donates": [],
+        "packed": None,
+        "carry": None,
+        "axes": [],
+        "role": "launch",
+    },
+    "elle-closure-tiled": {
+        "module": "jepsen_etcd_demo_tpu/ops/cycles_tiled.py",
+        "factory": "_occ_fn",
+        "donates": [0],
+        "packed": None,
+        "carry": None,
+        "axes": [],
+        "role": "launch",
+    },
+    "elle-closure-tiled-pallas": {
+        "module": "jepsen_etcd_demo_tpu/ops/cycles_tiled.py",
+        "factory": "_sparse_round_fn",
+        "donates": [0],
+        "packed": None,
+        "carry": None,
+        "axes": [],
+        "role": "launch",
+    },
+    "lattice-transitions": {
+        "module": "jepsen_etcd_demo_tpu/parallel/lattice.py",
+        "factory": "_transitions_fn",
+        "donates": [],
+        "packed": None,
+        "carry": None,
+        "axes": [],
+        "role": "transitions",
+    },
+    "wgl2-batch": {
+        "module": "jepsen_etcd_demo_tpu/ops/wgl2.py",
+        "factory": "cached_batch_checker2",
+        "donates": [],
+        "packed": None,
+        "carry": None,
+        "axes": [],
+        "role": "launch",
+    },
+    "wgl2-chunk": {
+        "module": "jepsen_etcd_demo_tpu/ops/wgl2.py",
+        "factory": "cached_chunk2",
+        "donates": [],
+        "packed": None,
+        "carry": "_Carry2",
+        "axes": [],
+        "role": "chunk",
+    },
+    "wgl2-single": {
+        "module": "jepsen_etcd_demo_tpu/ops/wgl2.py",
+        "factory": "cached_checker2",
+        "donates": [],
+        "packed": None,
+        "carry": None,
+        "axes": [],
+        "role": "launch",
+    },
+    "wgl2-sort-sharded": {
+        "module": "jepsen_etcd_demo_tpu/parallel/dense.py",
+        "factory": "sharded_batch_checker2",
+        "donates": [],
+        "packed": None,
+        "carry": None,
+        "axes": ["batch"],
+        "role": "launch",
+    },
+    "wgl3-batch": {
+        "module": "jepsen_etcd_demo_tpu/ops/wgl3.py",
+        "factory": "cached_batch_checker3",
+        "donates": [],
+        "packed": "wgl3.PACKED_FIELDS_XLA",
+        "carry": None,
+        "axes": [],
+        "role": "launch",
+        "entry": "cached_batch_checker3_packed",
+    },
+    "wgl3-chunk": {
+        "module": "jepsen_etcd_demo_tpu/ops/wgl3.py",
+        "factory": "_cached_chunk_run",
+        "donates": [0],
+        "packed": None,
+        "carry": "_Carry3",
+        "axes": [],
+        "role": "chunk",
+    },
+    "wgl3-chunk-dedup": {
+        "module": "jepsen_etcd_demo_tpu/ops/wgl3.py",
+        "factory": "_cached_chunk_run_dedup",
+        "donates": [0],
+        "packed": None,
+        "carry": "_Carry3",
+        "axes": [],
+        "role": "chunk",
+    },
+    "wgl3-dense-multislice": {
+        "module": "jepsen_etcd_demo_tpu/parallel/multislice.py",
+        "factory": "_sharded_batch_checker",
+        "donates": [],
+        "packed": None,
+        "carry": None,
+        "axes": ["slice", "batch"],
+        "role": "launch",
+    },
+    "wgl3-dense-sharded": {
+        "module": "jepsen_etcd_demo_tpu/parallel/dense.py",
+        "factory": "sharded_batch_checker3_packed",
+        "donates": [],
+        "packed": "wgl3.PACKED_FIELDS_XLA",
+        "carry": None,
+        "axes": ["batch"],
+        "role": "launch",
+    },
+    "wgl3-lattice-chunk": {
+        "module": "jepsen_etcd_demo_tpu/parallel/lattice.py",
+        "factory": "make_lattice_chunk_fn",
+        "donates": [],
+        "packed": None,
+        "carry": None,
+        "axes": ["lattice"],
+        "role": "chunk",
+        "entry": "cached_lattice_chunk",
+    },
+    "wgl3-pallas": {
+        "module": "jepsen_etcd_demo_tpu/ops/wgl3_pallas.py",
+        "factory": "local_pallas_launcher",
+        "donates": [],
+        "packed": "wgl3.PACKED_FIELDS",
+        "carry": None,
+        "axes": [],
+        "role": "launcher",
+        "entry": "cached_batch_checker_pallas",
+    },
+    "wgl3-pallas-grouped": {
+        "module": "jepsen_etcd_demo_tpu/ops/wgl3_pallas.py",
+        "factory": "local_pallas_launcher_grouped",
+        "donates": [],
+        "packed": "wgl3.PACKED_FIELDS",
+        "carry": None,
+        "axes": [],
+        "role": "launcher",
+        "entry": "cached_batch_checker_pallas_grouped",
+    },
+    "wgl3-pallas-prep": {
+        "module": "jepsen_etcd_demo_tpu/ops/wgl3_pallas.py",
+        "factory": "_cached_prep",
+        "donates": [],
+        "packed": None,
+        "carry": None,
+        "axes": [],
+        "role": "prep",
+    },
+    "wgl3-pallas-resumable": {
+        "module": "jepsen_etcd_demo_tpu/ops/wgl3_pallas.py",
+        "factory": "local_pallas_launcher_resumable",
+        "donates": [1, 4],
+        "packed": None,
+        "carry": None,
+        "axes": [],
+        "role": "launcher",
+        "entry": "_cached_resumable_launcher",
+    },
+    "wgl3-pallas-sharded": {
+        "module": "jepsen_etcd_demo_tpu/parallel/dense.py",
+        "factory": "sharded_batch_checker_pallas",
+        "donates": [],
+        "packed": None,
+        "carry": None,
+        "axes": ["batch"],
+        "role": "launch",
+    },
+    "wgl3-pallas-sharded-prep": {
+        "module": "jepsen_etcd_demo_tpu/parallel/dense.py",
+        "factory": "sharded_batch_checker_pallas",
+        "donates": [],
+        "packed": None,
+        "carry": None,
+        "axes": ["batch"],
+        "role": "prep",
+    },
+    "wgl3-pallas-sparse-resumable": {
+        "module": "jepsen_etcd_demo_tpu/ops/wgl3_pallas.py",
+        "factory": "local_pallas_launcher_sparse_resumable",
+        "donates": [1, 4],
+        "packed": None,
+        "carry": None,
+        "axes": [],
+        "role": "launcher",
+        "entry": "_cached_sparse_resumable_launcher",
+    },
+    "wgl3-single": {
+        "module": "jepsen_etcd_demo_tpu/ops/wgl3.py",
+        "factory": "cached_checker3_packed",
+        "donates": [],
+        "packed": "wgl3.PACKED_FIELDS_XLA",
+        "carry": None,
+        "axes": [],
+        "role": "launch",
+    },
+    "wgl3-sparse-chunk": {
+        "module": "jepsen_etcd_demo_tpu/ops/wgl3_sparse.py",
+        "factory": "_cached_sparse_chunk",
+        "donates": [0],
+        "packed": None,
+        "carry": "_Carry3",
+        "axes": [],
+        "role": "chunk",
+    },
+    "wgl3-sparse-chunk-dedup": {
+        "module": "jepsen_etcd_demo_tpu/ops/wgl3_sparse.py",
+        "factory": "_cached_sparse_chunk_dedup",
+        "donates": [0],
+        "packed": None,
+        "carry": "_Carry3",
+        "axes": [],
+        "role": "chunk",
+    },
+}
+
+
+def family_entry(family: str) -> dict:
+    try:
+        return PLAN_FAMILIES[family]
+    except KeyError:
+        raise KeyError(
+            f"unknown kernel family {family!r} — not in the plan "
+            f"registry (known: {', '.join(sorted(PLAN_FAMILIES))})"
+        ) from None
+
+
+def backend_callable(family: str) -> Any:
+    """The backend factory/entry callable for a family, resolved from
+    the registry's module path (lazy — importing a backend module may
+    pull in jax)."""
+    ent = family_entry(family)
+    modname = ent["module"].replace("/", ".").removesuffix(".py")
+    mod = importlib.import_module(modname)
+    return getattr(mod, ent.get("entry") or ent["factory"])

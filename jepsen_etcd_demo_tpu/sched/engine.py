@@ -34,7 +34,6 @@ import numpy as np
 
 from .. import obs
 from ..ops.limits import limits
-from .compile_cache import kernel_cache
 
 
 def assign_step_buckets(step_counts: Sequence[int]) -> list[int]:
@@ -73,52 +72,30 @@ def _pad_rs(k_slots: int):
 
 
 def _dense_bucket_launcher(model, cfg, b: int, r: int):
-    """Resolved packed checker for one (batch, step) bucket shape, from
-    the sched kernel LRU: run(tabs, act, tgt) -> DEVICE packed i32 rows.
-    The single-device route (wgl3_pallas.packed_batch_checker) emits
-    i32[b, 5] (wgl3.PACKED_FIELDS); the sharded route emits i32[b, 6]
+    """Resolved packed checker for one (batch, step) bucket shape,
+    through the KernelPlan layer (plan/dispatch.py plan_dense_batch —
+    the one copy of the sharded-vs-local and pallas-vs-XLA routing this
+    function used to duplicate): run(tabs, act, tgt) -> DEVICE packed
+    i32 rows. The single-device pallas route emits i32[b, 5]
+    (wgl3.PACKED_FIELDS); the XLA routes emit i32[b, 6]
     (wgl3.PACKED_FIELDS_XLA — the live-tile telemetry column rides
     along). The drain unpacks through wgl3.unpack_np, which accepts
     both widths — that dual-width contract is the one jtflow pins
-    (doc/analysis.md "Contracts"; this docstring used to claim a flat
-    i32[b, 5], the exact stale-width drift JTL401 exists for).
+    (doc/analysis.md "Contracts"). The plan's cache key carries the
+    mesh identity, so an elastic re-shard between runs can only MISS
+    the kernel LRU, never serve a stale compiled launch.
     Returns (run, kernel_name)."""
-    import jax
+    from .. import plan as kplan
 
-    mkey = model.cache_key()
-    if jax.device_count() > 1 and b > 1:
-        key = ("sched-dense-sharded", mkey, cfg, b, r)
-
-        def build():
-            from ..parallel.dense import (batch_mesh,
-                                          sharded_packed_batch_checker)
-
-            mesh = batch_mesh()
-            return sharded_packed_batch_checker(model, cfg, mesh,
-                                                n_steps=r, batch=b)
-
-        # jtflow: packed wgl3.PACKED_FIELDS_XLA
-        return kernel_cache().get(key, build)
-    key = ("sched-dense", mkey, cfg, b, r)
-
-    def build():
-        from ..ops.wgl3_pallas import packed_batch_checker
-
-        return packed_batch_checker(model, cfg, n_steps=r, batch=b)
-
-    # jtflow: packed wgl3.PACKED_FIELDS
-    return kernel_cache().get(key, build)
+    p = kplan.plan_dense_batch(model, cfg, n_steps=r, batch=b)
+    return kplan.resolve(p), p.label
 
 
 def _launch_multiple(model, cfg, b: int, r: int) -> int:
     """The [B]-axis multiple a launch of this shape must pad to."""
-    import jax
+    from .. import plan as kplan
 
-    if jax.device_count() > 1 and b > 1:
-        from ..parallel.dense import batch_mesh, batch_multiple
-
-        return batch_multiple(model, cfg, batch_mesh(), n_steps=r, batch=b)
-    return 1
+    return kplan.launch_multiple(model, cfg, n_steps=r, batch=b)
 
 
 class _Stats:

@@ -251,17 +251,19 @@ class KeyStream:
                          real: int) -> None:
         import jax.numpy as jnp
 
-        from ..ops import wgl3
-
         chunk = tgt.shape[0]
-        # Always the PLAIN (no-canonicalization) chunk fn: the frontier
+        # Through the KernelPlan layer (plan/dispatch.py): always the
+        # PLAIN (no-canonicalization) wgl3 chunk family — the frontier
         # dedup pass (ops/canon.py) needs to know which pending ops
         # never return in the REMAINING history, and a live stream
         # cannot know its future — an op pending now may still complete
         # later. Post-hoc sweeps of the same key run canon-free too for
         # short histories (batched kernels), so streamed and post-hoc
-        # metrics stay bit-identical.
-        run = wgl3._cached_chunk_run(self.model, self.cfg, chunk)
+        # metrics stay bit-identical (plan_stream_chunk docstring).
+        from .. import plan as kplan
+
+        run = kplan.resolve(
+            kplan.plan_stream_chunk(self.model, self.cfg, chunk))
         t0 = time.monotonic()
         with obs.get_tracer().span("stream.chunk", key=str(self.key),
                                    steps=real, live=bool(live)):

@@ -106,8 +106,14 @@ def _backend_ready() -> bool:
 
 
 def platform_key(require_jax_loaded: bool = True) -> str | None:
-    """``backend/device_kind/device_count`` for the running process, or
-    None when it cannot be determined. With ``require_jax_loaded`` (the
+    """``backend/device_kind/device_count`` for the running process —
+    with ``xH`` (host count) appended on a multi-process pod, so a
+    pod's tuned profile is keyed by its MESH SHAPE and an elastic
+    re-shard (device or host count changed between runs) can only MISS
+    the profile store, never resolve a stale entry tuned for a mesh
+    that no longer exists. Single-process keys keep the historical
+    3-part form (every existing profile stays valid). None when the
+    key cannot be determined. With ``require_jax_loaded`` (the
     default) the key resolves only when a backend is ALREADY initialized
     (_backend_ready): probing devices initializes one, and a lazy
     profile load must never be the thing that dials a wedged TPU tunnel
@@ -119,8 +125,20 @@ def platform_key(require_jax_loaded: bool = True) -> str | None:
         import jax
 
         dev = jax.devices()[0]
-        return f"{jax.default_backend()}/{dev.device_kind}/" \
-               f"{jax.device_count()}"
+        key = f"{jax.default_backend()}/{dev.device_kind}/" \
+              f"{jax.device_count()}"
+        if jax.process_count() > 1:
+            key += f"x{jax.process_count()}"
+        # An explicit mesh shape (--mesh-shape / JEPSEN_TPU_MESH_SHAPE)
+        # changes the sharded lanes' layout without changing the device
+        # or host counts — 2x4 and 4x2 tune differently, so the shape
+        # joins the key (absent = the default mesh for those counts).
+        from ..parallel.mesh import requested_shape
+
+        shape = requested_shape()
+        if shape is not None:
+            key += "@" + "x".join(str(s) for s in shape)
+        return key
     except Exception:
         return None
 

@@ -1,0 +1,24 @@
+"""Negative fixture: a plan registry exactly in step with its
+contracts.json — every spec family resolves, donation sets, packed
+schemas, carries and mesh axes all match. Zero JTL407 findings."""
+
+PLAN_FAMILIES = {
+    "k-a": {
+        "module": "kernels.py",
+        "factory": "make_a",
+        "donates": [0],
+        "packed": "kernels.PACKED_FIELDS",
+        "carry": "_CarryX",
+        "axes": ["batch"],
+        "role": "launch",
+    },
+    "k-b": {
+        "module": "kernels.py",
+        "factory": "make_b",
+        "donates": [],
+        "packed": None,
+        "carry": None,
+        "axes": [],
+        "role": "chunk",
+    },
+}

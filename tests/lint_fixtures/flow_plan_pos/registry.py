@@ -1,0 +1,28 @@
+"""Positive fixture: a plan registry drifted from contracts.json.
+
+Three JTL407 findings: spec family "k-b" has no registry entry
+(anchored on the PLAN_FAMILIES assignment), "k-c" dispatches a backend
+the spec never declared, and "k-a"'s donation set drifted from the
+contract it was seeded from.
+"""
+
+PLAN_FAMILIES = {
+    "k-a": {
+        "module": "kernels.py",
+        "factory": "make_a",
+        "donates": [],
+        "packed": None,
+        "carry": None,
+        "axes": [],
+        "role": "launch",
+    },
+    "k-c": {
+        "module": "kernels.py",
+        "factory": "make_c",
+        "donates": [],
+        "packed": None,
+        "carry": None,
+        "axes": [],
+        "role": "launch",
+    },
+}

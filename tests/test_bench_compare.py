@@ -237,3 +237,79 @@ def test_real_repo_records_load():
     for p in recs:
         rec = bench_compare.load_record(p)
         assert "value" in rec, p
+
+
+# -- scaling-efficiency lane (ISSUE 12) ------------------------------------
+
+def _scaling_record(per_chip: float, efficiency: float = 0.8,
+                    mesh_shape: dict | None = None) -> dict:
+    """A MULTICHIP_rNN.json `parsed` record (dryrun_multichip shape)."""
+    return {
+        "value": per_chip, "backend": "cpu",
+        "scaling": {"mesh_shape": mesh_shape or {"batch": 8},
+                    "n_devices": 8, "events": 2677,
+                    "events_per_sec": per_chip * 8,
+                    "events_per_chip": per_chip,
+                    "single_device_eps": per_chip / efficiency,
+                    "efficiency_vs_single": efficiency},
+    }
+
+
+def test_scaling_lane_gated_like_the_others():
+    """Events/s-per-chip and the efficiency ratio regression-gate: a
+    pod sharding-overhead blowup fails CI exactly like a single-chip
+    kernel regression."""
+    res = bench_compare.compare(_scaling_record(5000.0, 0.8),
+                                _scaling_record(3000.0, 0.45),
+                                threshold_pct=10.0)
+    assert "scaling_eps_per_chip" in res["regressions"]
+    assert "scaling_efficiency" in res["regressions"]
+    by_lane = {r["lane"]: r for r in res["lanes"]}
+    assert by_lane["scaling_eps_per_chip"]["delta_pct"] == -40.0
+    assert by_lane["scaling_total_eps"]["informational"] is True
+
+
+def test_scaling_lane_within_threshold_passes():
+    res = bench_compare.compare(_scaling_record(5000.0),
+                                _scaling_record(4800.0),
+                                threshold_pct=10.0)
+    assert res["regressions"] == []
+
+
+def test_scaling_mesh_shape_mismatch_skips_with_note():
+    """Per-chip rates from DIFFERENT mesh shapes are not like-for-like:
+    the scaling lanes skip with both shapes named instead of gating —
+    and the rest of the comparison still runs."""
+    res = bench_compare.compare(
+        _scaling_record(5000.0, mesh_shape={"batch": 8}),
+        _scaling_record(2000.0, mesh_shape={"host": 2, "batch": 8}),
+        threshold_pct=10.0)
+    assert res["comparable"] is True
+    assert "scaling_eps_per_chip" not in res["regressions"]
+    by_lane = {r["lane"]: r for r in res["lanes"]}
+    lane = by_lane["scaling_eps_per_chip"]
+    assert lane.get("skipped") is True
+    assert "'batch': 8" in lane["note"] and "'host': 2" in lane["note"]
+
+
+def test_scaling_lane_dropped_from_new_record_fails():
+    """A MULTICHIP round that stops measuring scaling is a dropped
+    lane — named failure, same policy as every other lane."""
+    old, new = _scaling_record(5000.0), _scaling_record(5000.0)
+    del new["scaling"]
+    res = bench_compare.compare(old, new)
+    assert "scaling_eps_per_chip" in res["missing"]
+
+
+def test_multichip_r06_record_loads_and_self_compares():
+    """The committed MULTICHIP_r06.json carries the per-chip numbers
+    and the mesh shape; it loads through the driver-wrapper path and
+    self-compares clean."""
+    repo = Path(__file__).resolve().parent.parent
+    rec = bench_compare.load_record(repo / "MULTICHIP_r06.json")
+    scal = rec["scaling"]
+    assert scal["events_per_chip"] > 0
+    assert scal["mesh_shape"] == {"batch": 8}
+    assert 0 < scal["efficiency_vs_single"] <= 8
+    res = bench_compare.compare(rec, rec)
+    assert res["comparable"] is True and res["regressions"] == []

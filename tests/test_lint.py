@@ -496,6 +496,9 @@ FLOW_GOLDEN = [
     ("JTL405", "flow_metric_pos",
      [("obsmod.py", 11), ("obsmod.py", 29), ("obsmod.py", 40)],
      "flow_metric_neg"),
+    ("JTL407", "flow_plan_pos",
+     [("registry.py", 9), ("registry.py", 10), ("registry.py", 19)],
+     "flow_plan_neg"),
 ]
 
 
@@ -530,7 +533,7 @@ def test_flow_rules_have_fixture_dirs():
     test_every_module_rule_has_fixture_pair_and_docs."""
     flow_ids = {i for i in analysis.all_rules() if i.startswith("JTL4")}
     assert flow_ids == {"JTL401", "JTL402", "JTL403", "JTL404",
-                        "JTL405", "JTL406"}
+                        "JTL405", "JTL406", "JTL407"}
     assert {g[0] for g in FLOW_GOLDEN} == flow_ids - {"JTL406"}
     for _rid, pos, _locs, neg in FLOW_GOLDEN:
         assert (FIXTURES / pos).is_dir() and (FIXTURES / neg).is_dir()
@@ -560,6 +563,31 @@ def test_pr7_metric_collision_regression_fixture():
     assert any("two TYPE lines" in m for m in msgs)
     assert any("not pre-registered" in m for m in msgs)
     assert any("no writer" in m for m in msgs)
+
+
+def test_plan_contract_drift_fixture():
+    """ISSUE 12 satellite: JTL407 verifies the KernelPlan registry
+    against contracts.json in BOTH directions — a spec family the plan
+    layer cannot dispatch, a dispatch target outside the spec, and a
+    drifted donation set each produce a named finding."""
+    res = _lint_flow("flow_plan_pos", "JTL407")
+    msgs = sorted(f.message for f in res.findings)
+    assert any("'k-b'" in m and "no KernelPlan registry entry" in m
+               for m in msgs)
+    assert any("'k-c'" in m and "does not declare" in m for m in msgs)
+    assert any("k-a" in m and "donates [] != contracts [0]" in m
+               for m in msgs)
+
+
+def test_plan_contract_real_tree_in_sync():
+    """The real plan/registry.py is in step with the real
+    contracts.json — through BOTH representations: the jtflow rule and
+    the runtime verifier report zero drift (the tier-1 half of the
+    contracts↔plan sync discipline; tests/test_plan.py owns the
+    regenerate-and-build half)."""
+    res = analysis.run_lint([PKG], rules={
+        "JTL407": analysis.all_rules()["JTL407"]}, root=REPO)
+    assert not res.findings, analysis.format_text(res.findings)
 
 
 def test_stale_jtflow_annotation_is_a_finding(tmp_path):
@@ -618,7 +646,9 @@ def test_contracts_json_in_sync():
         "table", "dead", "dead_step", "max_frontier"]
     assert c["partials"]["wgl3._chunk_fn"] == [
         "configs_explored", "live_tile_sum", "real_steps"]
-    assert set(c["meshes"]) == {"batch", "lattice", "slice"}
+    # "host" is the pod axis (ISSUE 12): parallel/mesh.pod_mesh and the
+    # 2-D batch/lattice pod meshes declare it.
+    assert set(c["meshes"]) == {"batch", "host", "lattice", "slice"}
     assert c["table_word_bits"] == 5
 
 

@@ -1,0 +1,174 @@
+"""KernelPlan spine (ISSUE 12): registry↔contracts sync (the tier-1
+regenerate-and-diff gate, JTL406's discipline applied to the plan
+layer), plan construction/dispatch for every family, routing-planner
+parity with the pre-plan backends, and the `jepsen-tpu plan --print`
+CLI verb."""
+
+from __future__ import annotations
+
+import json
+import random
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from jepsen_etcd_demo_tpu import plan as kplan
+from jepsen_etcd_demo_tpu import analysis
+from jepsen_etcd_demo_tpu.models import CASRegister
+from jepsen_etcd_demo_tpu.ops.encode import encode_register_history
+from jepsen_etcd_demo_tpu.utils.fuzz import (gen_register_history,
+                                             mutate_history)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# -- contracts↔plan sync (tier-1 gate) -------------------------------------
+
+def test_registry_in_sync_with_checked_in_contracts():
+    """Every contracts.json kernel family resolves to a registry entry
+    and vice versa, fields matching — the runtime half of JTL407."""
+    assert kplan.verify_registry() == []
+
+
+def test_contracts_plan_sync_regenerate_and_build():
+    """The FULL sync discipline (ISSUE 12 satellite, same shape as the
+    JTL406 contracts test): regenerate contracts.json from the tree,
+    verify the registry against the FRESH extraction, and build a
+    KernelPlan for every family — so neither a stale checked-in spec
+    nor an unbuildable registry entry can hide behind the other."""
+    fresh = analysis.extract_contracts(REPO)
+    assert kplan.verify_registry(fresh) == []
+    for family in kplan.PLAN_FAMILIES:
+        p = kplan.build_plan(family)
+        assert p.family == family
+        assert p.donates == tuple(
+            kplan.PLAN_FAMILIES[family]["donates"])
+        # Every family the registry declares must have a dispatch
+        # builder and a resolvable backend callable.
+        assert callable(kplan.backend_callable(family))
+
+
+def test_verify_registry_reports_drift_both_directions():
+    contracts = json.loads((REPO / "contracts.json").read_text())
+    tampered = json.loads(json.dumps(contracts))
+    tampered["kernels"]["wgl3-chunk"]["donates"] = []
+    tampered["kernels"]["k-new"] = {"module": "m.py", "factory": "f",
+                                    "donates": []}
+    del tampered["kernels"]["wgl2-chunk"]
+    problems = "\n".join(kplan.verify_registry(tampered))
+    assert "wgl3-chunk" in problems and "donates" in problems
+    assert "k-new" in problems and "no KernelPlan registry entry" \
+        in problems
+    assert "wgl2-chunk" in problems and "does not declare" in problems
+
+
+def test_unknown_family_fails_loudly():
+    with pytest.raises(KeyError, match="unknown kernel family"):
+        kplan.build_plan("no-such-kernel")
+    with pytest.raises(KeyError, match="no-such-kernel"):
+        kplan.plan_report("no-such-kernel")
+
+
+# -- planners: routing parity ----------------------------------------------
+
+def _dense_cfg(model, k=16, max_value=4):
+    from jepsen_etcd_demo_tpu.ops import wgl3
+
+    cfg = wgl3.dense_config(model, k, max_value)
+    assert cfg is not None
+    return cfg
+
+
+def test_plan_dense_batch_single_device_routes_xla_on_cpu():
+    """shard=False pins the local form; with no pallas backend the
+    family is the packed XLA batch checker, label 'wgl3-dense' —
+    exactly what packed_batch_checker (now a shim) returns."""
+    model = CASRegister()
+    cfg = _dense_cfg(model)
+    p = kplan.plan_dense_batch(model, cfg, n_steps=64, batch=4,
+                               shard=False)
+    assert p.family == "wgl3-batch"
+    assert p.label == "wgl3-dense"
+    assert p.mesh is None
+    from jepsen_etcd_demo_tpu.ops import wgl3_pallas
+
+    fn, name = wgl3_pallas.packed_batch_checker(model, cfg, n_steps=64,
+                                                batch=4)
+    assert name == "wgl3-dense"
+    assert callable(fn)
+
+
+def test_plan_dense_batch_auto_shards_on_the_virtual_mesh():
+    """The auto route (the sched bucket launcher's policy) shards over
+    the 8-device CI mesh; the plan's key carries the mesh identity."""
+    model = CASRegister()
+    cfg = _dense_cfg(model)
+    p = kplan.plan_dense_batch(model, cfg, n_steps=64, batch=8)
+    assert p.family == "wgl3-dense-sharded"
+    assert p.label == "wgl3-dense-sharded"
+    assert p.mesh is not None and p.mesh.total == 8
+    assert p.cache_key()[7] == p.mesh.key()
+
+
+def test_plan_dense_batch_rejects_overlong_scan():
+    from jepsen_etcd_demo_tpu.ops.limits import limits
+
+    model = CASRegister()
+    cfg = _dense_cfg(model)
+    with pytest.raises(ValueError, match="exceeds one scan program"):
+        kplan.plan_dense_batch(model, cfg,
+                               n_steps=limits().long_scan_max + 1,
+                               batch=4)
+
+
+def test_dispatch_long_stamps_plan_family_and_matches_direct():
+    """dispatch_long (the one copy of the lattice/pallas/XLA long-sweep
+    ladder) returns the chunked sweep's exact verdict with the planned
+    family stamped."""
+    from jepsen_etcd_demo_tpu.ops import wgl3
+
+    model = CASRegister()
+    rng = random.Random(0xABC)
+    h = mutate_history(rng, gen_register_history(rng, n_ops=60,
+                                                 n_procs=4))
+    enc = encode_register_history(h, k_slots=16)
+    cfg, rs = wgl3.prepare_dense(enc, model)
+    direct = wgl3.check_steps3_long(rs, model, cfg, chunk=32)
+    routed = kplan.dispatch_long(rs, model, cfg, chunk=32)
+    assert routed["plan_family"] in ("wgl3-chunk", "wgl3-chunk-dedup",
+                                     "wgl3-sparse-chunk")
+    for f in ("valid", "survived", "dead_step", "max_frontier",
+              "configs_explored"):
+        assert routed[f] == direct[f], (f, routed, direct)
+
+
+def test_elle_dispatch_through_plan():
+    """The elle closure resolves and launches through plan.dispatch
+    (family elle-closure) — cycle verdicts unchanged."""
+    import jax.numpy as jnp
+
+    p = kplan.plan_elle_single(16)
+    adj = np.zeros((16, 16), np.float32)
+    adj[0, 1] = adj[1, 2] = adj[2, 0] = 1.0     # 3-cycle
+    adj[4, 5] = 1.0                             # acyclic tail
+    packed, cyc, _rounds = p.dispatch(jnp.asarray(adj))
+    cyc = np.asarray(cyc)
+    assert cyc[:3].all() and not cyc[3:].any()
+    assert np.asarray(packed).shape == (16, 17)
+
+
+def test_plan_report_and_cli_verb(capsys):
+    rep = kplan.plan_report()
+    assert rep["sync"] == "ok"
+    assert set(rep["families"]) == set(kplan.PLAN_FAMILIES)
+    from jepsen_etcd_demo_tpu.cli.main import main
+
+    assert main(["plan", "--print", "--family", "wgl3-lattice-chunk"]) \
+        == 0
+    out = json.loads(capsys.readouterr().out)
+    fam = out["families"]["wgl3-lattice-chunk"]
+    assert fam["factory"] == "make_lattice_chunk_fn"
+    assert fam["entry"] == "cached_lattice_chunk"
+    assert fam["axes"] == ["lattice"]
+    assert main(["plan", "--family", "nope"]) == 2

@@ -65,6 +65,17 @@ LANES: list[tuple[str, tuple]] = [
     ("elle_txns_eps", ("detail", "elle", "txns_per_sec")),
     ("elle_events_eps", ("detail", "elle", "events_per_sec")),
 ]
+# Scaling-efficiency lanes (ISSUE 12): events/s PER CHIP on the mesh
+# and the per-chip-vs-single-device efficiency ratio, recorded by
+# __graft_entry__.dryrun_multichip into MULTICHIP_rNN.json. Gated like
+# every other lane — but ONLY when both records measured the SAME mesh
+# shape: per-chip numbers from different meshes are not a
+# like-for-like comparison (the shapes are named in the skip note).
+SCALING_LANES: list[tuple[str, tuple]] = [
+    ("scaling_eps_per_chip", ("scaling", "events_per_chip")),
+    ("scaling_efficiency", ("scaling", "efficiency_vs_single")),
+]
+SCALING_MESH_PATH = ("scaling", "mesh_shape")
 # Long-history lanes: seconds, LOWER is better — handled via inversion.
 LONG_LANES_PATH = ("detail", "long_history")
 # Deep-attribution lanes (ISSUE 8): the kernel_phases cost_analysis
@@ -89,6 +100,10 @@ INFO_LANES: list[tuple[str, tuple]] = [
     ("elle_speedup_vs_dense", ("detail", "elle", "speedup_vs_dense")),
     ("elle_dense_s", ("detail", "elle", "dense_s")),
     ("elle_tiled_s", ("detail", "elle", "tiled_s")),
+    # Scaling lane context (ISSUE 12): the totals behind the gated
+    # per-chip rate — a total-eps move explains a per-chip move.
+    ("scaling_total_eps", ("scaling", "events_per_sec")),
+    ("scaling_single_eps", ("scaling", "single_device_eps")),
 ]
 
 
@@ -177,6 +192,23 @@ def compare(old: dict, new: dict,
     old_long, new_long = _long_lanes(old), _long_lanes(new)
     pairs += [(lane, old_long.get(lane), new_long.get(lane))
               for lane in sorted(set(old_long) | set(new_long))]
+    # Scaling lanes gate ONLY same-mesh records (ISSUE 12): per-chip
+    # rates from different mesh shapes are not like-for-like. A shape
+    # mismatch skips the scaling lanes with both shapes named — it
+    # never silently gates, and never blocks the other lanes.
+    old_mesh = _dig_raw(old, SCALING_MESH_PATH)
+    new_mesh = _dig_raw(new, SCALING_MESH_PATH)
+    if old_mesh is not None and new_mesh is not None \
+            and old_mesh != new_mesh:
+        for lane, _path in SCALING_LANES:
+            out["lanes"].append({
+                "lane": lane, "old": None, "new": None,
+                "delta_pct": None, "regression": False, "skipped": True,
+                "note": (f"mesh shape differs: old {old_mesh} vs new "
+                         f"{new_mesh}; per-chip rates not comparable")})
+    else:
+        pairs += [(lane, _dig(old, path), _dig(new, path))
+                  for lane, path in SCALING_LANES]
     for lane, o, n in pairs:
         if o is not None and n is None:
             # The baseline RECORDS this lane (a 0 measurement counts —
