@@ -717,6 +717,155 @@ def bench_tuned(model, n_hist: int = 128, ops_range=(20, 300)) -> dict:
     return lane
 
 
+def bench_serve(model, n_hist: int = 96, clients: int = 8,
+                ops_range=(10, 48), n_procs: int = 4,
+                coalesce_ms: int = 10, seed: int = 0x5E12E,
+                invalid_every: int = 5, min_speedup: float | None = None
+                ) -> dict:
+    """Checking-as-a-service lane (ISSUE 13 tentpole): K concurrent CPU
+    clients against an in-process serve daemon (the CoalescingScheduler
+    core, exactly what `jepsen-tpu serve --check` dispatches through)
+    vs the serial one-request-at-a-time baseline — SAME histories, SAME
+    daemon configuration, daemon restarted between arms. The concurrent
+    arm's win is the whole serving thesis: K closed-loop clients fill
+    the coalescing window so per-launch dispatch overhead and the
+    max-linger amortize across the batch, while the solo client pays
+    both on every request (exactly the continuous-batching economics of
+    inference serving; on parallel hardware the batched kernel itself
+    adds the vectorization win on top — CPU only amortizes overhead).
+
+    The warm pool is shared process state (that is the product), so
+    both arms run after a warmup pass that compiles both arms' shapes —
+    the lane measures request-path batching, not compile luck. The
+    fixture keeps per-history concurrency small and uniform so the
+    shared-geometry (max-k) padding of a coalesced batch stays honest
+    work on a CPU (no SIMD batch axis to hide it).
+
+    Reports aggregate events/s (gated round-over-round), p50/p99
+    request latency and coalesced batch fill (informational), the
+    warm-pool hit rate across the concurrent arm, and certifies every
+    served verdict bit-identical to the post-hoc analyze route on the
+    same encoded histories. A mix of valid and mutated-invalid
+    histories keeps the parity check meaningful."""
+    import threading
+
+    from jepsen_etcd_demo_tpu import sched
+    from jepsen_etcd_demo_tpu.ops import wgl3_pallas
+    from jepsen_etcd_demo_tpu.ops.encode import encode_register_history
+    from jepsen_etcd_demo_tpu.serve import CoalescingScheduler
+    from jepsen_etcd_demo_tpu.utils.fuzz import (gen_register_history,
+                                                 mutate_history)
+
+    rng = random.Random(seed)
+    lo, hi = ops_range
+    encs = []
+    for i in range(n_hist):
+        hist = gen_register_history(rng, n_ops=rng.randrange(lo, hi),
+                                    n_procs=n_procs, p_info=0.002)
+        if invalid_every and i % invalid_every == invalid_every - 1:
+            hist = mutate_history(rng, hist)
+        encs.append(encode_register_history(hist, k_slots=8))
+    events = int(sum(e.n_events for e in encs))
+
+    # Post-hoc analyze route (the per-history auto router `analyze`
+    # resolves through) — the parity oracle AND the warmup for the
+    # serial arm's single-history shapes.
+    posthoc = []
+    for e in encs:
+        outs, _kernel = wgl3_pallas.check_batch_encoded_auto([e], model)
+        posthoc.append(outs[0])
+
+    def run_arm(arm_clients: int) -> tuple[float, list, dict]:
+        server = CoalescingScheduler(coalesce_ms=coalesce_ms)
+        try:
+            shards = [encs[i::arm_clients] for i in range(arm_clients)]
+            idx_shards = [list(range(n_hist))[i::arm_clients]
+                          for i in range(arm_clients)]
+            results: list = [None] * n_hist
+            errors: list = []
+
+            def client(tenant_i: int):
+                # Closed loop: submit, await the verdict, submit the
+                # next — K of these concurrently is what the coalescer
+                # merges into shared launches.
+                try:
+                    for idx, enc in zip(idx_shards[tenant_i],
+                                        shards[tenant_i]):
+                        req = server.submit(f"tenant-{tenant_i}", enc,
+                                            model_name=model.name)
+                        assert req.wait(300), "serve verdict timed out"
+                        results[idx] = req.result
+                except Exception as e:   # surfaced below, not swallowed
+                    errors.append(e)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(arm_clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            stats = server.stats()
+            return wall, results, stats
+        finally:
+            server.close()
+
+    # Warmup: one throwaway concurrent pass compiles the coalesced
+    # batch-bucket shapes the timed concurrent arm will launch.
+    run_arm(arm_clients=clients)
+    serial_wall, serial_results, _ = run_arm(arm_clients=1)
+    cache1 = sched.kernel_cache().stats()
+    conc_wall, conc_results, conc_stats = run_arm(arm_clients=clients)
+    cache2 = sched.kernel_cache().stats()
+    conc_lookups = (cache2["hits"] + cache2["misses"]
+                    - cache1["hits"] - cache1["misses"])
+    conc_hits = cache2["hits"] - cache1["hits"]
+
+    # Parity: every served verdict (both arms) bit-identical to the
+    # post-hoc analyze route on the same encoded history.
+    for arm_name, arm in (("serial", serial_results),
+                          ("concurrent", conc_results)):
+        for i, (srv, post) in enumerate(zip(arm, posthoc)):
+            assert srv["valid"] == post["valid"] \
+                and srv["dead_step"] == int(post["dead_step"]), \
+                (f"serve {arm_name} verdict diverged from analyze at "
+                 f"history {i}: {srv['valid']}/{srv['dead_step']} vs "
+                 f"{post['valid']}/{post['dead_step']}")
+
+    lats = sorted(r["latency_s"] for r in conc_results)
+    agg_eps = events / conc_wall
+    serial_eps = events / serial_wall
+    speedup = agg_eps / serial_eps if serial_eps else 0.0
+    if min_speedup is not None:
+        assert speedup >= min_speedup, \
+            (f"serve acceptance: aggregate {agg_eps:.0f} ev/s is only "
+             f"{speedup:.2f}x the serial baseline {serial_eps:.0f} ev/s "
+             f"(need >= {min_speedup}x)")
+    return {
+        "histories": n_hist,
+        "clients": clients,
+        "events": events,
+        "serial_s": round(serial_wall, 4),
+        "concurrent_s": round(conc_wall, 4),
+        "events_per_sec": round(agg_eps, 1),
+        "serial_events_per_sec": round(serial_eps, 1),
+        "speedup_vs_serial": round(speedup, 2),
+        "latency_p50_ms": round(1000 * lats[len(lats) // 2], 2),
+        "latency_p99_ms": round(
+            1000 * lats[min(len(lats) - 1, int(0.99 * len(lats)))], 2),
+        "batches": conc_stats["batches"],
+        "coalesced_requests": conc_stats["coalesced_requests"],
+        "batch_fill_avg": conc_stats["batch_fill_avg"],
+        "cache_hit_rate": round(conc_hits / conc_lookups, 4)
+        if conc_lookups else 1.0,
+        "invalid": sum(1 for r in posthoc if r["valid"] is not True),
+        "verdicts_identical": True,
+    }
+
+
 def build_stream_run(n_keys: int = 16, ops_per_key: int = 400,
                      seed: int = 0x57CA):
     """ONE generated independent-key run for the streaming lane: per-key
@@ -1329,6 +1478,7 @@ def main():
                 "cache_hit_rate": 0.0,
                 "sweep": obs.sweep_stats(None),
                 "elle": obs.elle_stats(None),
+                "serve": obs.serve_stats(None),
                 # Which tuning profile the run INTENDED to use (ISSUE 4:
                 # tools/print_profile.py prints the full resolved view).
                 "profile": _profile_record(),
@@ -1404,6 +1554,11 @@ def main():
             # tiled/batched closure on one 10k-txn sparse history,
             # verdicts certified bit-identical across every route.
             elle_lane = bench_elle()
+            # Checking-as-a-service lane (ISSUE 13): K concurrent
+            # clients against the in-process continuous-batching
+            # daemon vs the serial baseline, verdicts certified
+            # bit-identical to the analyze route; acceptance >= 3x.
+            serve_lane = bench_serve(model, min_speedup=3.0)
             # Inside the capture: the 100k lane's compile/execute/encode
             # seconds must land in the same kernel_phases breakdown as
             # every other lane when it actually runs.
@@ -1429,6 +1584,7 @@ def main():
             "cache_hit_rate": 0.0,
             "sweep": obs.sweep_stats(cap.metrics),
             "elle": obs.elle_stats(cap.metrics),
+            "serve": obs.serve_stats(cap.metrics),
             "profile": _profile_record(),
             "health": health_rec,
             "degraded": True,
@@ -1469,6 +1625,7 @@ def main():
         "tuned": tuned_lane,
         "streaming": stream_lane,
         "elle": elle_lane,
+        "serve": serve_lane,
     }
     if "roofline" in corpus:
         detail["roofline"] = corpus["roofline"]
@@ -1506,6 +1663,11 @@ def main():
         # (ISSUE 11): per-route graph counts, launches, tiled rounds,
         # streamed txns — zeros permitted, never absent.
         "elle": obs.elle_stats(cap.metrics),
+        # Serve-daemon accounting over the same capture (ISSUE 13):
+        # request/batch/admission counters and latency quantiles —
+        # zeros permitted, never absent (the degraded records above
+        # carry the all-zero shape).
+        "serve": obs.serve_stats(cap.metrics),
         # The tuning profile this round resolved (ISSUE 4): hash +
         # non-default fields with provenance; detail.tuned measures it.
         "profile": _profile_record(),

@@ -230,10 +230,36 @@ def build_parser() -> argparse.ArgumentParser:
         "serve",
         help="serve the results store over http (plus /live, /metrics, "
              "/healthz — live data needs the runner in-process: "
-             "`jepsen-tpu test --live-port`)")
-    s.add_argument("--port", type=int, default=8080)
+             "`jepsen-tpu test --live-port`); --check additionally runs "
+             "the checking-as-a-service daemon (serve/; doc/serve.md)")
+    s.add_argument("--port", type=int, default=8080,
+                   help="0 = ephemeral; the bound port is printed as one "
+                        "JSON line at startup")
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--store", default="store")
+    s.add_argument("--check", action="store_true",
+                   help="checking-as-a-service: accept histories over "
+                        "HTTP (POST /check, /serve/session) and verify "
+                        "them on the continuous-batching scheduler over "
+                        "the process-wide warm-kernel pool; verdicts "
+                        "land in the store as browsable runs")
+    s.add_argument("--model", default="cas-register",
+                   help="[--check] default linearizability model for "
+                        "requests that don't name one")
+    s.add_argument("--coalesce-ms", type=int, default=None,
+                   metavar="MS",
+                   help="[--check] max-linger of the coalescing "
+                        "scheduler (default: limits().serve_coalesce_ms "
+                        "— env/tuned-profile resolved)")
+    s.add_argument("--max-batch", type=positive_int, default=None,
+                   help="[--check] requests per coalesced batch "
+                        "(default: limits().serve_max_batch)")
+    s.add_argument("--max-inflight", type=positive_int, default=None,
+                   help="[--check] per-tenant admitted-request bound "
+                        "(default: limits().serve_max_inflight)")
+    s.add_argument("--ready-file", default=None,
+                   help="[--check] also write the startup JSON (port, "
+                        "url) to this file once bound")
 
     pl = sub.add_parser(
         "plan",
@@ -749,6 +775,18 @@ def cmd_plan(args) -> int:
 
 
 def cmd_serve(args) -> int:
+    if getattr(args, "check", False):
+        # Checking-as-a-service (serve/, ISSUE 13): the warm pool only
+        # pays off across requests if compiles persist, so the daemon
+        # enables the same compilation cache production runs use.
+        from ..serve.daemon import serve_check
+
+        enable_compilation_cache(args.store)
+        return serve_check(
+            args.store, host=args.host, port=args.port,
+            default_model=args.model, coalesce_ms=args.coalesce_ms,
+            max_batch=args.max_batch, max_inflight=args.max_inflight,
+            ready_file=args.ready_file)
     from ..web.server import serve
     serve(args.store, host=args.host, port=args.port)
     return 0

@@ -133,6 +133,27 @@ ELLE_GAUGES = ("elle.batch_fill", "elle.tile_density")
 # never absent; a post-hoc run simply records zeros).
 # jtflow: metrics preregistered
 STREAM_GAUGES = ("stream.overlap_ratio", "stream.watermark_lag")
+# Checking-as-a-service daemon (serve/, ISSUE 13): request/batch/
+# admission accounting of the continuous-batching scheduler — requests
+# admitted, coalesced batch launches, requests that shared a batch with
+# another request, work shed to the CPU oracle path while degraded,
+# rejections (admission bound / wedged backend), webhook deliveries —
+# pre-registered so every capture's metrics.json carries them (zeros
+# permitted, never absent; serve_stats() is the bench/web reader).
+# jtflow: metrics preregistered
+SERVE_COUNTERS = ("serve.requests", "serve.batches",
+                  "serve.coalesced_requests", "serve.shed_cpu",
+                  "serve.rejected_inflight", "serve.rejected_wedged",
+                  "serve.webhooks")
+# Queue depth at dispatch time and the coalesced batch's fill (requests
+# per batch over serve_max_batch) — the serve daemon's occupancy
+# telemetry on /metrics and the /live page.
+# jtflow: metrics preregistered
+SERVE_GAUGES = ("serve.queue_depth", "serve.batch_fill")
+# End-to-end request latency (submit -> verdict, seconds) across every
+# tenant; the exporter renders p50/p95/p99 quantile lines.
+# jtflow: metrics preregistered
+SERVE_HISTOGRAM = "serve.request_latency_s"
 # Deep kernel attribution (ISSUE 8): XLA cost_analysis totals captured
 # by instrument_kernel at lower time, plus the device-memory high-water
 # mark — behind kernel_phases' flops / bytes / device_mem_peak fields.
@@ -164,10 +185,11 @@ class Capture:
         self.metrics = MetricsRegistry(enabled=enabled)
         if enabled:
             for name in PHASE_COUNTERS + SCHED_COUNTERS + SWEEP_COUNTERS \
-                    + COST_COUNTERS + ELLE_COUNTERS:
+                    + COST_COUNTERS + ELLE_COUNTERS + SERVE_COUNTERS:
                 self.metrics.counter(name)
-            for name in ELLE_GAUGES:
+            for name in ELLE_GAUGES + SERVE_GAUGES:
                 self.metrics.gauge(name)
+            self.metrics.histogram(SERVE_HISTOGRAM)
             self.metrics.gauge(PHASE_GAUGE)
             self.metrics.gauge(SWEEP_GAUGE)
             self.metrics.gauge(DEDUP_GAUGE)
@@ -568,6 +590,45 @@ def elle_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
     g = snap.get("elle.tile_density")
     if g and g.get("last") is not None:
         out["tile_density"] = round(float(g["last"]), 4)
+    return out
+
+
+def serve_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
+    """The serve daemon's bench/web contract fields (serve/, ISSUE 13),
+    from a registry snapshot: request/batch/admission counters, the
+    queue-depth/batch-fill occupancy gauges, and the request-latency
+    quantiles. Zeros when no registry / no served requests — like every
+    reader here, the contract is "zeros permitted, never absent"."""
+    out = {"requests": 0, "batches": 0, "coalesced_requests": 0,
+           "shed_cpu": 0, "rejected_inflight": 0, "rejected_wedged": 0,
+           "webhooks": 0, "queue_depth": 0, "batch_fill": 0.0,
+           "latency_p50_s": 0.0, "latency_p99_s": 0.0}
+    if metrics is None or not metrics.enabled:
+        return out
+    snap = metrics.snapshot()
+
+    def counter_value(key: str) -> int:
+        rec = snap.get(key)
+        return int(rec["value"]) if rec \
+            and rec.get("type") == "counter" else 0
+
+    out["requests"] = counter_value("serve.requests")
+    out["batches"] = counter_value("serve.batches")
+    out["coalesced_requests"] = counter_value("serve.coalesced_requests")
+    out["shed_cpu"] = counter_value("serve.shed_cpu")
+    out["rejected_inflight"] = counter_value("serve.rejected_inflight")
+    out["rejected_wedged"] = counter_value("serve.rejected_wedged")
+    out["webhooks"] = counter_value("serve.webhooks")
+    g = snap.get("serve.queue_depth")
+    if g and g.get("last") is not None:
+        out["queue_depth"] = int(g["last"])
+    g = snap.get("serve.batch_fill")
+    if g and g.get("last") is not None:
+        out["batch_fill"] = round(float(g["last"]), 4)
+    h = snap.get("serve.request_latency_s")
+    if h and h.get("p50") is not None:
+        out["latency_p50_s"] = round(float(h["p50"]), 6)
+        out["latency_p99_s"] = round(float(h.get("p99") or 0.0), 6)
     return out
 
 

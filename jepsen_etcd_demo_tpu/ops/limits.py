@@ -305,6 +305,26 @@ class KernelLimits:
     # trip per chunk); deeper lets the async dispatch pipeline run
     # ahead between syncs.
     stream_max_lag_chunks: int = _f(4, "tunable", 1, 64, group="stream")
+    # [tunable] Max-linger of the serve daemon's continuous-batching
+    # scheduler (serve/scheduler.py): after the first pending request
+    # arrives, the dispatcher waits up to this many milliseconds for
+    # more requests to coalesce into the same bucketed launch before
+    # dispatching. 0 = dispatch immediately (no cross-request
+    # coalescing beyond what is already queued); larger values trade
+    # per-request latency for batch fill — the capacity-planning knob
+    # (doc/serve.md ties it to the sched bucket fill).
+    serve_coalesce_ms: int = _f(10, "tunable", 0, 1000)
+    # [tunable] Most requests one coalesced serve batch may carry; the
+    # dispatcher drains the per-tenant queues weighted-fair up to this
+    # many per launch cycle (the batch still splits into sched's
+    # {2^k, 1.5*2^k} bucket launches downstream).
+    serve_max_batch: int = _f(64, "tunable", 1, 4096)
+    # [arch] Per-tenant bound of admitted-but-unfinished serve requests
+    # (queued + in a dispatching batch): a tenant at the bound has new
+    # submissions rejected (HTTP 429) until verdicts drain — the
+    # admission-control half of the serve daemon's backpressure
+    # (supervisor state drives the other half: shed/503).
+    serve_max_inflight: int = _f(256, "arch", 1, 4096)
 
 
 def field_meta() -> dict[str, dict]:

@@ -18,15 +18,18 @@ See doc/perf.md for the operator-facing story.
 
 from .compile_cache import (compile_cache_dir, enable_persistent_cache,
                             kernel_cache)
-from .engine import assign_step_buckets, check_corpus
+from .engine import (assign_step_buckets, check_corpus, corpus_executor,
+                     submit_corpus)
 from .pipeline import InflightWindow, double_buffer
 
 __all__ = [
     "assign_step_buckets",
     "check_corpus",
     "compile_cache_dir",
+    "corpus_executor",
     "double_buffer",
     "enable_persistent_cache",
     "InflightWindow",
     "kernel_cache",
+    "submit_corpus",
 ]

@@ -111,10 +111,14 @@ class KernelCache:
         return value
 
     def stats(self) -> dict:
-        total = self.hits + self.misses
-        return {"hits": self.hits, "misses": self.misses,
-                "entries": len(self._entries),
-                "hit_rate": (self.hits / total) if total else 0.0}
+        # Under the lock: a concurrent get() mutating hits/misses/
+        # entries must not tear the snapshot (the serve daemon reads
+        # stats from handler threads while its dispatcher populates).
+        with self._lock:
+            total = self.hits + self.misses
+            return {"hits": self.hits, "misses": self.misses,
+                    "entries": len(self._entries),
+                    "hit_rate": (self.hits / total) if total else 0.0}
 
     def clear(self) -> None:
         with self._lock:

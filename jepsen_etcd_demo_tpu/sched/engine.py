@@ -28,6 +28,8 @@ and batch pads are all-pad histories stripped before assembly
 
 from __future__ import annotations
 
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Sequence
 
 import numpy as np
@@ -99,7 +101,15 @@ def _launch_multiple(model, cfg, b: int, r: int) -> int:
 
 
 class _Stats:
+    """Per-call corpus accounting. Mutations take the instance lock:
+    check_corpus itself records from one thread, but the serve daemon
+    (serve/scheduler.py) folds several calls' stats concurrently with
+    its dispatch thread and the obs counters ride along — hit/bucket
+    accounting must not tear under concurrent submitters (ISSUE 13
+    thread-safety pass)."""
+
     def __init__(self):
+        self._lock = threading.Lock()
         self.steps_real = 0
         self.steps_padded = 0
         self.launches = 0
@@ -115,21 +125,23 @@ class _Stats:
         into the corpus stats — the scheduler's half of the bench/CLI
         sweep exposure."""
         sweep = result.get("sweep")
-        if isinstance(sweep, dict):
-            self.sweep_steps_sparse += int(sweep.get("steps_sparse", 0))
-            self.sweep_steps_dense += int(sweep.get("steps_dense", 0))
-            self.sparse_overflow_rounds += int(
-                sweep.get("overflow_rounds", 0))
         dedup = result.get("dedup")
-        if isinstance(dedup, dict):
-            self.configs_pruned += int(dedup.get("configs_pruned", 0))
+        with self._lock:
+            if isinstance(sweep, dict):
+                self.sweep_steps_sparse += int(sweep.get("steps_sparse", 0))
+                self.sweep_steps_dense += int(sweep.get("steps_dense", 0))
+                self.sparse_overflow_rounds += int(
+                    sweep.get("overflow_rounds", 0))
+            if isinstance(dedup, dict):
+                self.configs_pruned += int(dedup.get("configs_pruned", 0))
 
     def record_launch(self, real: int, b: int, r: int) -> None:
         padded = b * r
-        self.steps_real += real
-        self.steps_padded += padded
-        self.launches += 1
-        self.buckets[r] = self.buckets.get(r, 0) + 1
+        with self._lock:
+            self.steps_real += real
+            self.steps_padded += padded
+            self.launches += 1
+            self.buckets[r] = self.buckets.get(r, 0) + 1
         m = obs.get_metrics()
         m.counter("sched.steps_real").add(real)
         m.counter("sched.steps_padded").add(padded)
@@ -138,18 +150,20 @@ class _Stats:
             m.gauge("sched.padding_waste_ratio").set(padded / real)
 
     def to_dict(self) -> dict:
-        out = {
-            "launches": self.launches,
-            "buckets": sorted(self.buckets.items()),
-            "steps_real": self.steps_real,
-            "steps_padded": self.steps_padded,
-            "padding_waste": (round(self.steps_padded / self.steps_real, 4)
-                              if self.steps_real else 0.0),
-            "sweep_steps_sparse": self.sweep_steps_sparse,
-            "sweep_steps_dense": self.sweep_steps_dense,
-            "configs_pruned": self.configs_pruned,
-            "sparse_overflow_rounds": self.sparse_overflow_rounds,
-        }
+        with self._lock:
+            out = {
+                "launches": self.launches,
+                "buckets": sorted(self.buckets.items()),
+                "steps_real": self.steps_real,
+                "steps_padded": self.steps_padded,
+                "padding_waste": (round(
+                    self.steps_padded / self.steps_real, 4)
+                    if self.steps_real else 0.0),
+                "sweep_steps_sparse": self.sweep_steps_sparse,
+                "sweep_steps_dense": self.sweep_steps_dense,
+                "configs_pruned": self.configs_pruned,
+                "sparse_overflow_rounds": self.sparse_overflow_rounds,
+            }
         return out
 
 
@@ -316,3 +330,38 @@ def _check_general(encs, general_idx, model, results, kernels,
         too_long_all.extend(too_long)
     wgl3_pallas.ladder_tail(encs, model, results, kernels, too_long_all,
                             overflow_seeds)
+
+
+# -- async submit/await face (ISSUE 13) -------------------------------------
+#
+# check_corpus is re-entrant (per-call _Stats, the locked kernel LRU,
+# thread-safe obs registries), but the device itself is a serial
+# resource: concurrent submitters gain nothing by racing dispatches and
+# can interleave compile traces. submit_corpus serializes every
+# submitter through ONE process-wide single-worker executor — the serve
+# daemon's dispatch loop, tests, and ad-hoc callers all await the same
+# queue, so a launch in flight is never preempted by another thread's.
+
+_executor_lock = threading.Lock()
+_executor: ThreadPoolExecutor | None = None
+
+
+def corpus_executor() -> ThreadPoolExecutor:
+    """The process-wide single-worker executor corpus launches serialize
+    on (created on first use; daemon threads, so interpreter shutdown is
+    never blocked on a drained queue)."""
+    global _executor
+    with _executor_lock:
+        if _executor is None:
+            _executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="sched-corpus")
+        return _executor
+
+
+def submit_corpus(encs: Sequence, model=None, f_cap: int = 256) -> Future:
+    """Async submit/await face of :func:`check_corpus`: returns a
+    Future resolving to the same (results, kernel, stats) tuple.
+    Submissions from any thread serialize on :func:`corpus_executor`,
+    so concurrent callers (the serve daemon's coalesced batches, a
+    bench arm, a test) never race device dispatches."""
+    return corpus_executor().submit(check_corpus, encs, model, f_cap)

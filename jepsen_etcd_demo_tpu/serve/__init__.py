@@ -1,0 +1,36 @@
+"""serve — checking-as-a-service (ISSUE 13 tentpole; ROADMAP item 2).
+
+The harness as infrastructure instead of a CLI: a persistent daemon
+whose HTTP API ingests histories from many concurrent tenants and whose
+core is a cross-tenant continuous-batching scheduler over the
+process-wide warm-kernel pool.
+
+  * scheduler.py — the coalescing queue: per-tenant weighted-fair
+    queuing, bounded in-flight admission, `serve_coalesce_ms`
+    max-linger, shared sched bucket launches via the KernelPlan spine,
+    supervisor-driven backpressure (degraded -> CPU oracle shed,
+    wedged -> reject + park, drain on recovery)
+  * sessions.py  — streaming ingestion: per-tenant stream sessions over
+    the incremental encoder, sharing the compiled chunk kernels
+  * daemon.py    — the HTTP surface (`jepsen-tpu serve --check`): the
+    ingestion endpoints on top of web/server.py's observability plane,
+    store artifacts for every verdict, webhooks
+
+See doc/serve.md for the API schema and capacity-planning notes.
+"""
+
+from .scheduler import CoalescingScheduler, Rejected, ServeRequest
+from .sessions import ServeSession, SessionManager, op_from_dict
+from .daemon import ServeDaemon, make_serve_handler, serve_check
+
+__all__ = [
+    "CoalescingScheduler",
+    "Rejected",
+    "ServeDaemon",
+    "ServeRequest",
+    "ServeSession",
+    "SessionManager",
+    "make_serve_handler",
+    "op_from_dict",
+    "serve_check",
+]

@@ -1,0 +1,214 @@
+"""Streaming serve sessions: many tenants' live op feeds, one warm pool.
+
+The single-shot ``POST /check`` path coalesces whole histories; this is
+the other ingestion mode the tentpole names — a tenant opens a session,
+POSTs ops as they happen, and the daemon checks the stable prefix WHILE
+the tenant's run is still going (exactly ``--check-mode stream``, with
+the network replacing the in-process recorder listener).
+
+Each session wraps a :class:`stream.engine.StreamSession` (incremental
+encoder -> watermark -> resumable dense chunk dispatch). Multiplexing
+across sessions happens one layer down, by construction: every
+session's chunk launches resolve through ``plan_stream_chunk`` against
+the ONE process-wide kernel LRU keyed by ``plan.cache_key()``, so
+session N+1's (cfg, chunk) shapes reuse session N's compiled kernels —
+cross-tenant warm-pool sharing on the streaming path, same as the
+coalesced batches on the single-shot path. Sessions are admitted under
+the same per-tenant in-flight bound and the same supervisor gate as
+single-shot work (wedged -> 503 + Retry-After at open)."""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+from ..obs import health
+from ..ops.op import Op
+from .scheduler import RETRY_AFTER_S, Rejected
+
+# Bounds on client-driven session state (the same no-unbounded-growth
+# discipline the scheduler applies to tenant queues): most sessions
+# open at once across ALL tenants, and the idle age past which an
+# abandoned session is finalized and dropped at the next open() (each
+# open session holds an encoder + a consumer thread).
+MAX_OPEN_SESSIONS = 512
+SESSION_IDLE_TTL_S = 900.0
+
+
+class ServeSession:
+    """One tenant's streaming check session. Ops are re-stamped with a
+    session-local monotonic ``seq`` (the recorder's contract the
+    incremental encoder's watermark rests on), so clients submit plain
+    op JSON without sequencing obligations beyond in-order delivery."""
+
+    def __init__(self, tenant: str, model, model_name: str,
+                 keyed: bool = False):
+        from ..stream.engine import StreamSession
+
+        self.id = uuid.uuid4().hex
+        self.tenant = tenant
+        self.model_name = model_name
+        self.created_mono = time.monotonic()
+        self.last_fed_mono = time.monotonic()
+        self.ops_fed = 0
+        self._seq = 0
+        self._closed = False
+        # Guards the seq stamp + feed order: each POST /ops runs on its
+        # own HTTP handler thread, and the incremental encoder's
+        # watermark rests on strictly-increasing seq in arrival order —
+        # interleaved stamping would corrupt the stable prefix.
+        self._lock = threading.Lock()
+        self._session = StreamSession(model, keyed=keyed)
+        self._ops: list[Op] = []    # the full feed, for store artifacts
+
+    def feed(self, ops: list[Op]) -> dict:
+        # Same supervisor gate as single-shot admission: a wedged
+        # backend takes no new streaming work either (the session
+        # itself survives — the client retries the chunk).
+        sup = health.get_supervisor()
+        if sup.snapshot()["state"] == health.WEDGED:
+            raise Rejected("backend wedged; not accepting stream ops "
+                           f"(retry after {RETRY_AFTER_S}s)", 503,
+                           retry_after_s=RETRY_AFTER_S)
+        with self._lock:
+            if self._closed:
+                # A feed racing a concurrent close must not answer
+                # "accepted" for ops that were silently dropped.
+                raise Rejected(f"session {self.id} already closed", 409)
+            for op in ops:
+                op.seq = self._seq
+                self._seq += 1
+                self._ops.append(op)
+                self._session.feed(op)
+            self.ops_fed += len(ops)
+            self.last_fed_mono = time.monotonic()
+            return {"accepted": len(ops), "ops_fed": self.ops_fed,
+                    "falsified": self._session.falsified()}
+
+    def close(self) -> dict:
+        """Drain + finalize: the session verdict. Keys the stream
+        abandoned (infeasible geometry, malformed shapes) re-run through
+        the post-hoc oracle of record — the daemon reports them
+        ``streamed: false`` rather than guessing."""
+        with self._lock:
+            self._closed = True
+            results = self._session.finalize()
+        stats = self._session.stats()
+        if results is None:
+            return {"valid": None, "streamed": False,
+                    "error": stats.get("fallback",
+                                       "no streamable verdicts"),
+                    "stream": stats, "ops_fed": self.ops_fed}
+        keys = {}
+        valid = True
+        for key, res in sorted(results.items(), key=lambda kv: str(kv[0])):
+            keys[str(key) if key is not None else "_"] = {
+                "valid": res.get("valid"),
+                "dead_step": int(res.get("dead_step", -1)),
+                "op_count": int(res.get("op_count", 0)),
+                "kernel": res.get("kernel"),
+            }
+            if res.get("valid") is not True:
+                valid = False
+        return {"valid": valid, "streamed": True, "keys": keys,
+                "stream": stats, "ops_fed": self.ops_fed}
+
+    @property
+    def ops(self) -> list[Op]:
+        with self._lock:
+            return list(self._ops)
+
+
+class SessionManager:
+    """Admission + registry for the daemon's streaming sessions."""
+
+    def __init__(self, max_per_tenant: Optional[int] = None):
+        self._max_per_tenant = max_per_tenant
+        self._lock = threading.Lock()
+        self._sessions: dict[str, ServeSession] = {}
+        self._per_tenant: dict[str, int] = {}
+
+    def _cap(self) -> int:
+        if self._max_per_tenant is not None:
+            return self._max_per_tenant
+        from ..ops.limits import limits
+
+        return limits().serve_max_inflight
+
+    def open(self, tenant: str, model, model_name: str,
+             keyed: bool = False) -> ServeSession:
+        sup = health.get_supervisor()
+        if sup.snapshot()["state"] == health.WEDGED:
+            raise Rejected("backend wedged; not opening new stream "
+                           f"sessions (retry after {RETRY_AFTER_S}s)",
+                           503, retry_after_s=RETRY_AFTER_S)
+        tenant = str(tenant)
+        self._reap_idle()
+        with self._lock:
+            if len(self._sessions) >= MAX_OPEN_SESSIONS:
+                raise Rejected(
+                    f"daemon at the global session bound "
+                    f"({MAX_OPEN_SESSIONS}); close sessions first", 429)
+            if self._per_tenant.get(tenant, 0) >= self._cap():
+                raise Rejected(
+                    f"tenant {tenant!r} at the session bound "
+                    f"({self._cap()}); close sessions first", 429)
+            sess = ServeSession(tenant, model, model_name, keyed=keyed)
+            self._sessions[sess.id] = sess
+            self._per_tenant[tenant] = self._per_tenant.get(tenant, 0) + 1
+        return sess
+
+    def _reap_idle(self) -> None:
+        """Finalize + drop sessions idle past SESSION_IDLE_TTL_S —
+        abandoned sessions must not hold their encoder state and
+        consumer thread forever (run lazily on open(), so an idle
+        daemon spends nothing)."""
+        cutoff = time.monotonic() - SESSION_IDLE_TTL_S
+        with self._lock:
+            stale = [sid for sid, s in self._sessions.items()
+                     if s.last_fed_mono < cutoff]
+        for sid in stale:
+            self.close(sid)
+
+    def get(self, session_id: str) -> Optional[ServeSession]:
+        with self._lock:
+            return self._sessions.get(session_id)
+
+    def close(self, session_id: str) -> Optional[dict]:
+        with self._lock:
+            sess = self._sessions.pop(session_id, None)
+            if sess is not None:
+                n = self._per_tenant.get(sess.tenant, 1) - 1
+                if n > 0:
+                    self._per_tenant[sess.tenant] = n
+                else:
+                    self._per_tenant.pop(sess.tenant, None)
+        if sess is None:
+            return None
+        verdict = sess.close()
+        verdict["session_id"] = session_id
+        verdict["tenant"] = sess.tenant
+        verdict["model"] = sess.model_name
+        return verdict
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"open_sessions": len(self._sessions),
+                    "per_tenant": dict(self._per_tenant)}
+
+
+def op_from_dict(d: dict[str, Any]) -> Op:
+    """One history entry from the HTTP JSON shape — the same fields as a
+    history.jsonl line (ops/op.py). 2-lists normalize to tuples so
+    independent (key, value) ops survive the JSON trip."""
+    if not isinstance(d, dict) or "type" not in d or "f" not in d:
+        raise ValueError(f"op entry must be an object with type/f: {d!r}")
+    v = d.get("value")
+    if isinstance(v, list) and len(v) == 2:
+        v = tuple(v)
+    return Op(type=str(d["type"]), f=str(d["f"]), value=v,
+              process=d.get("process", 0), time=int(d.get("time", 0)),
+              index=int(d.get("index", -1)), error=d.get("error"),
+              seq=int(d.get("seq", -1)))

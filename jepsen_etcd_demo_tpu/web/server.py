@@ -39,8 +39,20 @@ from ..store import Store
 def _run_summary(results: dict) -> str:
     """Compact why-it-failed / what-ran column: op count, rate, and for
     invalid runs the failing detail (per-key failed ops, elle anomaly
-    types) pulled from the composed result tree."""
+    types) pulled from the composed result tree. Served checks
+    (serve/daemon.py artifacts, ISSUE 13) summarize their tenant /
+    batch / route instead — they are browsable history like CLI runs."""
     bits = []
+    srv = results.get("serve") or {}
+    if srv.get("tenant"):
+        bits.append(f"tenant {srv['tenant']}")
+        batch = srv.get("batch") or {}
+        if batch.get("size"):
+            bits.append(f"batch of {batch['size']}")
+        if srv.get("route") and srv["route"] != "jax":
+            bits.append(f"route {srv['route']}")
+        if srv.get("op_count"):
+            bits.append(f"{srv['op_count']} ops")
     perf = results.get("perf") or {}
     if perf.get("count"):
         bits.append(f"{perf['count']} ops")
@@ -110,12 +122,16 @@ def _check_perf_columns(run) -> tuple[str, str, str, str]:
 def _stream_columns(results: dict) -> tuple[str, str]:
     """(check mode, overlap ratio) columns for the run index, from the
     run's results.json (runner/core.py stamps check_mode + the stream
-    session record). Blank for runs recorded before streaming existed;
-    overlap shows only for streamed runs (a post run has none by
-    definition)."""
+    session record; serve/daemon.py stamps "serve", ISSUE 13). Blank
+    for runs recorded before streaming existed; overlap shows only for
+    streamed runs (a post run has none by definition)."""
     mode = results.get("check_mode")
-    if mode not in ("post", "stream"):
+    if mode not in ("post", "stream", "serve"):
         return "", ""
+    if mode == "serve":
+        ov = ((results.get("serve") or {}).get("stream")
+              or {}).get("overlap_ratio")
+        return mode, (f"{ov:.0%}" if isinstance(ov, (int, float)) else "")
     if mode != "stream":
         return mode, ""
     ov = (results.get("stream") or {}).get("overlap_ratio")
@@ -529,10 +545,12 @@ padding-left:1.2em;margin:2px 0}
 start one with <code>jepsen-tpu test &hellip; --live-port</code></p>
 <table id='stats'><tr>
 <th>ops ok</th><th>ops/s</th><th>ops fail</th><th>stream overlap</th>
-<th>watermark lag</th><th>frontier peak</th></tr><tr>
+<th>watermark lag</th><th>frontier peak</th><th>serve queue</th>
+<th>batch fill</th></tr><tr>
 <td id='ok'>0</td><td id='rate'>&ndash;</td><td id='fail'>0</td>
 <td id='overlap'>&ndash;</td><td id='lag'>&ndash;</td>
-<td id='frontier'>&ndash;</td></tr></table>
+<td id='frontier'>&ndash;</td><td id='squeue'>&ndash;</td>
+<td id='sfill'>&ndash;</td></tr></table>
 <h3>nemesis / events</h3><ul id='events'></ul>
 <h3>span tree</h3><ul class='tree' id='spans'></ul>
 <script>
@@ -554,6 +572,10 @@ function met(name, m){
     el('lag').textContent = m.last;
   else if (name === 'wgl.frontier_peak' && m.max !== null)
     el('frontier').textContent = m.max;
+  else if (name === 'serve.queue_depth' && m.last !== null)
+    el('squeue').textContent = m.last;
+  else if (name === 'serve.batch_fill' && m.last !== null)
+    el('sfill').textContent = (100 * m.last).toFixed(0) + '%';
   else if (name === 'health.state') setHealth(m.last);
 }
 function setHealth(v){

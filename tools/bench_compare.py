@@ -64,6 +64,10 @@ LANES: list[tuple[str, tuple]] = [
     # the gated headline.
     ("elle_txns_eps", ("detail", "elle", "txns_per_sec")),
     ("elle_events_eps", ("detail", "elle", "events_per_sec")),
+    # Serve lane (ISSUE 13): the K-concurrent-clients aggregate
+    # throughput is the gated headline; the latency quantiles and
+    # batch-fill context ride the informational lanes below.
+    ("serve_agg_eps", ("detail", "serve", "events_per_sec")),
 ]
 # Scaling-efficiency lanes (ISSUE 12): events/s PER CHIP on the mesh
 # and the per-chip-vs-single-device efficiency ratio, recorded by
@@ -104,6 +108,16 @@ INFO_LANES: list[tuple[str, tuple]] = [
     # per-chip rate — a total-eps move explains a per-chip move.
     ("scaling_total_eps", ("scaling", "events_per_sec")),
     ("scaling_single_eps", ("scaling", "single_device_eps")),
+    # Serve lane context (ISSUE 13): latency quantiles are LOWER-better
+    # and load-shaped, the serial arm is a one-measurement baseline,
+    # and batch fill / speedup are ratios of measurements — all
+    # informational; the gate stays on serve_agg_eps above.
+    ("serve_serial_eps", ("detail", "serve", "serial_events_per_sec")),
+    ("serve_speedup", ("detail", "serve", "speedup_vs_serial")),
+    ("serve_p50_ms", ("detail", "serve", "latency_p50_ms")),
+    ("serve_p99_ms", ("detail", "serve", "latency_p99_ms")),
+    ("serve_batch_fill", ("detail", "serve", "batch_fill_avg")),
+    ("serve_cache_hit_rate", ("detail", "serve", "cache_hit_rate")),
 ]
 
 
