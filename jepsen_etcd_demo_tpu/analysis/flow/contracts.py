@@ -25,6 +25,12 @@ sorted keys, repo-relative posix paths, no timestamps):
   * ``collectives``      per-module collective/sharding axis uses
   * ``metrics``          pre-registered capture names, labeled export
                          families, snapshot-contract keys
+  * ``sync``             the jtsan concurrency contract (analysis/flow/
+                         sync.py): canonical lock ids, thread roots,
+                         each shared structure's guarding lock + the
+                         threads that touch it, and the may-happen
+                         lock-order edge set the runtime sanitizer
+                         (obs/sync.py) is validated against
 """
 
 from __future__ import annotations
@@ -49,6 +55,12 @@ def extract_contracts(root: Path,
         index = FlowIndex.build(Path(root))
     facts = flow_facts(index)
     return _assemble(facts)
+
+
+def _sync_section(index: FlowIndex) -> dict:
+    from .sync import sync_model
+
+    return sync_model(index).contract_section()
 
 
 def _assemble(facts: FlowFacts) -> dict:
@@ -110,6 +122,7 @@ def _assemble(facts: FlowFacts) -> dict:
                                      in facts.snapshot_reads}),
             "dynamic_families": dynamic_families,
         },
+        "sync": _sync_section(facts.index),
     }
 
 

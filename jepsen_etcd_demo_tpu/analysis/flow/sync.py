@@ -1,0 +1,1346 @@
+"""jtsan's concurrency model: locks, threads, happens-before — statically.
+
+The JTL5xx rules (analysis/rules/sync_rules.py) and the sync section of
+contracts.json both consume one ``SyncModel`` extracted from a
+``FlowIndex`` — the same parse-once discipline as the JTL4xx flow facts,
+extended from *data* contracts (packed widths, donation sets) to
+*synchronization* contracts (which lock guards which structure, which
+thread reaches which method, which lock orders are possible).
+
+What the model knows, and where it comes from:
+
+  * **Locks** — ``self.X = threading.Lock()/RLock()/Condition()`` class
+    attrs and module-level ``NAME = threading.Lock()`` globals, each
+    with a canonical id (``serve.scheduler.CoalescingScheduler._lock``)
+    the runtime sanitizer (obs/sync.py) shares, so witnessed and modeled
+    edges compare by name. Constructions wrapped in
+    ``obs.sync.maybe_wrap(inner, "name")`` are seen through (and the
+    name literal is verified against the canonical id — JTL506). A lock
+    attr assigned from a constructor *parameter* (obs/metrics.py's
+    injected instrument lock) has no identity of its own; the
+    ``# jtsan: alias-of=<lock-id>`` annotation unifies it with the lock
+    its owner actually passes in.
+  * **Threads** — ``threading.Thread(target=self.m)`` spawn sites,
+    ``executor.submit(fn, ...)`` sites, and HTTP handler classes
+    (anything whose base chain reaches ``*RequestHandler`` — each
+    request runs the ``do_*`` methods on its own thread). Each is a
+    *root*; the call-graph closure of a root is everything that thread
+    may execute. Call edges placed after ``self.<thread>.join()`` in
+    the same method are pruned from closure propagation — join IS the
+    happens-before edge that makes post-join access single-threaded
+    (StreamSession.finalize's shape).
+  * **Locksets** — for every attribute access and call site, the set of
+    modeled locks syntactically held (``with`` nesting, same scope). A
+    private function whose every in-model call site holds lock L is
+    credited with L ("callers always hold" — the RacerD ownership
+    idiom obs/health.py's ``_transition`` uses).
+  * **Lock order** — ``with a: with b:`` nesting plus the
+    interprocedural edges: a call made while holding L contributes
+    L -> every lock the callee's call-graph closure may acquire.
+    This edge set is exactly what the runtime sanitizer's witnessed
+    acquisition orders are validated against (tests/test_jtsan.py).
+
+Resolution is deliberately conservative: a call the model cannot type
+(a variable callable, a queue's internal machinery) contributes no
+edges. Under-approximation is safe for the race/order rules (they stay
+quiet) and is *tested* for the cross-validation contract — a witnessed
+runtime edge the model failed to predict fails tier-1, which is the
+mechanism that keeps the resolution honest as the tree grows.
+
+``# jtsan:`` annotation grammar (bound to the next statement, same
+binding rules as ``# jtflow:``; VERIFIED not trusted — a stale or
+unresolvable annotation is a JTL506 finding):
+
+    # jtsan: returns=MetricsRegistry      (call-result type for the
+                                           call-graph: obs factories)
+    # jtsan: alias-of=obs.metrics.MetricsRegistry._lock
+                                          (an injected lock attr IS
+                                           that lock)
+    # jtsan: guarded-by=self._lock        (this attr's contract lock —
+                                           JTL501 enforces every site)
+    # jtsan: hb=self.done                 (accesses in this statement
+                                           are ordered by that Event /
+                                           Thread — excluded from the
+                                           lockset intersection)
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterator, Optional
+
+from ..astutil import ancestors_same_scope, dotted
+from ..core import ModuleSource, PACKAGE_NAME
+from .facts import _bind_line, _const_str, _stmt_at
+from .index import FlowIndex
+
+# Package scopes the concurrency model covers: everything with threads,
+# handlers or locks in it. None (files outside the package — the
+# fixture mini-projects) and "" (top-level modules) are always in.
+SYNC_SCOPES = ("serve", "stream", "sched", "runner", "web", "obs", "db",
+               "clients", "control")
+
+_ANNOT_RE = re.compile(r"#\s*jtsan:\s*(.+?)\s*$")
+_DIRECTIVES = ("returns", "alias-of", "guarded-by", "hb")
+
+_LOCK_ORIGINS = {"threading.Lock": "lock", "threading.RLock": "rlock",
+                 "threading.Condition": "condition"}
+_SAFE_ORIGINS = {"queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+                 "queue.PriorityQueue", "threading.Event",
+                 "threading.Semaphore", "threading.BoundedSemaphore",
+                 "collections.deque", "contextvars.ContextVar"}
+_THREAD_ORIGINS = {"threading.Thread", "Thread"}
+_EXECUTOR_SUFFIX = "ThreadPoolExecutor"
+_WRAP_SUFFIXES = ("maybe_wrap", "wrap_lock")
+
+# Calls that block the calling thread. `.get`/`.wait` are matched only
+# against receivers the model can type (a queue/Event attr) — bare
+# dict.get must never count. Condition.wait on a HELD condition is the
+# release idiom, not a block.
+_BLOCKING_SUBPROC = {"run", "check_output", "check_call", "call"}
+_BLOCKING_METHODS = {"result", "join"}          # future / thread
+
+
+def mod_dotted(mod: ModuleSource) -> str:
+    """Canonical dotted module path: package prefix and .py dropped,
+    __init__ collapsed — ``serve.scheduler`` for
+    jepsen_etcd_demo_tpu/serve/scheduler.py, ``engine`` for a fixture's
+    engine.py."""
+    parts = list(Path(mod.relpath).with_suffix("").parts)
+    if parts and parts[0] == PACKAGE_NAME:
+        parts = parts[1:]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) if parts else Path(mod.relpath).stem
+
+
+def in_sync_scope(mod: ModuleSource) -> bool:
+    return mod.scope is None or mod.scope == "" or mod.scope in SYNC_SCOPES
+
+
+@dataclass
+class LockDecl:
+    id: str                      # canonical ("serve.scheduler.Cls._lock")
+    kind: str                    # lock / rlock / condition / injected
+    mod: ModuleSource
+    line: int
+    wrap_name: Optional[str] = None   # literal passed to maybe_wrap
+
+
+@dataclass
+class Annotation:
+    mod: ModuleSource
+    line: int
+    directive: str
+    arg: str
+    node: Optional[ast.stmt]
+
+
+@dataclass
+class Access:
+    owner: str                   # class key
+    attr: str
+    write: bool
+    mod: ModuleSource
+    node: ast.AST
+    fn: str                      # function key
+    locks: frozenset
+    in_init: bool
+    after_join: bool
+    hb: bool                     # statement carries a `# jtsan: hb=` edge
+
+
+@dataclass
+class BlockingCall:
+    fn: str
+    mod: ModuleSource
+    node: ast.AST
+    what: str                    # human label ("Queue.get", ".join()", …)
+    locks: frozenset
+
+
+@dataclass
+class ClassInfo:
+    key: str
+    name: str
+    mod: ModuleSource
+    node: ast.ClassDef
+    locks: dict[str, LockDecl] = field(default_factory=dict)
+    alias: dict[str, str] = field(default_factory=dict)   # attr -> lock id
+    safe_attrs: set[str] = field(default_factory=set)
+    queue_attrs: set[str] = field(default_factory=set)    # queue.* only
+    thread_attrs: dict[str, str] = field(default_factory=dict)  # attr->target
+    executor_attrs: set[str] = field(default_factory=set)
+    attr_types: dict[str, str] = field(default_factory=dict)  # attr->clskey
+    elem_types: dict[str, str] = field(default_factory=dict)  # registry attr
+    methods: dict[str, ast.AST] = field(default_factory=dict)
+    bases: list[str] = field(default_factory=list)        # resolved origins
+    handler: bool = False
+
+
+@dataclass
+class FuncInfo:
+    key: str
+    mod: ModuleSource
+    node: ast.AST
+    cls: Optional[str]           # owning class key
+    acquires: set[str] = field(default_factory=set)
+    # (callee key, locks held, after_join, call node)
+    calls: list[tuple] = field(default_factory=list)
+    returns_cls: Optional[str] = None
+    join_line: Optional[int] = None
+    ltypes: dict[str, str] = field(default_factory=dict)
+    # Same-scope node list, computed ONCE per function and reused by
+    # every pass (the repeated walk_same_scope generators were the
+    # model's dominant cost against the tier-1 lint budget).
+    nodes: list = field(default_factory=list)
+
+    def same_scope(self) -> list:
+        if not self.nodes:
+            from ..astutil import walk_same_scope
+
+            self.nodes = list(walk_same_scope(self.node))
+        return self.nodes
+
+
+class SyncModel:
+    def __init__(self, index: FlowIndex):
+        self.index = index
+        self.classes: dict[str, ClassInfo] = {}
+        self.functions: dict[str, FuncInfo] = {}
+        self.module_locks: dict[str, LockDecl] = {}
+        self.module_var_types: dict[str, dict[str, str]] = {}  # mod->name
+        self.module_executors: dict[str, tuple] = {}  # name id -> (mod, line)
+        self.annotations: list[Annotation] = []
+        self.guarded: dict[tuple[str, str], tuple[str, int]] = {}
+        self.hb_stmts: set[tuple[str, int]] = set()    # (relpath, stmt line)
+        self.hb_decls: list[Annotation] = []
+        self.accesses: list[Access] = []
+        self.blocking: list[BlockingCall] = []
+        # class-level annotated attrs awaiting the full class table:
+        # (ClassInfo, attr, bare class name, mod)
+        self._pending_attr_ann: list[tuple] = []
+        self._value_class_memo: dict[tuple, Optional[str]] = {}
+        self._blocks_memo: dict[str, bool] = {}
+        self._acq_star: dict[str, set[str]] = {}
+        # root id -> (entry fn key, multi-threaded?)
+        self.roots: dict[str, tuple[str, bool]] = {}
+        self.closures: dict[str, set[str]] = {}
+        # (outer lock id, inner lock id) -> (mod, line, via_call) of the
+        # first site; via_call distinguishes call-chain edges (JTL502's
+        # exclusive jurisdiction) from direct with-nesting (which JTL201
+        # also sees when intra-module).
+        self.order_edges: dict[tuple[str, str],
+                               tuple[ModuleSource, int, bool]] = {}
+        self._build()
+
+    # -- public views -------------------------------------------------------
+
+    def lock_ids(self) -> dict[str, str]:
+        out = {d.id: d.kind for d in self.module_locks.values()}
+        for ci in self.classes.values():
+            for d in ci.locks.values():
+                out[d.id] = d.kind
+        return out
+
+    def lock_modules(self) -> dict[str, str]:
+        """Lock id -> declaring module relpath, from the declarations
+        themselves — parsing the module back out of the dotted id would
+        mis-split module-level lock ids (no class component)."""
+        out = {d.id: d.mod.relpath for d in self.module_locks.values()}
+        for ci in self.classes.values():
+            for d in ci.locks.values():
+                out[d.id] = d.mod.relpath
+        return out
+
+    def edge_pairs(self) -> set[tuple[str, str]]:
+        """The may-happen acquisition-order edge set, alias-unified —
+        what the runtime sanitizer's witnessed orders validate against."""
+        return set(self.order_edges)
+
+    def sides_of(self, fn_key: str) -> set[str]:
+        return {r for r, c in self.closures.items() if fn_key in c}
+
+    # -- construction -------------------------------------------------------
+
+    def _mods(self) -> list[ModuleSource]:
+        return [m for m in self.index.modules.values()
+                if in_sync_scope(m) and m.scope != "analysis"]
+
+    def _build(self) -> None:
+        mods = self._mods()
+        for mod in mods:
+            self._scan_annotations(mod)
+        for mod in mods:
+            self._scan_module_level(mod)
+            for node in mod.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self._scan_class(mod, node)
+        self._mark_handlers()
+        for ci, attr, bare, mod in self._pending_attr_ann:
+            cls = self._class_by_name(bare, mod)
+            if cls is not None:
+                ci.attr_types.setdefault(attr, cls)
+        for mod in mods:
+            self._scan_functions(mod)
+        self._apply_annotations()
+        self._build_roots()
+        self._analyze_bodies()
+        self._detect_blocking()
+        self._propagate_caller_locks()
+        self._build_closures()
+        self._build_order_edges()
+
+    # -- annotations --------------------------------------------------------
+
+    def _scan_annotations(self, mod: ModuleSource) -> None:
+        for i, ln in sorted(mod.comments.items()):
+            m = _ANNOT_RE.search(ln)
+            if not m:
+                continue
+            body = m.group(1)
+            head, _, rest = body.partition(" ")
+            directive, _, inline = head.partition("=")
+            arg = (inline + " " + rest).strip() if inline else rest.strip()
+            target = _bind_line(mod, i)
+            node = _stmt_at(mod, target) if target is not None else None
+            self.annotations.append(Annotation(
+                mod=mod, line=i, directive=directive, arg=arg, node=node))
+
+    def _apply_annotations(self) -> None:
+        """Fold the resolvable annotations into the model; verification
+        (unknown directive, failed binding, dangling reference) is
+        JTL506's job — it re-walks self.annotations."""
+        for a in self.annotations:
+            if a.node is None:
+                continue
+            if a.directive == "returns":
+                fn = self._enclosing_or_bound_def(a)
+                cls = self._class_by_name(a.arg, a.mod)
+                if fn is not None and cls is not None:
+                    fn.returns_cls = cls
+            elif a.directive == "alias-of":
+                bound = self._bound_self_attr(a.node)
+                ci = self._class_of_stmt(a)
+                if bound and ci is not None and self._lock_id_known(a.arg):
+                    ci.alias[bound] = a.arg
+                    ci.locks.pop(bound, None)
+            elif a.directive == "guarded-by":
+                bound = self._bound_self_attr(a.node)
+                ci = self._class_of_stmt(a)
+                lid = self._resolve_lock_expr(a.arg, ci, a.mod)
+                if bound and ci is not None and lid is not None:
+                    self.guarded[(ci.key, bound)] = (lid, a.line)
+            elif a.directive == "hb":
+                self.hb_stmts.add((a.mod.relpath, a.node.lineno))
+                self.hb_decls.append(a)
+
+    def _enclosing_or_bound_def(self, a: Annotation) -> Optional[FuncInfo]:
+        if isinstance(a.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            key = self._fn_key_of_def(a.mod, a.node)
+            return self.functions.get(key)
+        return None
+
+    def _class_by_name(self, name: str, mod: ModuleSource) -> Optional[str]:
+        local = f"{mod_dotted(mod)}.{name}"
+        if local in self.classes:
+            return local
+        hits = [k for k, c in self.classes.items() if c.name == name]
+        return hits[0] if len(hits) == 1 else None
+
+    def _class_of_stmt(self, a: Annotation) -> Optional[ClassInfo]:
+        from ..astutil import enclosing_class
+
+        cls = enclosing_class(a.node)
+        if cls is None:
+            return None
+        return self.classes.get(f"{mod_dotted(a.mod)}.{cls.name}")
+
+    def _bound_self_attr(self, node: ast.stmt) -> Optional[str]:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in tgts:
+                d = dotted(t)
+                if d and d.startswith("self.") and len(d.split(".")) == 2:
+                    return d.split(".")[1]
+        return None
+
+    def _lock_id_known(self, lid: str) -> bool:
+        return lid in self.lock_ids()
+
+    def _resolve_lock_expr(self, expr: str, ci: Optional[ClassInfo],
+                           mod: ModuleSource) -> Optional[str]:
+        if expr.startswith("self.") and ci is not None:
+            attr = expr.split(".", 1)[1]
+            if attr in ci.locks:
+                return ci.locks[attr].id
+            if attr in ci.alias:
+                return ci.alias[attr]
+            return None
+        mid = f"{mod_dotted(mod)}.{expr}"
+        if mid in self.module_locks:
+            return mid
+        return expr if self._lock_id_known(expr) else None
+
+    # -- declaration scans --------------------------------------------------
+
+    def _unwrap(self, mod: ModuleSource, call: ast.Call
+                ) -> tuple[ast.AST, Optional[str]]:
+        """See through obs.sync.maybe_wrap(inner, "name")."""
+        origin = mod.imports.resolve(call.func) or ""
+        if origin.split(".")[-1] in _WRAP_SUFFIXES and call.args:
+            name = _const_str(call.args[1]) if len(call.args) > 1 else None
+            return call.args[0], name
+        return call, None
+
+    def _value_class(self, mod: ModuleSource, node: ast.AST
+                     ) -> Optional[str]:
+        """Class key a constructor call resolves to, or None (memoized
+        per (module, origin) — constructor origins repeat massively)."""
+        if not isinstance(node, ast.Call):
+            return None
+        origin = mod.imports.resolve(node.func)
+        if origin is None:
+            return None
+        memo_key = (mod.relpath, origin)
+        if memo_key in self._value_class_memo:
+            return self._value_class_memo[memo_key]
+        name = origin.split(".")[-1]
+        out = None
+        resolved = self.index.resolve_symbol(origin)
+        if resolved is not None:
+            tmod, sym = resolved
+            key = f"{mod_dotted(tmod)}.{sym}"
+            if key in self.classes or any(
+                    isinstance(n, ast.ClassDef) and n.name == sym
+                    for n in tmod.tree.body):
+                out = key
+        if out is None:
+            local = f"{mod_dotted(mod)}.{name}"
+            if local in self.classes:
+                out = local
+            else:
+                hits = [k for k, c in self.classes.items()
+                        if c.name == name]
+                out = hits[0] if len(hits) == 1 else None
+        self._value_class_memo[memo_key] = out
+        return out
+
+    def _scan_module_level(self, mod: ModuleSource) -> None:
+        md = mod_dotted(mod)
+        vtypes = self.module_var_types.setdefault(mod.relpath, {})
+        globals_assigned: dict[str, ast.AST] = {}
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                globals_assigned[node.targets[0].id] = node
+        # Assignments to declared globals inside functions count too
+        # (sched.engine's lazily-built executor). One pass over the
+        # cached flat walk: `global X` anywhere makes later `X = ...`
+        # assignments module-level for typing purposes.
+        gnames = {n for g in mod.walk_nodes()
+                  if isinstance(g, ast.Global) for n in g.names}
+        if gnames:
+            for st in mod.walk_nodes():
+                if isinstance(st, ast.Assign) \
+                        and len(st.targets) == 1 \
+                        and isinstance(st.targets[0], ast.Name) \
+                        and st.targets[0].id in gnames:
+                    globals_assigned.setdefault(st.targets[0].id, st)
+        for name, node in globals_assigned.items():
+            val = node.value
+            wrap_name = None
+            if isinstance(val, ast.Call):
+                val, wrap_name = self._unwrap(mod, val)
+            if not isinstance(val, ast.Call):
+                continue
+            origin = mod.imports.resolve(val.func) or ""
+            kind = _LOCK_ORIGINS.get(origin)
+            if kind is None and origin.split(".")[-1] in \
+                    {o.split(".")[-1] for o in _LOCK_ORIGINS} \
+                    and origin.startswith("threading"):
+                kind = "lock"
+            if kind is not None:
+                self.module_locks[f"{md}.{name}"] = LockDecl(
+                    id=f"{md}.{name}", kind=kind, mod=mod,
+                    line=node.lineno, wrap_name=wrap_name)
+                continue
+            if origin.split(".")[-1] == _EXECUTOR_SUFFIX:
+                self.module_executors[f"{md}.{name}"] = (mod, node.lineno)
+                continue
+            cls = self._value_class(mod, val)
+            if cls is not None:
+                vtypes[name] = cls
+
+    def _scan_class(self, mod: ModuleSource, node: ast.ClassDef) -> None:
+        md = mod_dotted(mod)
+        key = f"{md}.{node.name}"
+        ci = ClassInfo(key=key, name=node.name, mod=mod, node=node)
+        ci.bases = [mod.imports.resolve(b) or (dotted(b) or "")
+                    for b in node.bases]
+        for n in node.body:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ci.methods[n.name] = n
+            elif isinstance(n, ast.AnnAssign) \
+                    and isinstance(n.target, ast.Name):
+                # Dataclass fields: `done: Event = field(default_factory=
+                # threading.Event)` declares a safe-typed attr; a plain
+                # class-level annotation (`daemon_obj: ServeDaemon`)
+                # types the attr for call/access resolution.
+                if isinstance(n.value, ast.Call):
+                    val = n.value
+                    origin = mod.imports.resolve(val.func) or ""
+                    if origin.split(".")[-1] == "field":
+                        for kw in val.keywords:
+                            if kw.arg == "default_factory":
+                                fo = mod.imports.resolve(kw.value) or ""
+                                if fo in _SAFE_ORIGINS:
+                                    ci.safe_attrs.add(n.target.id)
+                    elif origin in _SAFE_ORIGINS:
+                        ci.safe_attrs.add(n.target.id)
+                ann = n.annotation
+                if isinstance(ann, (ast.Name, ast.Attribute)):
+                    d = dotted(ann)
+                    if d is not None:
+                        self._pending_attr_ann.append(
+                            (ci, n.target.id, d.split(".")[-1], mod))
+        params = {a.arg for meth in ci.methods.values()
+                  if meth.name == "__init__"
+                  for a in meth.args.args + meth.args.kwonlyargs}
+        for meth in ci.methods.values():
+            for st in ast.walk(meth):
+                if isinstance(st, ast.Assign):
+                    targets = st.targets
+                elif isinstance(st, ast.AnnAssign) \
+                        and st.value is not None:
+                    targets = [st.target]
+                else:
+                    continue
+                tgt_attrs = [d.split(".")[1] for t in targets
+                             for d in [dotted(t)]
+                             if d and d.startswith("self.")
+                             and len(d.split(".")) == 2]
+                if not tgt_attrs:
+                    continue
+                val = st.value
+                wrap_name = None
+                if isinstance(val, ast.Call):
+                    val, wrap_name = self._unwrap(mod, val)
+                if isinstance(val, ast.Name) and meth.name == "__init__" \
+                        and val.id in params:
+                    # Injected dependency (obs/metrics.py's shared
+                    # instrument lock): identity unknown until an
+                    # alias-of annotation unifies it.
+                    from ..astutil import LOCKISH_RE
+
+                    for attr in tgt_attrs:
+                        if LOCKISH_RE.search(attr):
+                            ci.locks[attr] = LockDecl(
+                                id=f"{key}.{attr}", kind="injected",
+                                mod=mod, line=st.lineno)
+                    continue
+                if not isinstance(val, ast.Call):
+                    continue
+                origin = mod.imports.resolve(val.func) or ""
+                kind = _LOCK_ORIGINS.get(origin)
+                for attr in tgt_attrs:
+                    if kind is not None:
+                        ci.locks[attr] = LockDecl(
+                            id=f"{key}.{attr}", kind=kind, mod=mod,
+                            line=st.lineno, wrap_name=wrap_name)
+                    elif origin in _SAFE_ORIGINS:
+                        ci.safe_attrs.add(attr)
+                        if origin.startswith("queue."):
+                            ci.queue_attrs.add(attr)
+                    elif origin in _THREAD_ORIGINS:
+                        for kw in val.keywords:
+                            if kw.arg == "target":
+                                t = dotted(kw.value) or ""
+                                if t.startswith("self."):
+                                    ci.thread_attrs[attr] = \
+                                        t.split(".", 1)[1]
+                    elif origin.split(".")[-1] == _EXECUTOR_SUFFIX:
+                        ci.executor_attrs.add(attr)
+                    else:
+                        cls = self._value_class(mod, val)
+                        if cls is not None:
+                            ci.attr_types[attr] = cls
+                # Registry inserts: self._reg[k] = <ClassName(...)> or a
+                # local previously typed (handled again in body pass).
+            # Registry element types: `self._reg[k] = ClassName(...)`
+            # directly, or through a method-local first
+            # (`sess = ServeSession(...); self._sessions[sess.id] =
+            # sess` — the SessionManager idiom).
+            meth_locals: dict[str, str] = {}
+            for st in ast.walk(meth):
+                if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                        and isinstance(st.targets[0], ast.Name):
+                    cls = self._value_class(mod, st.value)
+                    if cls is not None:
+                        meth_locals[st.targets[0].id] = cls
+            for st in ast.walk(meth):
+                if isinstance(st, ast.Assign):
+                    for t in st.targets:
+                        if isinstance(t, ast.Subscript):
+                            d = dotted(t.value)
+                            if d and d.startswith("self.") \
+                                    and len(d.split(".")) == 2:
+                                cls = self._value_class(mod, st.value)
+                                if cls is None and isinstance(
+                                        st.value, ast.Name):
+                                    cls = meth_locals.get(st.value.id)
+                                if cls is not None:
+                                    ci.elem_types[d.split(".")[1]] = cls
+        self.classes[key] = ci
+        for mname, meth in ci.methods.items():
+            fk = f"{key}.{mname}"
+            self.functions[fk] = FuncInfo(key=fk, mod=mod, node=meth,
+                                          cls=key)
+
+    def _mark_handlers(self) -> None:
+        """Classes whose base chain reaches *RequestHandler serve each
+        request on its own thread (ThreadingHTTPServer)."""
+        def is_handler(key: str, seen: set) -> bool:
+            ci = self.classes.get(key)
+            if ci is None or key in seen:
+                return False
+            seen.add(key)
+            for b in ci.bases:
+                if b.split(".")[-1].endswith("RequestHandler"):
+                    return True
+                base_key = self._class_by_name(b.split(".")[-1], ci.mod)
+                if base_key and is_handler(base_key, seen):
+                    return True
+            return False
+
+        for key, ci in self.classes.items():
+            ci.handler = is_handler(key, set())
+
+    def _scan_functions(self, mod: ModuleSource) -> None:
+        md = mod_dotted(mod)
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fk = f"{md}.{node.name}"
+                self.functions[fk] = FuncInfo(key=fk, mod=mod, node=node,
+                                              cls=None)
+        # Factory return types (one pattern each): `return ClassName(...)`
+        # and `return <module var of known type>`.
+        for fi in list(self.functions.values()):
+            if fi.mod is not mod or fi.returns_cls is not None:
+                continue
+            vtypes = self.module_var_types.get(mod.relpath, {})
+            for ret in fi.same_scope():
+                if not isinstance(ret, ast.Return) or ret.value is None:
+                    continue
+                cls = self._value_class(mod, ret.value)
+                if cls is None and isinstance(ret.value, ast.Name):
+                    cls = vtypes.get(ret.value.id)
+                if cls is not None:
+                    fi.returns_cls = cls
+                    break
+
+    def _fn_key_of_def(self, mod: ModuleSource, node: ast.AST) -> str:
+        from ..astutil import enclosing_class
+
+        cls = enclosing_class(node)
+        md = mod_dotted(mod)
+        if cls is not None:
+            return f"{md}.{cls.name}.{node.name}"
+        return f"{md}.{node.name}"
+
+    # -- roots --------------------------------------------------------------
+
+    def _method_key(self, cls_key: str, name: str,
+                    seen: Optional[set] = None) -> Optional[str]:
+        """Resolve a method through the in-model base chain."""
+        seen = seen or set()
+        if cls_key in seen:
+            return None
+        seen.add(cls_key)
+        ci = self.classes.get(cls_key)
+        if ci is None:
+            return None
+        if name in ci.methods:
+            return f"{cls_key}.{name}"
+        for b in ci.bases:
+            bk = self._class_by_name(b.split(".")[-1], ci.mod)
+            if bk:
+                hit = self._method_key(bk, name, seen)
+                if hit:
+                    return hit
+        return None
+
+    def _build_roots(self) -> None:
+        for key, ci in self.classes.items():
+            for attr, target in sorted(ci.thread_attrs.items()):
+                entry = self._method_key(key, target)
+                if entry:
+                    self.roots[f"thread:{key}.{target}"] = (entry, False)
+            if ci.handler:
+                for mname in sorted(ci.methods):
+                    if mname.startswith("do_") or mname == "handle":
+                        self.roots[f"handler:{key}"] = \
+                            (f"{key}.{mname}", True)
+                        break
+        # executor.submit(fn, ...) sites — the submitted callable runs
+        # on a pool thread.
+        for fi in list(self.functions.values()):
+            for call in fi.same_scope():
+                if not (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "submit" and call.args):
+                    continue
+                target = self._callable_key(fi, call.args[0])
+                if target is not None:
+                    self.roots[f"executor:{target}"] = (target, True)
+
+    def _callable_key(self, fi: FuncInfo, node: ast.AST) -> Optional[str]:
+        d = dotted(node)
+        if d is None:
+            return None
+        if d.startswith("self.") and fi.cls:
+            return self._method_key(fi.cls, d.split(".", 1)[1])
+        md = mod_dotted(fi.mod)
+        if f"{md}.{d}" in self.functions:
+            return f"{md}.{d}"
+        origin = fi.mod.imports.resolve(node)
+        return self._resolve_function(fi.mod, origin)
+
+    def _resolve_function(self, mod: ModuleSource,
+                          origin: Optional[str],
+                          depth: int = 0) -> Optional[str]:
+        if not origin or depth > 4:
+            return None
+        resolved = self.index.resolve_symbol(origin)
+        if resolved is not None:
+            tmod, sym = resolved
+            key = f"{mod_dotted(tmod)}.{sym}"
+            if key in self.functions:
+                return key
+            # Re-export hops (obs/__init__ re-exports export.subscribe),
+            # depth-bounded: import cycles must not recurse forever.
+            hop = tmod.imports.names.get(sym)
+            if hop and hop != origin:
+                return self._resolve_function(tmod, hop, depth + 1)
+        # Unique bare name among module-level functions (the
+        # `from . import get_metrics` shape resolves to a bare name).
+        bare = origin.split(".")[-1]
+        hits = [k for k, f in self.functions.items()
+                if f.cls is None and k.split(".")[-1] == bare]
+        return hits[0] if len(hits) == 1 else None
+
+    # -- body analysis ------------------------------------------------------
+
+    def _lock_id_of_expr(self, fi: FuncInfo, node: ast.AST
+                         ) -> Optional[str]:
+        d = dotted(node)
+        if d is None and isinstance(node, ast.Call):
+            d = dotted(node.func)
+        if d is None:
+            return None
+        if d.startswith("self.") and fi.cls:
+            attr = d.split(".")[1]
+            ci = self.classes.get(fi.cls)
+            if ci is None:
+                return None
+            if attr in ci.alias:
+                return ci.alias[attr]
+            if attr in ci.locks:
+                return ci.locks[attr].id
+            return None
+        mid = f"{mod_dotted(fi.mod)}.{d}"
+        return mid if mid in self.module_locks else None
+
+    def _held_at(self, fi: FuncInfo, node: ast.AST) -> frozenset:
+        held = []
+        for a in ancestors_same_scope(node):
+            if isinstance(a, ast.With):
+                for item in a.items:
+                    lid = self._lock_id_of_expr(fi, item.context_expr)
+                    if lid is not None:
+                        held.append(lid)
+        return frozenset(held)
+
+    def _local_types(self, fi: FuncInfo) -> dict[str, str]:
+        """Flow-insensitive local-variable class types for one function:
+        constructor calls, typed factory calls, typed attrs, registry
+        get/pop, iteration over typed registries, annotated params."""
+        ci = self.classes.get(fi.cls) if fi.cls else None
+        vtypes = self.module_var_types.get(fi.mod.relpath, {})
+        out: dict[str, str] = {}
+
+        def ann_class(ann: Optional[ast.AST]) -> Optional[str]:
+            if ann is None:
+                return None
+            if isinstance(ann, ast.Subscript):   # list[X] / Optional[X]
+                return ann_class(ann.slice)
+            if isinstance(ann, (ast.Name, ast.Attribute)):
+                d = dotted(ann)
+                if d:
+                    return self._class_by_name(d.split(".")[-1], fi.mod)
+            if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                return self._class_by_name(ann.value.split(".")[-1],
+                                           fi.mod)
+            return None
+
+        args = fi.node.args
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            cls = ann_class(a.annotation)
+            if cls:
+                out[a.arg] = cls
+
+        def expr_type(val: ast.AST) -> Optional[str]:
+            cls = self._value_class(fi.mod, val)
+            if cls:
+                return cls
+            if isinstance(val, ast.Call):
+                callee = self._resolve_call(fi, val, out)
+                if callee:
+                    rfi = self.functions.get(callee)
+                    if rfi is not None and rfi.returns_cls:
+                        return rfi.returns_cls
+                # registry get/pop on a typed self attr
+                if isinstance(val.func, ast.Attribute) \
+                        and val.func.attr in ("get", "pop"):
+                    d = dotted(val.func.value)
+                    if d and d.startswith("self.") and ci is not None:
+                        return ci.elem_types.get(d.split(".")[1])
+            d = dotted(val)
+            if d and d.startswith("self.") and ci is not None \
+                    and len(d.split(".")) == 2:
+                return ci.attr_types.get(d.split(".")[1])
+            if isinstance(val, ast.Name):
+                return vtypes.get(val.id)
+            return None
+
+        def bind_iteration(target: ast.AST, it: ast.AST) -> None:
+            recv = None
+            if isinstance(it, ast.Call) \
+                    and isinstance(it.func, ast.Attribute) \
+                    and it.func.attr in ("values", "items"):
+                recv = dotted(it.func.value)
+            if recv and recv.startswith("self.") and ci is not None:
+                elem = ci.elem_types.get(recv.split(".")[1])
+                if elem:
+                    if isinstance(target, ast.Name):
+                        out[target.id] = elem
+                    elif isinstance(target, ast.Tuple) and target.elts \
+                            and isinstance(target.elts[-1], ast.Name):
+                        out[target.elts[-1].id] = elem
+
+        for st in fi.same_scope():
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                t = expr_type(st.value)
+                if t:
+                    out[st.targets[0].id] = t
+            elif isinstance(st, (ast.ListComp, ast.SetComp,
+                                 ast.GeneratorExp, ast.DictComp)):
+                for gen in st.generators:
+                    bind_iteration(gen.target, gen.iter)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                it = st.iter
+                recv = None
+                if isinstance(it, ast.Call) \
+                        and isinstance(it.func, ast.Attribute) \
+                        and it.func.attr in ("values", "items"):
+                    recv = dotted(it.func.value)
+                elif isinstance(it, ast.Call) \
+                        and isinstance(it.func, ast.Name) \
+                        and it.func.id == "zip" and it.args:
+                    recv = None     # handled by param annotations mostly
+                d = recv
+                if d and d.startswith("self.") and ci is not None:
+                    elem = ci.elem_types.get(d.split(".")[1])
+                    if elem:
+                        tgt = st.target
+                        if isinstance(tgt, ast.Name):
+                            out[tgt.id] = elem
+                        elif isinstance(tgt, ast.Tuple) and tgt.elts \
+                                and isinstance(tgt.elts[-1], ast.Name):
+                            out[tgt.elts[-1].id] = elem
+                # `for x in batch:` with batch: list[Cls]
+                if isinstance(it, ast.Name) and it.id in out \
+                        and isinstance(st.target, ast.Name):
+                    out[st.target.id] = out[it.id]
+                if isinstance(it, ast.Call) \
+                        and isinstance(it.func, ast.Name) \
+                        and it.func.id == "zip":
+                    srcs = [a for a in it.args]
+                    if isinstance(st.target, ast.Tuple) \
+                            and len(st.target.elts) == len(srcs):
+                        for tgt, src in zip(st.target.elts, srcs):
+                            if isinstance(tgt, ast.Name) \
+                                    and isinstance(src, ast.Name) \
+                                    and src.id in out:
+                                out[tgt.id] = out[src.id]
+        return out
+
+    def _resolve_call(self, fi: FuncInfo, call: ast.Call,
+                      ltypes: dict[str, str]) -> Optional[str]:
+        func = call.func
+        # super().m()
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Call) \
+                and isinstance(func.value.func, ast.Name) \
+                and func.value.func.id == "super" and fi.cls:
+            ci = self.classes.get(fi.cls)
+            for b in (ci.bases if ci else []):
+                bk = self._class_by_name(b.split(".")[-1], fi.mod)
+                if bk:
+                    hit = self._method_key(bk, func.attr)
+                    if hit:
+                        return hit
+            return None
+        # f(...).m(...) — chained through the inner call's return type
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Call):
+            inner = self._resolve_call(fi, func.value, ltypes)
+            if inner:
+                rfi = self.functions.get(inner)
+                if rfi is not None and rfi.returns_cls:
+                    return self._method_key(rfi.returns_cls, func.attr)
+            cls = self._value_class(fi.mod, func.value)
+            if cls:
+                return self._method_key(cls, func.attr)
+            return None
+        d = dotted(func)
+        if d is None:
+            return None
+        parts = d.split(".")
+        ci = self.classes.get(fi.cls) if fi.cls else None
+        if parts[0] == "self" and ci is not None:
+            if len(parts) == 2:
+                return self._method_key(fi.cls, parts[1])
+            if len(parts) == 3:
+                owner = ci.attr_types.get(parts[1])
+                if owner:
+                    return self._method_key(owner, parts[2])
+            return None
+        if len(parts) == 2:
+            if parts[0] in ltypes:
+                return self._method_key(ltypes[parts[0]], parts[1])
+            vtypes = self.module_var_types.get(fi.mod.relpath, {})
+            if parts[0] in vtypes:
+                return self._method_key(vtypes[parts[0]], parts[1])
+        if len(parts) == 3 and parts[0] in ltypes:
+            # d.sessions.open(...) — typed local, one owned-attr hop.
+            mid = self.classes.get(ltypes[parts[0]])
+            if mid is not None:
+                owner = mid.attr_types.get(parts[1])
+                if owner:
+                    return self._method_key(owner, parts[2])
+        # module function / constructor / imported symbol
+        origin = fi.mod.imports.resolve(func)
+        cls = self._value_class(fi.mod, call)
+        if cls is not None:
+            return self._method_key(cls, "__init__") or f"{cls}.__init__"
+        md = mod_dotted(fi.mod)
+        if len(parts) == 1 and f"{md}.{d}" in self.functions:
+            return f"{md}.{d}"
+        return self._resolve_function(fi.mod, origin)
+
+    def _analyze_bodies(self) -> None:
+        for fi in self.functions.values():
+            self._analyze_one(fi)
+
+    def _analyze_one(self, fi: FuncInfo) -> None:
+        from ..astutil import statement_of
+
+        ci = self.classes.get(fi.cls) if fi.cls else None
+        ltypes = fi.ltypes = self._local_types(fi)
+        in_init = fi.node.name == "__init__"
+        # join line: `self.<thread attr>.join()` (or `.shutdown()`).
+        for call in fi.same_scope():
+            if isinstance(call, ast.Call) \
+                    and isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in ("join", "shutdown"):
+                d = dotted(call.func.value)
+                if d and d.startswith("self.") and ci is not None \
+                        and (d.split(".")[1] in ci.thread_attrs
+                             or d.split(".")[1] in ci.executor_attrs):
+                    fi.join_line = min(fi.join_line or call.lineno,
+                                       call.lineno)
+
+        def after_join(node: ast.AST) -> bool:
+            return fi.join_line is not None \
+                and getattr(node, "lineno", 0) > fi.join_line
+
+        def stmt_hb(node: ast.AST) -> bool:
+            st = statement_of(node)
+            return (fi.mod.relpath, getattr(st, "lineno", -1)) \
+                in self.hb_stmts
+
+        def record_access(owner: Optional[str], attr: str, write: bool,
+                          node: ast.AST) -> None:
+            if owner is None or owner not in self.classes:
+                return
+            self.accesses.append(Access(
+                owner=owner, attr=attr, write=write, mod=fi.mod,
+                node=node, fn=fi.key, locks=self._held_at(fi, node),
+                in_init=in_init, after_join=after_join(node),
+                hb=stmt_hb(node)))
+
+        def owner_of(base: ast.AST) -> tuple[Optional[str], Optional[str]]:
+            """(owning class key, attr) for an attribute chain's first
+            hop: self.X, typed-local.X, typed self.attr.X."""
+            d = dotted(base)
+            if d is None:
+                return None, None
+            parts = d.split(".")
+            if parts[0] == "self" and fi.cls:
+                if len(parts) == 2:
+                    return fi.cls, parts[1]
+                if len(parts) == 3 and ci is not None:
+                    owner = ci.attr_types.get(parts[1])
+                    if owner:
+                        return owner, parts[2]
+                return None, None
+            if len(parts) == 2 and parts[0] in ltypes:
+                return ltypes[parts[0]], parts[1]
+            return None, None
+
+        from ..rules.shared_state import _MUTATORS
+
+        for node in fi.same_scope():
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                tgts = (node.targets if isinstance(node, ast.Assign)
+                        else [node.target])
+                for t in tgts:
+                    base = t.value if isinstance(t, ast.Subscript) else t
+                    owner, attr = owner_of(base)
+                    if attr:
+                        record_access(owner, attr, True, node)
+                if isinstance(node, ast.AugAssign):
+                    owner, attr = owner_of(node.target)
+                    if attr:
+                        record_access(owner, attr, True, node)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    base = t.value if isinstance(t, ast.Subscript) else t
+                    owner, attr = owner_of(base)
+                    if attr:
+                        record_access(owner, attr, True, node)
+            elif isinstance(node, ast.Call):
+                locks = self._held_at(fi, node)
+                callee = self._resolve_call(fi, node, ltypes)
+                if callee is not None:
+                    fi.calls.append((callee, locks, after_join(node),
+                                     node))
+                if isinstance(node.func, ast.Attribute):
+                    if node.func.attr in _MUTATORS:
+                        owner, attr = owner_of(node.func.value)
+                        if attr:
+                            record_access(owner, attr, True, node)
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(getattr(node, "ctx", None), ast.Load):
+                owner, attr = owner_of(node)
+                if attr:
+                    # Skip the method-call receiver itself: reading
+                    # `self._q` to call .put on it is use, not shared-
+                    # state access of a plain field (safe attrs filter
+                    # later anyway); plain loads are what we want.
+                    record_access(owner, attr, False, node)
+
+    def _detect_blocking(self) -> None:
+        """Second pass (needs every FuncInfo.calls populated for the
+        interprocedural closure): blocking calls made while a modeled
+        lock is syntactically held — JTL504's input."""
+        for fi in self.functions.values():
+            if isinstance(fi.node, ast.AsyncFunctionDef):
+                continue
+            for node in fi.same_scope():
+                if not isinstance(node, ast.Call):
+                    continue
+                locks = self._held_at(fi, node)
+                if not locks:
+                    continue
+                what = self._blocking_what(fi, node, fi.ltypes, locks)
+                if what is not None:
+                    self.blocking.append(BlockingCall(
+                        fn=fi.key, mod=fi.mod, node=node, what=what,
+                        locks=locks))
+
+    def _direct_block_label(self, fi: FuncInfo, call: ast.Call,
+                            locks: frozenset) -> Optional[str]:
+        """Label when this call is a blocking PRIMITIVE (no call-graph
+        recursion); None otherwise."""
+        ci = self.classes.get(fi.cls) if fi.cls else None
+        f = call.func
+        origin = fi.mod.imports.resolve(f) or ""
+        tail = origin.split(".")[-1]
+        if tail == "urlopen" or origin.startswith("urllib.request"):
+            return "urllib.request.urlopen"
+        if origin in ("time.sleep",):
+            return "time.sleep"
+        if origin.startswith("subprocess.") \
+                and tail in _BLOCKING_SUBPROC:
+            return origin
+        if not isinstance(f, ast.Attribute):
+            return None
+        d = dotted(f.value)
+        attr_of_self = d.split(".")[1] if d and d.startswith("self.") \
+            and len(d.split(".")) == 2 and ci is not None else None
+        if f.attr == "get":
+            # Only queue-typed receivers count (never dict.get, never
+            # ContextVar.get); put on this codebase's unbounded queues
+            # cannot block, so only the consuming side is flagged.
+            if attr_of_self and attr_of_self in (ci.queue_attrs if ci
+                                                 else ()):
+                return "Queue.get"
+            return None
+        if f.attr == "wait":
+            lid = self._lock_id_of_expr(fi, f.value)
+            if lid is not None and lid in locks:
+                return None         # Condition.wait on the held lock
+            if lid is not None or (attr_of_self and ci
+                                   and attr_of_self in ci.safe_attrs):
+                return "Event/Condition.wait"
+            return None
+        if f.attr == "acquire":
+            lid = self._lock_id_of_expr(fi, f.value)
+            if lid is not None and lid not in locks:
+                return "lock.acquire"
+            return None
+        if f.attr in _BLOCKING_METHODS:
+            if f.attr == "result":
+                return "Future.result"
+            if f.attr == "join":
+                # str.join is ubiquitous: require a thread-ish receiver.
+                if (attr_of_self and ci
+                        and (attr_of_self in ci.thread_attrs
+                             or attr_of_self in ci.executor_attrs)) \
+                        or (d and "thread" in d.lower()):
+                    return "Thread.join"
+            return None
+        return None
+
+    def _blocking_what(self, fi: FuncInfo, call: ast.Call,
+                       ltypes: dict[str, str],
+                       locks: frozenset) -> Optional[str]:
+        """Label when this call can block (primitive or through a
+        resolvable callee whose closure blocks); None otherwise."""
+        label = self._direct_block_label(fi, call, locks)
+        if label is not None:
+            return label
+        callee = self._resolve_call(fi, call, ltypes)
+        if callee is not None and self._callee_blocks(callee):
+            return f"{callee}() [blocks inside]"
+        return None
+
+    def _callee_blocks(self, fn_key: str, depth: int = 0,
+                       seen: Optional[set] = None) -> bool:
+        if depth > 3:
+            return False
+        memo = self._blocks_memo.get(fn_key)
+        if memo is not None:
+            return memo
+        seen = seen or set()
+        if fn_key in seen:
+            return False
+        seen.add(fn_key)
+        fi = self.functions.get(fn_key)
+        if fi is None:
+            return False
+        out = False
+        for call in fi.same_scope():
+            if isinstance(call, ast.Call) and self._direct_block_label(
+                    fi, call, self._held_at(fi, call)) is not None:
+                out = True
+                break
+        if not out:
+            for callee, _locks, _aj, _node in fi.calls:
+                if self._callee_blocks(callee, depth + 1, seen):
+                    out = True
+                    break
+        if not out and depth > 0:
+            # Depth-truncated negatives are not cacheable (a deeper
+            # start could still find the block); positives always are.
+            return out
+        self._blocks_memo[fn_key] = out
+        return out
+
+    # -- interprocedural lockset credit -------------------------------------
+
+    def _propagate_caller_locks(self) -> None:
+        """A private function whose EVERY in-model call site holds lock
+        L is analyzed as holding L (obs/health._transition's "caller
+        holds the lock" contract). One level, write-credited into the
+        recorded accesses."""
+        callers: dict[str, list[frozenset]] = {}
+        for fi in self.functions.values():
+            for callee, locks, _aj, _node in fi.calls:
+                callers.setdefault(callee, []).append(locks)
+        credit: dict[str, frozenset] = {}
+        for fn_key, locksets in callers.items():
+            name = fn_key.split(".")[-1]
+            if not name.startswith("_") or name.startswith("__"):
+                continue
+            common = frozenset.intersection(*locksets) if locksets \
+                else frozenset()
+            if common:
+                credit[fn_key] = common
+        if not credit:
+            return
+        for acc in self.accesses:
+            extra = credit.get(acc.fn)
+            if extra:
+                acc.locks = acc.locks | extra
+
+    # -- closures -----------------------------------------------------------
+
+    def _build_closures(self) -> None:
+        for root, (entry, _multi) in self.roots.items():
+            seen: set[str] = set()
+            frontier = [entry]
+            while frontier:
+                cur = frontier.pop()
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                fi = self.functions.get(cur)
+                if fi is None:
+                    continue
+                for callee, _locks, after_join, _node in fi.calls:
+                    if not after_join:
+                        frontier.append(callee)
+            self.closures[root] = seen
+
+    # -- lock order ---------------------------------------------------------
+
+    def _acq_closure(self, fn_key: str) -> set[str]:
+        return self._acq_star.get(fn_key, set())
+
+    def _compute_acq_star(self) -> None:
+        """May-acquire closure per function: fixpoint of
+        acq*(f) = acquires(f) ∪ ⋃ acq*(callees) over the call graph
+        (cycle-safe, whole-graph — replaces a per-call-site recursion
+        that dominated the model's wall time)."""
+        star = {k: set(fi.acquires) for k, fi in self.functions.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k, fi in self.functions.items():
+                cur = star[k]
+                for callee, _locks, _aj, _node in fi.calls:
+                    extra = star.get(callee)
+                    if extra and not extra <= cur:
+                        cur |= extra
+                        changed = True
+        self._acq_star = star
+
+    def _build_order_edges(self) -> None:
+        # Direct syntactic acquisitions per function.
+        for fi in self.functions.values():
+            for node in fi.same_scope():
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        lid = self._lock_id_of_expr(fi, item.context_expr)
+                        if lid is not None:
+                            fi.acquires.add(lid)
+        # with a: with b: nesting + with a, b: items.
+        for fi in self.functions.values():
+            for node in fi.same_scope():
+                if not isinstance(node, ast.With):
+                    continue
+                ids = [lid for item in node.items
+                       for lid in
+                       [self._lock_id_of_expr(fi, item.context_expr)]
+                       if lid is not None]
+                for outer, inner in zip(ids, ids[1:]):
+                    self.order_edges.setdefault(
+                        (outer, inner), (fi.mod, node.lineno, False))
+                if not ids:
+                    continue
+                held = self._held_at(fi, node)
+                for outer in held:
+                    for inner in ids:
+                        self.order_edges.setdefault(
+                            (outer, inner), (fi.mod, node.lineno, False))
+        # Calls while holding: held x callee acquisition closure. A
+        # callee in the HOLDER'S OWN class is JTL201's same-class-call
+        # territory; everything else is marked via_call=True for
+        # JTL502's exclusive jurisdiction.
+        self._compute_acq_star()
+        for fi in self.functions.values():
+            for callee, locks, _aj, node in fi.calls:
+                if not locks:
+                    continue
+                same_class = fi.cls is not None \
+                    and callee.rsplit(".", 1)[0] == fi.cls
+                for inner in sorted(self._acq_closure(callee)):
+                    for outer in locks:
+                        self.order_edges.setdefault(
+                            (outer, inner),
+                            (fi.mod, node.lineno, not same_class))
+
+    # -- contract view ------------------------------------------------------
+
+    def contract_section(self) -> dict:
+        """The deterministic `sync` section for contracts.json: locks,
+        thread roots, each shared structure's guarding lock + the
+        threads that touch it, and the may-happen lock-order edges."""
+        locks = dict(sorted(self.lock_ids().items()))
+        threads = {root: entry for root, (entry, _m)
+                   in sorted(self.roots.items())}
+        guarded: dict[str, dict] = {}
+        # ONE eligibility walk (iter_shared_attrs — the JTL501 rule's
+        # exact input), so the contract can never desynchronize from
+        # what the race rule actually checks.
+        for owner, attr, sites in iter_shared_attrs(self):
+            ci = self.classes[owner]
+            decl = self.guarded.get((owner, attr))
+            if decl is None and not any(a.write for a in sites):
+                continue        # read-only post-init: not a structure
+                                # anything needs guarding
+            if decl is None and attr in ci.attr_types:
+                continue        # owned-object handles: lifecycle state,
+                                # not a guarded structure
+            common = frozenset.intersection(*[a.locks for a in sites])
+            lock = decl[0] if decl else (sorted(common)[0] if common
+                                         else None)
+            if lock is None:
+                continue
+            roots = sorted({r for a in sites for r in self.sides_of(a.fn)})
+            if not roots and not decl:
+                continue
+            guarded[f"{owner}.{attr}"] = {"lock": lock, "threads": roots}
+        order = sorted([a, b] for a, b in self.order_edges)
+        return {"locks": locks, "threads": threads, "guarded": guarded,
+                "order": order}
+
+
+def sync_model(index: FlowIndex) -> SyncModel:
+    """Extract (and memoize on the index) the concurrency model."""
+    cached = getattr(index, "_sync", None)
+    if cached is None:
+        cached = SyncModel(index)
+        index._sync = cached
+    return cached
+
+
+def iter_shared_attrs(model: SyncModel) -> Iterator[tuple]:
+    """(owner class key, attr, non-init/non-joined/non-hb sites) for
+    every plain attribute the model saw accessed — the JTL501 walk."""
+    by_attr: dict[tuple[str, str], list[Access]] = {}
+    for acc in model.accesses:
+        by_attr.setdefault((acc.owner, acc.attr), []).append(acc)
+    for (owner, attr), accs in sorted(by_attr.items()):
+        ci = model.classes.get(owner)
+        if ci is None or ci.handler:
+            # Handler classes are instantiated per request: their attrs
+            # are thread-confined by construction (the shared state a
+            # handler touches lives on the daemon object, which IS
+            # modeled).
+            continue
+        if attr in ci.locks or attr in ci.alias or attr in ci.safe_attrs \
+                or attr in ci.thread_attrs or attr in ci.executor_attrs:
+            continue
+        sites = [a for a in accs
+                 if not a.in_init and not a.after_join and not a.hb]
+        if sites:
+            yield owner, attr, sites

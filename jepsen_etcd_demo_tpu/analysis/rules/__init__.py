@@ -8,6 +8,11 @@ Rule id blocks (doc/analysis.md has the full reference):
   JTL4xx — interprocedural flow rules over the jtflow contract graph
            (packed schemas, cross-module donation, sharding axes,
            resumable carries, metric contracts, contracts.json sync)
+  JTL5xx — jtsan: interprocedural happens-before / lock-set concurrency
+           analysis (lockset races, cross-module lock order,
+           check-then-act, blocking under lock, thread lifecycles,
+           sync contracts) cross-validated by the runtime sanitizer
+           (obs/sync.py)
   JTL000 — reserved: unparseable file (emitted by the engine itself)
 
 Adding a rule = one module here with a ``@register``-ed Rule subclass,
@@ -26,4 +31,5 @@ from . import limits_doc        # noqa: F401
 from . import lock_order        # noqa: F401
 from . import metric_name       # noqa: F401
 from . import shared_state      # noqa: F401
+from . import sync_rules        # noqa: F401
 from . import traced_branch     # noqa: F401

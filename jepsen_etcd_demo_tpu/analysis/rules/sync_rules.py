@@ -1,0 +1,693 @@
+"""JTL5xx — jtsan: interprocedural happens-before / lock-set analysis.
+
+Where JTL201/203 see one class in one file, these rules run over the
+whole-program ``SyncModel`` (analysis/flow/sync.py): thread spawn sites,
+executor submissions and HTTP handler classes become roots; the call
+graph carries lock-sets and reachability across modules; ``join()``
+and ``# jtsan:`` annotations contribute happens-before edges. The serve
+daemon (PR 13) is the motivating subject — a web of handler threads,
+one dispatch thread, stream consumer threads, and the obs pump sharing
+a dozen locks across six packages.
+
+  JTL501 lockset-race        a shared attribute whose access sites'
+                             lock-sets have an empty intersection — the
+                             Eraser discipline, compositional across
+                             modules (RacerD's ownership idiom via the
+                             "callers always hold" credit)
+  JTL502 cross-lock-order    lock-order cycles THROUGH call chains
+                             spanning modules (JTL201 only sees
+                             same-class nesting)
+  JTL503 check-then-act      read under a lock, decide, write under a
+                             LATER acquisition without re-validating —
+                             the admission/registry double-insert shape
+  JTL504 blocking-under-lock blocking primitives (Queue.get,
+                             future.result, Thread.join, HTTP waits)
+                             while holding a modeled lock, resolved
+                             through the call graph
+  JTL505 thread-lifecycle    a thread/executor-owning class (directly
+                             or through owned instances/registries)
+                             whose shutdown path never reaches a
+                             join/close for some source
+  JTL506 sync-contract       the ``# jtsan:`` annotation grammar and
+                             sanitizer wrap-names VERIFIED against the
+                             model; contracts.json must carry the
+                             ``sync`` section (content drift rides the
+                             JTL406 regenerate-and-diff gate)
+
+The runtime counterpart (obs/sync.py) records witnessed acquisition
+orders under JEPSEN_TPU_SYNC_TRACE=1; tests/test_jtsan.py cross-
+validates them against JTL502's edge set.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterator, Optional
+
+from ..astutil import dotted, walk_same_scope
+from ..core import PACKAGE_NAME, ProjectRule, register
+from ..findings import Finding
+from .shared_state import _MUTATORS
+
+
+class SyncRule(ProjectRule):
+    """Shared plumbing: one SyncModel per lint invocation, through the
+    engine's shared FlowIndex when provided."""
+
+    def _model(self, root: Path, ctx=None):
+        from ..flow.index import FlowIndex
+        from ..flow.sync import sync_model
+
+        index = None
+        if ctx is not None and hasattr(ctx, "flow_index"):
+            index = ctx.flow_index()
+        if index is None:
+            index = FlowIndex.build(Path(root))
+        return sync_model(index)
+
+    def check_project(self, root: Path, ctx=None) -> list[Finding]:
+        return list(self._check(self._model(root, ctx)))
+
+    def _check(self, model) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+def _fmt_locks(locks) -> str:
+    return ", ".join(sorted(locks)) if locks else "no lock"
+
+
+def _fmt_sides(sides) -> str:
+    return ", ".join(sorted(sides)) if sides else "caller threads"
+
+
+@register
+class LocksetRaceRule(SyncRule):
+    id = "JTL501"
+    name = "lockset-race"
+    scopes = None
+    rationale = (
+        "PR 13 turned the harness into one process full of handler "
+        "threads, a dispatch thread, stream consumers and the obs pump; "
+        "JTL203 only sees a single class spawning its own thread. An "
+        "attribute reachable from two threads whose access sites share "
+        "no lock (and no happens-before edge) is a data race — the "
+        "Eraser lock-set discipline, applied across modules")
+    hint = ("hold the structure's one guarding lock at every access "
+            "site (route reads through a locked stats()/snapshot "
+            "reader), hand the data across on a queue, or order the "
+            "sides with an Event/join and annotate it (# jtsan: hb=)")
+
+    def _check(self, model) -> Iterator[Finding]:
+        from ..flow.sync import iter_shared_attrs
+
+        for owner, attr, sites in iter_shared_attrs(model):
+            ci = model.classes[owner]
+            decl = model.guarded.get((owner, attr))
+            if decl is not None:
+                lid, _line = decl
+                bad = sorted((s for s in sites if lid not in s.locks),
+                             key=lambda s: (s.mod.relpath,
+                                            s.node.lineno))
+                if bad:
+                    s = bad[0]
+                    yield s.mod.finding(
+                        self, s.node,
+                        f"{ci.name}.{attr} is annotated "
+                        f"`# jtsan: guarded-by={lid.split('.')[-1]}` "
+                        f"but {s.fn.split('.')[-1]}() "
+                        f"{'writes' if s.write else 'reads'} it holding "
+                        f"{_fmt_locks(s.locks)} — the declared guard is "
+                        f"broken")
+                continue
+            writes = [s for s in sites if s.write]
+            if not writes:
+                continue
+            side_of = {id(s): model.sides_of(s.fn) for s in sites}
+            all_sides = set().union(*side_of.values())
+            outside = [s for s in sites if not side_of[id(s)]]
+            if not all_sides:
+                continue
+            if len(all_sides) == 1 and not outside:
+                continue            # single-threaded closure
+            common = frozenset.intersection(*[s.locks for s in sites])
+            if common:
+                continue
+            locked = [s for s in sites if s.locks]
+            if locked:
+                bad = sorted((s for s in sites if not s.locks),
+                             key=lambda s: (not s.write,
+                                            s.mod.relpath,
+                                            s.node.lineno))
+                if not bad:
+                    # Divergent but every site locked: report the first
+                    # write (two disjoint locks guard nothing).
+                    bad = sorted(writes, key=lambda s: (s.mod.relpath,
+                                                        s.node.lineno))
+                s = bad[0]
+                others = sorted({lk for o in sites if o.locks
+                                 for lk in o.locks})
+                yield s.mod.finding(
+                    self, s.node,
+                    f"{ci.name}.{attr} is guarded by "
+                    f"{', '.join(others)} on other paths, but "
+                    f"{s.fn.split('.')[-1]}() "
+                    f"{'writes' if s.write else 'reads'} it holding "
+                    f"{_fmt_locks(s.locks)} (threads: "
+                    f"{_fmt_sides(all_sides)}) — no common lock-set, a "
+                    f"cross-thread race")
+            else:
+                write_roots = set().union(
+                    *[side_of[id(s)] for s in writes])
+                if len(write_roots) < 2:
+                    continue        # caller-vs-own-thread is JTL203's
+                s = sorted(writes, key=lambda x: (x.mod.relpath,
+                                                  x.node.lineno))[0]
+                yield s.mod.finding(
+                    self, s.node,
+                    f"{ci.name}.{attr} is mutated from two threads "
+                    f"({_fmt_sides(write_roots)}) with no lock at any "
+                    f"site and no happens-before edge — a cross-module "
+                    f"data race")
+
+
+@register
+class CrossLockOrderRule(SyncRule):
+    id = "JTL502"
+    name = "cross-lock-order"
+    scopes = None
+    rationale = (
+        "JTL201 sees with-nesting inside one class; the serve->sched->"
+        "obs call paths hold one module's lock while acquiring "
+        "another's, which is exactly where an acquisition-order cycle "
+        "would hide — two threads taking opposite ends deadlock the "
+        "daemon, and nothing in-process can recover it")
+    hint = ("pick one global acquisition order (document it in the "
+            "contracts sync section) and restructure the out-of-order "
+            "path — release before calling across modules, or snapshot "
+            "under the inner lock first")
+
+    def _check(self, model) -> Iterator[Finding]:
+        graph: dict[str, set[str]] = {}
+        for a, b in model.order_edges:
+            graph.setdefault(a, set()).add(b)
+        lock_mods = model.lock_modules()
+        reported: set[tuple] = set()
+        for (a, b), (mod, line, via_call) in sorted(
+                model.order_edges.items(),
+                key=lambda kv: (kv[1][0].relpath, kv[1][1])):
+            if a == b:
+                # Nest/same-class self-edges are JTL201's
+                # self-deadlock finding; a re-acquisition through a
+                # call CHAIN (any other class or module) is ours —
+                # JTL201 cannot follow the call.
+                if not via_call:
+                    continue
+                if (a,) not in reported:
+                    reported.add((a,))
+                    yield mod.finding(
+                        self, line,
+                        f"lock {a} re-acquired through a call chain "
+                        f"while already held — self-deadlock on a "
+                        f"non-reentrant lock")
+                continue
+            path = self._find_path(graph, b, a)
+            if path is None:
+                continue
+            cycle = [a] + path
+            key = tuple(sorted(set(cycle)))
+            if key in reported:
+                continue
+            # JTL201's jurisdiction: a cycle made ONLY of direct/
+            # same-class nesting whose locks all live in one module
+            # (the declaring modules — parsing them back out of the id
+            # would mis-split module-level lock ids). Anything with a
+            # call-chain edge, or spanning modules, is ours.
+            edges = list(zip(cycle, cycle[1:]))
+            any_call = any(model.order_edges[e][2] for e in edges
+                           if e in model.order_edges)
+            mods = {lock_mods.get(lid, lid) for lid in key}
+            if not any_call and len(mods) <= 1:
+                continue
+            reported.add(key)
+            yield mod.finding(
+                self, line,
+                "lock acquisition order cycle through call chains: "
+                + " -> ".join(cycle)
+                + " — two threads taking opposite ends deadlock")
+
+    def _find_path(self, graph, src, dst) -> Optional[list]:
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            cur, path = stack.pop()
+            if cur == dst:
+                return path
+            for nxt in sorted(graph.get(cur, ())):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+
+def _self_attr_reads(scope: ast.AST) -> set[str]:
+    out = set()
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Attribute) \
+                and isinstance(getattr(n, "ctx", None), ast.Load):
+            d = dotted(n)
+            if d and d.startswith("self.") and len(d.split(".")) == 2:
+                out.add(d.split(".")[1])
+    return out
+
+
+def _self_attr_writes(scope: ast.AST) -> set[str]:
+    out = set()
+    for n in ast.walk(scope):
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            tgts = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in tgts:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                d = dotted(base)
+                if d and d.startswith("self.") and len(d.split(".")) == 2:
+                    out.add(d.split(".")[1])
+        elif isinstance(n, ast.Call) \
+                and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _MUTATORS:
+            d = dotted(n.func.value)
+            if d and d.startswith("self.") and len(d.split(".")) == 2:
+                out.add(d.split(".")[1])
+    return out
+
+
+def _bound_names(scope: ast.AST) -> set[str]:
+    out = set()
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _revalidates(scope: ast.AST, attr: str) -> bool:
+    """True when the second critical section re-reads the structure
+    into a binding (the `x = d.setdefault(...)` / re-get idiom) —
+    the decision is re-derived under the lock, not trusted stale."""
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Assign):
+            for sub in ast.walk(n.value):
+                d = dotted(sub)
+                if d == f"self.{attr}":
+                    return True
+    return False
+
+
+@register
+class CheckThenActRule(SyncRule):
+    id = "JTL503"
+    name = "check-then-act"
+    scopes = None
+    rationale = (
+        "the serve admission path reads a counter/registry under the "
+        "lock, decides, then applies the decision under a LATER "
+        "acquisition — between the two, another thread changed the "
+        "state (two tenants double-insert a model; an inflight counter "
+        "admits past its bound). Atomicity violations survive every "
+        "individual-access lock discipline")
+    hint = ("do the read-decide-write in ONE critical section, or "
+            "re-validate under the second acquisition and bind the "
+            "result (`x = d.setdefault(k, x)` — use what the structure "
+            "actually holds)")
+
+    def _check(self, model) -> Iterator[Finding]:
+        for key in sorted(model.functions):
+            fi = model.functions[key]
+            if isinstance(fi.node, ast.AsyncFunctionDef):
+                continue
+            withs = []
+            for node in walk_same_scope(fi.node):
+                if isinstance(node, ast.With):
+                    ids = {lid for item in node.items for lid in
+                           [model._lock_id_of_expr(fi,
+                                                   item.context_expr)]
+                           if lid is not None}
+                    if ids:
+                        withs.append((node, ids))
+            withs.sort(key=lambda w: w[0].lineno)
+            for i, (w1, ids1) in enumerate(withs):
+                reads1 = _self_attr_reads(w1) - _self_attr_writes(w1)
+                bound1 = _bound_names(w1)
+                if not reads1 or not bound1:
+                    continue
+                for w2, ids2 in withs[i + 1:]:
+                    if not (ids1 & ids2):
+                        continue
+                    inter = reads1 & _self_attr_writes(w2)
+                    for attr in sorted(inter):
+                        if not self._gated(fi, w1, w2, bound1):
+                            continue
+                        if _revalidates(w2, attr):
+                            continue
+                        yield fi.mod.finding(
+                            self, w2,
+                            f"check-then-act: self.{attr} was read "
+                            f"under {', '.join(sorted(ids1 & ids2))} "
+                            f"in an earlier critical section of "
+                            f"{fi.node.name}(), the decision taken "
+                            f"between acquisitions, and the write here "
+                            f"trusts the stale read — re-validate "
+                            f"under this lock and bind the result")
+
+    def _gated(self, fi, w1, w2, bound1: set[str]) -> bool:
+        """An If/While between the sections (or enclosing the second)
+        whose test uses a name the first section bound — the 'decide'
+        step."""
+        from ..astutil import ancestors_same_scope
+
+        candidates = [a for a in ancestors_same_scope(w2)
+                      if isinstance(a, (ast.If, ast.While))]
+        for node in walk_same_scope(fi.node):
+            if isinstance(node, (ast.If, ast.While)) \
+                    and w1.lineno <= node.lineno <= w2.lineno:
+                candidates.append(node)
+        for c in candidates:
+            for n in ast.walk(c.test):
+                if isinstance(n, ast.Name) and n.id in bound1:
+                    return True
+        return False
+
+
+@register
+class BlockingUnderLockRule(SyncRule):
+    id = "JTL504"
+    name = "blocking-under-lock"
+    scopes = None
+    rationale = (
+        "a blocking call (Queue.get, future.result, Thread.join, an "
+        "HTTP wait) made while holding a lock turns every sibling of "
+        "that lock into a convoy — the /metrics scrape and the stats "
+        "readers take the same locks, so one stalled dispatch freezes "
+        "the whole observability plane (and a join under the lock the "
+        "joined thread wants is a deadlock)")
+    hint = ("move the blocking call outside the critical section: "
+            "snapshot the state under the lock, release, then block "
+            "(serve/sessions.py's close() shape)")
+
+    def _check(self, model) -> Iterator[Finding]:
+        seen: set[tuple] = set()
+        for b in sorted(model.blocking,
+                        key=lambda b: (b.mod.relpath, b.node.lineno)):
+            key = (b.mod.relpath, b.node.lineno, b.what)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield b.mod.finding(
+                self, b.node,
+                f"{b.what} while holding {_fmt_locks(b.locks)} in "
+                f"{b.fn.split('.')[-1]}() — every thread needing "
+                f"{'that lock' if len(b.locks) == 1 else 'those locks'} "
+                f"convoys behind this block")
+
+
+@register
+class ThreadLifecycleRule(SyncRule):
+    id = "JTL505"
+    name = "thread-lifecycle"
+    scopes = None
+    rationale = (
+        "the serve daemon owns threads transitively — scheduler "
+        "dispatch thread, per-session stream consumers, the obs pump; "
+        "a shutdown path that misses one source leaks the thread past "
+        "close(), which in a long-running daemon means encoder state "
+        "and device handles held forever (and joins that never happen "
+        "hide latent crashes)")
+    hint = ("give every thread/executor source a release on the "
+            "owner's shutdown path: join the thread, shutdown the "
+            "executor, close owned instances (SessionManager."
+            "close_all's shape), and call it from the owning close()")
+
+    def _check(self, model) -> Iterator[Finding]:
+        owning = self._thread_owning(model)
+        releasing = self._releasing(model, owning)
+        for key in sorted(owning):
+            ci = model.classes.get(key)
+            if ci is None or ci.handler:
+                continue
+            sources = self._sources(model, ci, owning)
+            if not sources:
+                continue            # owning only transitively via elems
+            released = {attr for (cls, attr) in releasing if cls == key}
+            missing = [a for a in sorted(sources) if a not in released]
+            if not missing:
+                continue
+            if not released:
+                yield ci.mod.finding(
+                    self, ci.node,
+                    f"{ci.name} owns thread source(s) "
+                    f"{', '.join(sorted(sources))} but no method ever "
+                    f"joins/shuts them down — the threads outlive "
+                    f"every shutdown path")
+            else:
+                for attr in missing:
+                    yield ci.mod.finding(
+                        self, ci.node,
+                        f"{ci.name}.{attr} owns threads "
+                        f"(via {sources[attr]}) but {ci.name}'s "
+                        f"shutdown path never releases it — joined "
+                        f"sources: {', '.join(sorted(released))}")
+        # Module-level executors with no shutdown anywhere.
+        for name, (mod, line) in sorted(model.module_executors.items()):
+            if self._module_has_shutdown(model, mod, name):
+                continue
+            yield mod.finding(
+                self, line,
+                f"module executor {name} is never shut down — its "
+                f"worker threads live for the process")
+
+    def _sources(self, model, ci, owning) -> dict[str, str]:
+        out = {}
+        for attr in ci.thread_attrs:
+            out[attr] = "threading.Thread"
+        for attr in ci.executor_attrs:
+            out[attr] = "ThreadPoolExecutor"
+        for attr, cls in ci.attr_types.items():
+            if cls in owning:
+                out[attr] = cls
+        for attr, cls in ci.elem_types.items():
+            if cls in owning:
+                out[attr] = f"registry of {cls}"
+        return out
+
+    def _thread_owning(self, model) -> set[str]:
+        owning = {k for k, ci in model.classes.items()
+                  if ci.thread_attrs or ci.executor_attrs}
+        changed = True
+        while changed:
+            changed = False
+            for k, ci in model.classes.items():
+                if k in owning:
+                    continue
+                if any(c in owning for c in ci.attr_types.values()) \
+                        or any(c in owning
+                               for c in ci.elem_types.values()):
+                    owning.add(k)
+                    changed = True
+        return owning
+
+    def _releasing(self, model, owning) -> set[tuple[str, str]]:
+        """(class key, source attr) pairs some method of the class
+        releases — join/shutdown for direct sources, a call into a
+        releasing method of the owned class for indirect ones."""
+        released: set[tuple[str, str]] = set()
+        # method keys that release ANY source of their class
+        rel_methods: set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for key, ci in model.classes.items():
+                if key not in owning:
+                    continue
+                for mname in ci.methods:
+                    fk = f"{key}.{mname}"
+                    fi = model.functions.get(fk)
+                    if fi is None:
+                        continue
+                    for call in walk_same_scope(fi.node):
+                        if not isinstance(call, ast.Call):
+                            continue
+                        if isinstance(call.func, ast.Attribute) \
+                                and call.func.attr in ("join",
+                                                       "shutdown"):
+                            d = dotted(call.func.value)
+                            a = d.split(".")[1] if d \
+                                and d.startswith("self.") \
+                                and len(d.split(".")) == 2 else None
+                            if a and (a in ci.thread_attrs
+                                      or a in ci.executor_attrs):
+                                if (key, a) not in released:
+                                    released.add((key, a))
+                                    rel_methods.add(fk)
+                                    changed = True
+                    for callee, _locks, _aj, node in fi.calls:
+                        if callee not in rel_methods:
+                            continue
+                        tcls = callee.rsplit(".", 1)[0]
+                        if tcls == key:
+                            # Delegation within the class: close_all()
+                            # calling close() is as releasing as close.
+                            if fk not in rel_methods:
+                                rel_methods.add(fk)
+                                changed = True
+                            continue
+                        d = dotted(node.func) or ""
+                        # self.<attr>.<m>() on a typed owned attr
+                        if d.startswith("self.") \
+                                and len(d.split(".")) == 3:
+                            a = d.split(".")[1]
+                            if ci.attr_types.get(a) == tcls \
+                                    and (key, a) not in released:
+                                released.add((key, a))
+                                rel_methods.add(fk)
+                                changed = True
+                            continue
+                        # element of a typed registry (popped/iterated)
+                        for a, ecls in ci.elem_types.items():
+                            if ecls == tcls and (key, a) not in released:
+                                released.add((key, a))
+                                rel_methods.add(fk)
+                                changed = True
+        return released
+
+    def _module_has_shutdown(self, model, mod, name: str) -> bool:
+        bare = name.split(".")[-1]
+        for n in mod.walk_nodes():
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "shutdown":
+                d = dotted(n.func.value) or ""
+                if d.split(".")[-1] == bare:
+                    return True
+        return False
+
+
+@register
+class SyncContractRule(SyncRule):
+    id = "JTL506"
+    name = "sync-contract"
+    scopes = None
+    rationale = (
+        "the model's extra facts arrive via `# jtsan:` annotations and "
+        "the sanitizer's wrap-name literals; trusted unverified, a "
+        "stale annotation silently re-legitimizes the race it once "
+        "excused and a renamed lock breaks the witnessed-vs-modeled "
+        "comparison — so every declaration is checked against the "
+        "tree, and contracts.json must carry the sync section the "
+        "model regenerates (content drift rides the JTL406 gate)")
+    hint = ("fix or remove the stale annotation; wrap-name literals "
+            "must equal the model's canonical lock id "
+            "(<module>.<Class>.<attr>); regenerate contracts.json with "
+            "`jepsen-tpu lint --write-contracts`")
+
+    def _check(self, model) -> Iterator[Finding]:
+        from ..flow.sync import _DIRECTIVES
+
+        for a in sorted(model.annotations,
+                        key=lambda a: (a.mod.relpath, a.line)):
+            if a.directive not in _DIRECTIVES:
+                yield a.mod.finding(
+                    self, a.line,
+                    f"unknown jtsan directive `{a.directive}` — the "
+                    f"contract it meant to declare is not being checked")
+                continue
+            if a.node is None:
+                yield a.mod.finding(
+                    self, a.line,
+                    f"jtsan `{a.directive}` annotation does not bind to "
+                    f"a statement (stale annotation — nothing is "
+                    f"verified)")
+                continue
+            yield from self._verify_one(model, a)
+        # Sanitizer wrap names must equal the canonical lock id.
+        decls = list(model.module_locks.values()) + [
+            d for ci in model.classes.values() for d in ci.locks.values()]
+        for d in sorted(decls, key=lambda d: (d.mod.relpath, d.line)):
+            if d.wrap_name is not None and d.wrap_name != d.id:
+                yield d.mod.finding(
+                    self, d.line,
+                    f"sanitizer wrap name {d.wrap_name!r} != the "
+                    f"model's canonical lock id {d.id!r} — witnessed "
+                    f"edges would not match the static model")
+
+    def _verify_one(self, model, a) -> Iterator[Finding]:
+        if a.directive == "returns":
+            fn = model._enclosing_or_bound_def(a)
+            if fn is None:
+                yield a.mod.finding(
+                    self, a.line,
+                    "jtsan returns= must annotate a def")
+                return
+            if model._class_by_name(a.arg, a.mod) is None:
+                yield a.mod.finding(
+                    self, a.line,
+                    f"jtsan returns= names unknown class {a.arg!r}")
+        elif a.directive == "alias-of":
+            bound = model._bound_self_attr(a.node)
+            ci = model._class_of_stmt(a)
+            if bound is None or ci is None:
+                yield a.mod.finding(
+                    self, a.line,
+                    "jtsan alias-of= must annotate a `self.X = ...` "
+                    "assignment inside a class")
+                return
+            if not model._lock_id_known(a.arg):
+                yield a.mod.finding(
+                    self, a.line,
+                    f"jtsan alias-of= names unknown lock {a.arg!r}")
+        elif a.directive == "guarded-by":
+            bound = model._bound_self_attr(a.node)
+            ci = model._class_of_stmt(a)
+            if bound is None or ci is None \
+                    or model._resolve_lock_expr(a.arg, ci,
+                                                a.mod) is None:
+                yield a.mod.finding(
+                    self, a.line,
+                    f"jtsan guarded-by={a.arg!r} does not resolve to a "
+                    f"known lock on an attr-initializing statement")
+        elif a.directive == "hb":
+            ci = model._class_of_stmt(a)
+            ok = False
+            if a.arg.startswith("self.") and ci is not None:
+                attr = a.arg.split(".", 1)[1]
+                ok = attr in ci.safe_attrs or attr in ci.thread_attrs
+            if not ok:
+                yield a.mod.finding(
+                    self, a.line,
+                    f"jtsan hb={a.arg!r} must name an Event/Thread "
+                    f"attr of the enclosing class — no ordering edge "
+                    f"exists to justify the exemption")
+
+    def check_project(self, root: Path, ctx=None) -> list[Finding]:
+        import json
+
+        out = list(self._check(self._model(root, ctx)))
+        root = Path(root)
+        contracts_path = root / "contracts.json"
+        if (root / PACKAGE_NAME).is_dir() and contracts_path.is_file():
+            try:
+                contracts = json.loads(
+                    contracts_path.read_text(encoding="utf-8"))
+            except ValueError:
+                return out          # JTL406 reports the invalid file
+            if "sync" not in contracts:
+                out.append(Finding(
+                    rule=self.id, path="contracts.json", line=1,
+                    message=("contracts.json has no `sync` section — "
+                             "the concurrency contract is undeclared; "
+                             "regenerate with `jepsen-tpu lint "
+                             "--write-contracts`"),
+                    hint=self.hint))
+        return out
+
+    def covered_paths(self, root: Path) -> list[str]:
+        return ["contracts.json"]

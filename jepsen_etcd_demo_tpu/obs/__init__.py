@@ -168,6 +168,12 @@ COST_GAUGE = "wgl.device_mem_peak"
 # 2 wedged, set on every transition.
 # jtflow: metrics preregistered
 HEALTH_GAUGE = "health.state"
+# Runtime lock-order sanitizer (obs/sync.py, JEPSEN_TPU_SYNC_TRACE=1):
+# wrapped-lock acquisitions and distinct witnessed order edges, folded
+# in by sync.publish_metrics() — zeros (sanitizer off) permitted, never
+# absent.
+# jtflow: metrics preregistered
+SYNC_COUNTERS = ("sync.lock_acquisitions", "sync.order_edges")
 
 _NULL_TRACER = Tracer(enabled=False)
 _NULL_METRICS = MetricsRegistry(enabled=False)
@@ -185,7 +191,8 @@ class Capture:
         self.metrics = MetricsRegistry(enabled=enabled)
         if enabled:
             for name in PHASE_COUNTERS + SCHED_COUNTERS + SWEEP_COUNTERS \
-                    + COST_COUNTERS + ELLE_COUNTERS + SERVE_COUNTERS:
+                    + COST_COUNTERS + ELLE_COUNTERS + SERVE_COUNTERS \
+                    + SYNC_COUNTERS:
                 self.metrics.counter(name)
             for name in ELLE_GAUGES + SERVE_GAUGES:
                 self.metrics.gauge(name)
@@ -228,12 +235,14 @@ def telemetry_enabled() -> bool:
         not in ("0", "false", "no", "off")
 
 
+# jtsan: returns=Tracer
 def get_tracer() -> Tracer:
     """The active capture's tracer, or a no-op singleton."""
     stack = _stack
     return stack[-1].tracer if stack else _NULL_TRACER
 
 
+# jtsan: returns=MetricsRegistry
 def get_metrics() -> MetricsRegistry:
     """The active capture's metrics registry, or a no-op singleton."""
     stack = _stack

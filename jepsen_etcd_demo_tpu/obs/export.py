@@ -177,20 +177,28 @@ class Subscription:
         _BUS.unsubscribe(self)
 
 
+# jtlint: disable=JTL505 -- the pump thread is self-terminating by
+# design: _pump_metrics exits (and clears self._pump) the moment the
+# last subscriber closes, and it is daemon=True — a module-global bus
+# has no shutdown path to join it from, and needs none.
 class _Bus:
     """Module-global publish/subscribe fan-out. `publish` is called
     from the tracer's append path (under the tracer lock), so the
     no-subscriber fast path must stay one attribute check."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        from .sync import maybe_wrap
+
+        self._lock = maybe_wrap(threading.Lock(),
+                                "obs.export._Bus._lock")
         self._subs: tuple[Subscription, ...] = ()
         self._pump: Optional[threading.Thread] = None
         self.pump_interval_s = 0.25
 
     @property
     def active(self) -> bool:
-        return bool(self._subs)
+        with self._lock:
+            return bool(self._subs)
 
     def subscribe(self, kinds: Optional[set] = None,
                   maxsize: int = 4096) -> Subscription:
@@ -209,6 +217,15 @@ class _Bus:
             self._subs = tuple(s for s in self._subs if s is not sub)
 
     def publish(self, rec: dict) -> None:
+        # Deliberate lock-free fast path: _subs is an IMMUTABLE tuple
+        # swapped under the bus lock, publish runs inside the tracer's
+        # append (every span on every thread) and must stay one
+        # attribute check when nobody subscribed. A reader sees either
+        # the old or the new tuple — both are safe to fan out to.
+        # jtlint: disable=JTL501 -- lock-free by design: immutable
+        # tuple swap (writers hold the bus lock), benign stale read;
+        # taking the lock here would serialize every traced span
+        # against subscribe/unsubscribe.
         subs = self._subs
         if not subs:
             return

@@ -151,9 +151,12 @@ class BackendSupervisor:
             if probe_interval_s is not None \
             else _env_float("JEPSEN_TPU_HEALTH_PROBE_INTERVAL_S",
                             PROBE_INTERVAL_S)
+        from .sync import maybe_wrap
+
         self._probe = probe or (
             lambda: probe_backend(timeout_s=self.probe_timeout_s))
-        self._lock = threading.Lock()
+        self._lock = maybe_wrap(threading.Lock(),
+                                "obs.health.BackendSupervisor._lock")
         self.state = HEALTHY
         self._since_wall = time.time()
         self._consecutive_failures = 0

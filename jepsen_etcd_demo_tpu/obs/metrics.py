@@ -43,6 +43,8 @@ import threading
 from pathlib import Path
 from typing import Optional
 
+from .sync import maybe_wrap
+
 # Log-bucket geometry for histogram quantiles: base 1.1 gives ~±4.9%
 # relative error; indices clamped so memory stays bounded for any input
 # (index 400 covers up to ~5e16, -400 down to ~2e-17).
@@ -56,6 +58,9 @@ class Counter:
 
     def __init__(self, lock: threading.Lock, name: str = "",
                  dirty: Optional[set] = None):
+        # The registry's ONE lock, injected so a snapshot pass and the
+        # writers serialize on the same object.
+        # jtsan: alias-of=obs.metrics.MetricsRegistry._lock
         self._lock = lock
         self._dirty = dirty
         self.name = name
@@ -68,7 +73,11 @@ class Counter:
                 self._dirty.add(self.name)
 
     def snapshot(self) -> dict:
-        return {"type": "counter", "value": self.value}
+        # Snapshot-under-lock: /metrics scrapes run on web handler
+        # threads while kernel/serve threads write — an unlocked read
+        # here was jtsan JTL501's first real finding.
+        with self._lock:
+            return {"type": "counter", "value": self.value}
 
 
 class Gauge:
@@ -76,6 +85,7 @@ class Gauge:
 
     def __init__(self, lock: threading.Lock, name: str = "",
                  dirty: Optional[set] = None):
+        # jtsan: alias-of=obs.metrics.MetricsRegistry._lock
         self._lock = lock
         self._dirty = dirty
         self.name = name
@@ -95,8 +105,11 @@ class Gauge:
                 self._dirty.add(self.name)
 
     def snapshot(self) -> dict:
-        return {"type": "gauge", "last": self.last, "min": self.min,
-                "max": self.max, "n": self.n}
+        # Snapshot-under-lock (see Counter.snapshot): a torn
+        # last/min/max triple would mix two updates on one row.
+        with self._lock:
+            return {"type": "gauge", "last": self.last, "min": self.min,
+                    "max": self.max, "n": self.n}
 
 
 class Histogram:
@@ -105,6 +118,7 @@ class Histogram:
 
     def __init__(self, lock: threading.Lock, name: str = "",
                  dirty: Optional[set] = None):
+        # jtsan: alias-of=obs.metrics.MetricsRegistry._lock
         self._lock = lock
         self._dirty = dirty
         self.name = name
@@ -180,7 +194,8 @@ _NULL_INSTRUMENT = _NullInstrument()
 class MetricsRegistry:
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
-        self._lock = threading.Lock()
+        self._lock = maybe_wrap(threading.Lock(),
+                                "obs.metrics.MetricsRegistry._lock")
         self._metrics: dict[str, object] = {}
         self._dirty: set[str] = set()
 
@@ -199,12 +214,15 @@ class MetricsRegistry:
                     f"{type(m).__name__}, requested {cls.__name__}")
             return m
 
+    # jtsan: returns=Counter
     def counter(self, name: str) -> Counter:
         return self._get(name, Counter)
 
+    # jtsan: returns=Gauge
     def gauge(self, name: str) -> Gauge:
         return self._get(name, Gauge)
 
+    # jtsan: returns=Histogram
     def histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
 
@@ -227,15 +245,17 @@ class MetricsRegistry:
 
     def value(self, name: str, default: float = 0.0) -> float:
         """Scalar view for consumers that just want a number: a counter's
-        value, a gauge's last, a histogram's sum."""
+        value, a gauge's last, a histogram's sum. Read under the shared
+        lock — the instruments write under the same one (jtsan's
+        snapshot-under-lock discipline)."""
         with self._lock:
             m = self._metrics.get(name)
-        if isinstance(m, Counter):
-            return m.value
-        if isinstance(m, Gauge):
-            return m.last if m.last is not None else default
-        if isinstance(m, Histogram):
-            return m.sum
+            if isinstance(m, Counter):
+                return m.value
+            if isinstance(m, Gauge):
+                return m.last if m.last is not None else default
+            if isinstance(m, Histogram):
+                return m.sum
         return default
 
     def to_json(self) -> str:

@@ -1,0 +1,230 @@
+"""Runtime lock-order sanitizer — the dynamic half of jtsan.
+
+The static model (analysis/flow/sync.py) predicts which lock orders
+*may* happen; this module records which orders *do* happen, so the two
+can be cross-validated in tier-1 (tests/test_jtsan.py): every witnessed
+acquisition order must be an edge the static model predicted, and no
+pair may be witnessed in both directions (a live inversion — the
+deadlock JTL502 exists to prevent). Disagreement in either direction is
+a failure: an unpredicted witness means the static resolution went
+blind somewhere (fix the model before trusting its race verdicts); a
+witnessed inversion means the tree has the bug.
+
+Zero-cost discipline: wrapping is decided at LOCK CONSTRUCTION time by
+``maybe_wrap(lock, name)`` — with ``JEPSEN_TPU_SYNC_TRACE`` unset (the
+default, production included) it returns the raw lock untouched, so the
+hot paths pay exactly one env check per lock *created*, never per
+acquisition. With ``JEPSEN_TPU_SYNC_TRACE=1`` each wrapped lock records,
+per acquisition, an ordered edge (held-lock -> acquired-lock) into a
+process-global witness table keyed by the same canonical names the
+static model derives (``serve.scheduler.CoalescingScheduler._lock``),
+plus held-while-blocking events when a wrapped Condition is waited on
+with other wrapped locks held.
+
+The witness table is plain dicts under one RAW ``threading.Lock`` (never
+itself wrapped — recording an acquisition must not recurse into
+recording) with a per-thread held stack in ``threading.local``.
+
+``publish_metrics()`` folds the table into the active obs capture
+(``sync.lock_acquisitions`` / ``sync.order_edges`` counters, pre-
+registered like every contract key) — called by the cross-validation
+test and at serve-daemon shutdown; doc/telemetry.md documents the
+records and the env gate.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+SYNC_TRACE_ENV = "JEPSEN_TPU_SYNC_TRACE"
+
+_table_lock = threading.Lock()          # raw on purpose (see docstring)
+_held = threading.local()
+# (outer name, inner name) -> count of witnessed acquisitions in that
+# order; _acquisitions counts every wrapped acquisition; _blocking holds
+# (held name, event label) pairs witnessed while blocked.
+_edges: dict[tuple[str, str], int] = {}
+_acquisitions = 0
+_blocking: dict[tuple[str, str], int] = {}
+
+
+def sync_trace_enabled() -> bool:
+    return os.environ.get(SYNC_TRACE_ENV, "").lower() \
+        in ("1", "true", "yes", "on")
+
+
+def reset_witness() -> None:
+    """Clear the witness table (test isolation)."""
+    global _acquisitions
+    with _table_lock:
+        _edges.clear()
+        _blocking.clear()
+        _acquisitions = 0
+
+
+def witnessed_edges() -> dict[tuple[str, str], int]:
+    with _table_lock:
+        return dict(_edges)
+
+
+def witnessed_blocking() -> dict[tuple[str, str], int]:
+    with _table_lock:
+        return dict(_blocking)
+
+
+def witness_summary() -> dict:
+    """The telemetry view: counts + the edge list, JSON-shaped."""
+    with _table_lock:
+        return {
+            "acquisitions": _acquisitions,
+            "edges": sorted([a, b] for a, b in _edges),
+            "held_while_blocking": sorted(
+                [h, w] for h, w in _blocking),
+        }
+
+
+def _stack() -> list:
+    st = getattr(_held, "stack", None)
+    if st is None:
+        st = _held.stack = []
+    return st
+
+
+def _note_acquired(name: str) -> None:
+    global _acquisitions
+    st = _stack()
+    with _table_lock:
+        _acquisitions += 1
+        for outer in st:
+            if outer != name:
+                key = (outer, name)
+                _edges[key] = _edges.get(key, 0) + 1
+    st.append(name)
+
+
+def _note_released(name: str) -> None:
+    st = _stack()
+    # Release order can legitimately differ from reverse-acquisition
+    # (lock juggling); remove the most recent matching entry.
+    for i in range(len(st) - 1, -1, -1):
+        if st[i] == name:
+            del st[i]
+            break
+
+
+def _note_blocking(name: str, what: str) -> None:
+    st = _stack()
+    held = [h for h in st if h != name]
+    if not held:
+        return
+    with _table_lock:
+        for h in held:
+            key = (h, what)
+            _blocking[key] = _blocking.get(key, 0) + 1
+
+
+class TracingLock:
+    """Proxy over a Lock/RLock/Condition recording acquisition order.
+    Context-manager use, acquire/release, and the Condition surface
+    (wait/notify/notify_all) are instrumented; everything else
+    delegates. ``wait`` keeps the lock on the held stack — the
+    condition reacquires before returning, so the thread's held set is
+    unchanged from the model's point of view."""
+
+    __slots__ = ("_inner", "name")
+
+    def __init__(self, inner, name: str):
+        self._inner = inner
+        self.name = name
+
+    # -- lock surface -----------------------------------------------------
+    def acquire(self, *a, **kw):
+        ok = self._inner.acquire(*a, **kw)
+        if ok:
+            _note_acquired(self.name)
+        return ok
+
+    def release(self):
+        _note_released(self.name)
+        return self._inner.release()
+
+    def __enter__(self):
+        self._inner.__enter__()
+        _note_acquired(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        _note_released(self.name)
+        return self._inner.__exit__(*exc)
+
+    def locked(self):
+        return self._inner.locked()
+
+    # -- condition surface ------------------------------------------------
+    def wait(self, timeout: Optional[float] = None):
+        _note_blocking(self.name, "Condition.wait")
+        return self._inner.wait(timeout)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        _note_blocking(self.name, "Condition.wait")
+        return self._inner.wait_for(predicate, timeout)
+
+    def notify(self, n: int = 1):
+        return self._inner.notify(n)
+
+    def notify_all(self):
+        return self._inner.notify_all()
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def __repr__(self):
+        return f"TracingLock({self.name!r}, {self._inner!r})"
+
+
+def maybe_wrap(lock, name: str):
+    """Wrap `lock` for witness recording when JEPSEN_TPU_SYNC_TRACE is
+    set; return it untouched otherwise. `name` must be the canonical id
+    the static model derives for this lock
+    (``<module>.<Class>.<attr>`` under the package root) — JTL506
+    verifies the literal against the model, so a rename cannot leave a
+    stale witness name behind."""
+    if not sync_trace_enabled():
+        return lock
+    return TracingLock(lock, name)
+
+
+def cross_validate(predicted: set) -> list[str]:
+    """Compare the witness table against the static model's edge set.
+    Returns a list of human-readable problems (empty = the halves
+    agree): witnessed-but-unmodeled edges, and pairs witnessed in BOTH
+    directions (a live lock-order inversion — the runtime counterpart
+    of a JTL502 cycle)."""
+    problems: list[str] = []
+    witnessed = witnessed_edges()
+    for (a, b), n in sorted(witnessed.items()):
+        if (a, b) not in predicted:
+            problems.append(
+                f"witnessed lock order {a} -> {b} ({n}x) is not an edge "
+                f"the static model predicts — the jtsan resolution is "
+                f"blind to this path")
+        if (b, a) in witnessed and a < b:
+            problems.append(
+                f"lock-order inversion witnessed live: {a} -> {b} AND "
+                f"{b} -> {a} — two threads taking opposite ends deadlock")
+    return problems
+
+
+def publish_metrics() -> dict:
+    """Fold the witness table into the active obs capture (pre-
+    registered ``sync.lock_acquisitions`` / ``sync.order_edges``) and
+    return the summary dict."""
+    from . import get_metrics
+
+    summary = witness_summary()
+    m = get_metrics()
+    m.counter("sync.lock_acquisitions").add(summary["acquisitions"])
+    m.counter("sync.order_edges").add(len(summary["edges"]))
+    return summary

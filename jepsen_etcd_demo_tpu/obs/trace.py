@@ -71,9 +71,12 @@ class Tracer:
     silent."""
 
     def __init__(self, enabled: bool = True, max_records: int = 200_000):
+        from .sync import maybe_wrap
+
         self.enabled = enabled
         self.max_records = max_records
-        self._lock = threading.Lock()
+        self._lock = maybe_wrap(threading.Lock(),
+                                "obs.trace.Tracer._lock")
         self._records: list[dict] = []
         self._dropped = 0
         self._next_id = 1
@@ -158,6 +161,18 @@ class Tracer:
     def records(self) -> list[dict]:
         with self._lock:
             return list(self._records)
+
+    def tail(self, n: int) -> list[dict]:
+        """The most recent `n` records, copied under the lock — the
+        /live SSE init seed. Copies n records, not the whole buffer
+        (records() duplicates up to max_records entries per call, which
+        a reconnecting SSE client would pay on every connect)."""
+        if n <= 0:
+            # [-0:] would degenerate to the WHOLE buffer — the exact
+            # copy this method exists to avoid.
+            return []
+        with self._lock:
+            return self._records[-n:]
 
     def to_jsonl(self) -> str:
         with self._lock:

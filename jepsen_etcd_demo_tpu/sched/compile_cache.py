@@ -84,9 +84,12 @@ class KernelCache:
     persistent cache keeps recompiles of an evicted shape cheap)."""
 
     def __init__(self, capacity: int | None = None):
+        from ..obs.sync import maybe_wrap
+
         self._capacity = capacity
         self._entries: OrderedDict[tuple, Any] = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = maybe_wrap(
+            threading.Lock(), "sched.compile_cache.KernelCache._lock")
         self.hits = 0
         self.misses = 0
 

@@ -109,7 +109,10 @@ class _Stats:
     thread-safety pass)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        from ..obs.sync import maybe_wrap
+
+        self._lock = maybe_wrap(threading.Lock(),
+                                "sched.engine._Stats._lock")
         self.steps_real = 0
         self.steps_padded = 0
         self.launches = 0
@@ -353,6 +356,11 @@ def corpus_executor() -> ThreadPoolExecutor:
     global _executor
     with _executor_lock:
         if _executor is None:
+            # jtlint: disable=JTL505 -- process-lifetime singleton by
+            # design (docstring above): one daemon worker thread that
+            # serializes every corpus submitter for the life of the
+            # process; there is no later point to shut it down from,
+            # and daemon=True means it never blocks interpreter exit.
             _executor = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="sched-corpus")
         return _executor

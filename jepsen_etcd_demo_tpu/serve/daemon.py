@@ -75,10 +75,13 @@ class ServeDaemon:
                  max_batch: Optional[int] = None,
                  max_inflight: Optional[int] = None,
                  write_artifacts: bool = True):
+        from ..obs.sync import maybe_wrap
+
         self.store = Store(store_root)
         self.default_model = default_model
         self._write_artifacts = write_artifacts
-        self._lock = threading.Lock()
+        self._lock = maybe_wrap(threading.Lock(),
+                                "serve.daemon.ServeDaemon._lock")
         self._requests: "OrderedDict[str, ServeRequest]" = OrderedDict()
         self._lins: dict[str, Any] = {}     # model name -> Linearizable
         self.scheduler = CoalescingScheduler(
@@ -223,6 +226,11 @@ class ServeDaemon:
                 "sessions": self.sessions.stats()}
 
     def close(self) -> None:
+        """Shut down BOTH thread sources: the dispatch thread and every
+        open streaming session's consumer (the latter was the jtsan
+        JTL505 shutdown gap — sessions kept their encoder state and
+        threads past close)."""
+        self.sessions.close_all()
         self.scheduler.close()
 
 
@@ -432,4 +440,10 @@ def serve_check(store_root: str = "store", host: str = "127.0.0.1",
         finally:
             daemon.close()
             httpd.server_close()
+            # Fold the jtsan runtime sanitizer's witness table (empty
+            # unless JEPSEN_TPU_SYNC_TRACE=1) into the daemon's final
+            # metrics snapshot — doc/telemetry.md "Sync trace".
+            from ..obs import sync as obs_sync
+
+            obs_sync.publish_metrics()
     return 0

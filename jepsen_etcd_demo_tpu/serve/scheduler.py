@@ -57,6 +57,7 @@ from typing import Any, Callable, Optional
 
 from .. import obs
 from ..obs import health
+from ..obs.sync import maybe_wrap
 from ..ops.limits import limits
 
 # Retry-After seconds a wedged rejection advertises: long enough that a
@@ -137,9 +138,13 @@ class CoalescingScheduler:
         # registry) — kernel attribution and the serve.* series belong
         # on the daemon's own capture.
         self._batch_telemetry = batch_telemetry
-        self._lock = threading.Condition()
+        self._lock = maybe_wrap(
+            threading.Condition(),
+            "serve.scheduler.CoalescingScheduler._lock")
+        # jtsan: guarded-by=self._lock
         self._queues: dict[str, deque[ServeRequest]] = {}
         self._rotation: deque[str] = deque()    # WFQ tenant turn order
+        # jtsan: guarded-by=self._lock
         self._inflight: dict[str, int] = {}
         self._pending = 0
         self._models: dict[str, Any] = {}       # model name -> Model
@@ -209,7 +214,12 @@ class CoalescingScheduler:
         return req
 
     def model_for(self, name: str):
-        """Resolved (and cached) Model instance per model name."""
+        """Resolved (and cached) Model instance per model name. The
+        dispatch thread and session-opening handler threads race here;
+        binding setdefault's RETURN re-validates under the second
+        acquisition, so both racers end up using the ONE instance the
+        registry actually holds (jtsan JTL503 pinned the unbound form:
+        each racer kept its own instance)."""
         with self._lock:
             mdl = self._models.get(name)
         if mdl is None:
@@ -217,7 +227,7 @@ class CoalescingScheduler:
 
             mdl = get_model(name)
             with self._lock:
-                self._models.setdefault(name, mdl)
+                mdl = self._models.setdefault(name, mdl)
         return mdl
 
     # -- dispatch thread --------------------------------------------------
@@ -370,9 +380,13 @@ class CoalescingScheduler:
                 "latency_s": round(latency, 4),
             }
             m.histogram("serve.request_latency_s").observe(latency)
-            lat = self._tenant_latency.setdefault(
-                req.tenant, deque(maxlen=1024))
-            lat.append(latency)
+            # Under the lock: tenant_latencies()/stats() iterate this
+            # dict from handler threads — an unlocked setdefault here
+            # could resize it mid-iteration (jtsan JTL501 finding).
+            with self._lock:
+                lat = self._tenant_latency.setdefault(
+                    req.tenant, deque(maxlen=1024))
+                lat.append(latency)
         m.counter("serve.batches").add(1)
         if len(batch) > 1:
             m.counter("serve.coalesced_requests").add(len(batch))

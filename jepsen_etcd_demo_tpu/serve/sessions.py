@@ -25,6 +25,7 @@ import uuid
 from typing import Any, Optional
 
 from ..obs import health
+from ..obs.sync import maybe_wrap
 from ..ops.op import Op
 from .scheduler import RETRY_AFTER_S, Rejected
 
@@ -59,7 +60,8 @@ class ServeSession:
         # own HTTP handler thread, and the incremental encoder's
         # watermark rests on strictly-increasing seq in arrival order —
         # interleaved stamping would corrupt the stable prefix.
-        self._lock = threading.Lock()
+        self._lock = maybe_wrap(threading.Lock(),
+                                "serve.sessions.ServeSession._lock")
         self._session = StreamSession(model, keyed=keyed)
         self._ops: list[Op] = []    # the full feed, for store artifacts
 
@@ -91,16 +93,24 @@ class ServeSession:
         """Drain + finalize: the session verdict. Keys the stream
         abandoned (infeasible geometry, malformed shapes) re-run through
         the post-hoc oracle of record — the daemon reports them
-        ``streamed: false`` rather than guessing."""
+        ``streamed: false`` rather than guessing.
+
+        The lock only latches ``_closed`` (so a racing feed gets its
+        409 and no op lands after the latch); ``finalize()`` — which
+        JOINS the stream consumer thread — runs OUTSIDE it. Joining
+        under the lock stalled every other session call behind the
+        drain (jtsan JTL504), and once ``_closed`` is set no feed can
+        touch ``_session`` again, so the unlock is safe."""
         with self._lock:
             self._closed = True
-            results = self._session.finalize()
+            fed = self.ops_fed
+        results = self._session.finalize()
         stats = self._session.stats()
         if results is None:
             return {"valid": None, "streamed": False,
                     "error": stats.get("fallback",
                                        "no streamable verdicts"),
-                    "stream": stats, "ops_fed": self.ops_fed}
+                    "stream": stats, "ops_fed": fed}
         keys = {}
         valid = True
         for key, res in sorted(results.items(), key=lambda kv: str(kv[0])):
@@ -113,7 +123,15 @@ class ServeSession:
             if res.get("valid") is not True:
                 valid = False
         return {"valid": valid, "streamed": True, "keys": keys,
-                "stream": stats, "ops_fed": self.ops_fed}
+                "stream": stats, "ops_fed": fed}
+
+    def idle_at(self) -> float:
+        """Last-fed monotonic stamp, read under the session lock — the
+        reaper's view (feed() writes it under the same lock; an
+        unlocked read from the manager thread was a jtsan JTL501
+        divergent-lockset shape)."""
+        with self._lock:
+            return self.last_fed_mono
 
     @property
     def ops(self) -> list[Op]:
@@ -126,8 +144,11 @@ class SessionManager:
 
     def __init__(self, max_per_tenant: Optional[int] = None):
         self._max_per_tenant = max_per_tenant
-        self._lock = threading.Lock()
+        self._lock = maybe_wrap(threading.Lock(),
+                                "serve.sessions.SessionManager._lock")
+        # jtsan: guarded-by=self._lock
         self._sessions: dict[str, ServeSession] = {}
+        # jtsan: guarded-by=self._lock
         self._per_tenant: dict[str, int] = {}
 
     def _cap(self) -> int:
@@ -166,9 +187,14 @@ class SessionManager:
         consumer thread forever (run lazily on open(), so an idle
         daemon spends nothing)."""
         cutoff = time.monotonic() - SESSION_IDLE_TTL_S
+        # Snapshot the registry under the manager lock, probe each
+        # session's locked idle_at() AFTER releasing it: taking every
+        # session lock while holding the manager lock would convoy all
+        # tenants' opens behind one tenant's bulk feed (the JTL504
+        # shape, held one level up).
         with self._lock:
-            stale = [sid for sid, s in self._sessions.items()
-                     if s.last_fed_mono < cutoff]
+            sessions = list(self._sessions.items())
+        stale = [sid for sid, s in sessions if s.idle_at() < cutoff]
         for sid in stale:
             self.close(sid)
 
@@ -192,6 +218,20 @@ class SessionManager:
         verdict["tenant"] = sess.tenant
         verdict["model"] = sess.model_name
         return verdict
+
+    def close_all(self) -> int:
+        """Finalize every open session — the daemon's shutdown path.
+        Each open session holds an incremental encoder and a live
+        consumer thread; a daemon close() that only stopped the
+        scheduler leaked them past shutdown (jtsan JTL505's unjoined-
+        thread gap). Returns how many sessions were closed."""
+        with self._lock:
+            open_ids = list(self._sessions)
+        n = 0
+        for sid in open_ids:
+            if self.close(sid) is not None:
+                n += 1
+        return n
 
     def stats(self) -> dict:
         with self._lock:

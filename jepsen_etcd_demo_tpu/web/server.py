@@ -679,8 +679,12 @@ class StoreHandler(SimpleHTTPRequestHandler):
                 if getattr(reg, "enabled", False) else {},
                 # The most recent already-recorded trace tail — enough
                 # to seed the page without replaying a whole long run.
-                "records": tracer.records()[-500:]
-                if tracer.enabled else [],
+                # Through the LOCKED tail() reader: handler threads
+                # must not slice the live record list while the run's
+                # threads append (jtsan's snapshot-under-lock
+                # discipline), and copying the whole buffer per SSE
+                # connect was O(max_records) anyway.
+                "records": tracer.tail(500) if tracer.enabled else [],
             }
             self.wfile.write(export.sse_message(init, event="init"))
             self.wfile.flush()

@@ -1,0 +1,19 @@
+"""JTL504 negative: block FIRST, then take the lock only for the
+bookkeeping write (and Condition.wait on the held condition is the
+release idiom, never flagged)."""
+import queue
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._q = queue.Queue()
+        self.taken = 0
+
+    def take(self):
+        item = self._q.get()
+        with self._lock:
+            self.taken += 1
+            self._lock.wait(0.01)
+        return item

@@ -1,0 +1,18 @@
+"""JTL504 positive: a blocking Queue.get while holding the lock —
+every other thread needing the lock convoys behind a consumer that may
+wait forever."""
+import queue
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self.taken = 0
+
+    def take(self):
+        with self._lock:
+            item = self._q.get()
+            self.taken += 1
+        return item

@@ -1,0 +1,19 @@
+"""JTL503 negative: the second critical section re-validates — the
+setdefault RETURN is bound, so both racers end up with the one
+instance the registry actually holds."""
+import threading
+
+
+class ModelRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models = {}
+
+    def model_for(self, name):
+        with self._lock:
+            mdl = self._models.get(name)
+        if mdl is None:
+            mdl = object()
+            with self._lock:
+                mdl = self._models.setdefault(name, mdl)
+        return mdl

@@ -1,0 +1,20 @@
+"""JTL503 positive: read the registry under the lock, decide on the
+stale value, then write under a LATER acquisition WITHOUT re-validating
+— two racing callers each install (and keep using) their own instance;
+the serve admission/model-registry shape."""
+import threading
+
+
+class ModelRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models = {}
+
+    def model_for(self, name):
+        with self._lock:
+            mdl = self._models.get(name)
+        if mdl is None:
+            mdl = object()
+            with self._lock:
+                self._models.setdefault(name, mdl)
+        return mdl

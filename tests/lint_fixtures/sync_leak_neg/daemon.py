@@ -1,0 +1,30 @@
+"""JTL505 negative: every thread source has a release on the owner's
+shutdown path — the daemon closes the owned worker AND joins its own
+thread."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        self._thread.join()
+
+
+class Daemon:
+    def __init__(self):
+        self.worker = Worker()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        pass
+
+    def close(self):
+        self.worker.close()
+        self._thread.join()

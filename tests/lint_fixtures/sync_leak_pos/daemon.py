@@ -1,0 +1,38 @@
+"""JTL505 positives: `Leaky` starts a thread no method ever joins, and
+`Daemon`'s shutdown path joins its OWN thread but never closes the
+thread-owning `worker` it constructed — the serve-daemon shutdown gap."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        self._thread.join()
+
+
+class Leaky:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+
+class Daemon:
+    def __init__(self):
+        self.worker = Worker()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        pass
+
+    def close(self):
+        self._thread.join()

@@ -1,0 +1,17 @@
+"""JTL502 negative: both cross-module paths acquire in ONE global
+order (A before B) — no cycle."""
+import threading
+
+import locker_b
+
+_alock = threading.Lock()
+
+
+def fa():
+    with _alock:
+        locker_b.fb()
+
+
+def fd():
+    with _alock:
+        locker_b.fb()

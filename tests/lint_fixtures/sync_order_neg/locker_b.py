@@ -1,0 +1,8 @@
+import threading
+
+_block = threading.Lock()
+
+
+def fb():
+    with _block:
+        pass

@@ -1,0 +1,19 @@
+"""JTL502 positive (with locker_b.py): module A holds its lock and
+calls into B, which acquires B's lock; module B holds its lock and
+calls back into A, which acquires A's lock — a cross-module
+acquisition-order cycle no single-file pass can see."""
+import threading
+
+import locker_b
+
+_alock = threading.Lock()
+
+
+def fa():
+    with _alock:
+        locker_b.fb()
+
+
+def fd():
+    with _alock:
+        pass

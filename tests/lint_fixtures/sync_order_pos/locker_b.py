@@ -1,0 +1,15 @@
+import threading
+
+import locker_a
+
+_block = threading.Lock()
+
+
+def fb():
+    with _block:
+        pass
+
+
+def fc():
+    with _block:
+        locker_a.fd()

@@ -1,0 +1,21 @@
+"""JTL501 negative: every access site of `items` — thread side and
+caller side — holds the ONE guarding lock (snapshot-under-lock)."""
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # jtsan: guarded-by=self._lock
+        self.items = {}
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.items["beat"] = self.items.get("beat", 0) + 1
+
+    def stats(self):
+        with self._lock:
+            return dict(self.items)

@@ -1,0 +1,20 @@
+"""JTL501 positive: the pump thread mutates `items` under the lock,
+but the caller-facing stats() reads it with NO lock — divergent
+lock-sets on a structure two threads share (the Eraser discipline)."""
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = {}
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.items["beat"] = self.items.get("beat", 0) + 1
+
+    def stats(self):
+        return dict(self.items)

@@ -1,0 +1,27 @@
+"""JTL501 incident regression — the PR 13-era WFQ shape: the dispatch
+thread rotates the weighted-fair tenant slot under the queue CONDITION
+while stats() walks the rotation under a separate stats lock. Each side
+is individually locked; the lock-sets are DISJOINT, so they exclude
+nothing — exactly the class of bug a single-class heuristic (JTL203)
+cannot see past "there is a with-lock around it"."""
+import threading
+
+
+class WfqScheduler:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._stats_lock = threading.Lock()
+        self._rotation = []
+        self._thread = threading.Thread(target=self._dispatch,
+                                        daemon=True)
+        self._thread.start()
+
+    def _dispatch(self):
+        while True:
+            with self._cond:
+                if self._rotation:
+                    self._rotation.append(self._rotation.pop(0))
+
+    def stats(self):
+        with self._stats_lock:
+            return list(self._rotation)
