@@ -866,6 +866,110 @@ def bench_serve(model, n_hist: int = 96, clients: int = 8,
     }
 
 
+def bench_campaign(model, n_specs: int = 48, seed: int = 0xCA3,
+                   shrink_ops: int = 140) -> dict:
+    """Scenario-factory lane (ISSUE 15 tentpole), three measurements:
+
+      1. **Campaign end-to-end specs/s** — one smoke-scaled campaign
+         (deterministic sim scenarios on the virtual-time loop, corpus-
+         batched checking on the warm pool, triage + shrink + bank into
+         a throwaway store) — the headline gated round over round.
+      2. **Shrink-checks/s, batched vs sequential** — the SAME ddmin
+         reduction of one seeded-invalid register history driven two
+         ways: candidates re-checked as one corpus launch per round
+         (the production route) vs one launch per candidate (what a
+         naive shrinker pays). Identical candidate sequences by
+         construction (verdicts are pure functions of candidates), so
+         the speedup isolates the batching.
+      3. **Banked-corpus replay wall** — the regression lane's cost:
+         re-falsify everything the campaign banked in one batched
+         launch per model.
+    """
+    import shutil
+    import tempfile
+
+    from jepsen_etcd_demo_tpu import sched
+    from jepsen_etcd_demo_tpu.campaign import replay_corpus, run_campaign
+    from jepsen_etcd_demo_tpu.campaign.triage import (ddmin_shrink,
+                                                      make_check_batch)
+    from jepsen_etcd_demo_tpu.utils.fuzz import (gen_register_history,
+                                                 mutate_history)
+
+    td = tempfile.mkdtemp(prefix="bench-campaign-")
+    try:
+        t0 = time.perf_counter()
+        report = run_campaign(n_specs=n_specs, seed=seed, scale=0.4,
+                              bug_rate=0.4, workers=4, store_root=td)
+        campaign_wall = time.perf_counter() - t0
+        rep = report.to_dict()
+
+        # Shrink arms: a seeded-invalid history big enough that the
+        # candidate batches have real width.
+        rng = random.Random(seed)
+        direct = lambda encs, m: sched.check_corpus(encs, m)[0]  # noqa: E731
+
+        def sequential(encs, m):
+            out = []
+            for e in encs:
+                out.extend(sched.check_corpus([e], m)[0])
+            return out
+
+        batched_probe = make_check_batch(model, direct)
+        bad = None
+        for _ in range(16):
+            cand = mutate_history(
+                rng, gen_register_history(rng, n_ops=shrink_ops,
+                                          n_procs=6, p_info=0.01))
+            if batched_probe([cand])[0]:
+                bad = cand
+                break
+        assert bad is not None, "could not seed an invalid shrink fixture"
+        # Warmup shrink compiles both arms' bucket shapes, then each
+        # arm re-runs the identical reduction.
+        ddmin_shrink(bad, batched_probe)
+        t0 = time.perf_counter()
+        sres = ddmin_shrink(bad, batched_probe)
+        batched_wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        seq_res = ddmin_shrink(bad, make_check_batch(model, sequential))
+        seq_wall = time.perf_counter() - t0
+        assert seq_res.to_ops == sres.to_ops \
+            and seq_res.checks == sres.checks, \
+            "sequential and batched ddmin diverged — candidate order " \
+            "is no longer deterministic"
+
+        t0 = time.perf_counter()
+        replay = replay_corpus(td)
+        replay_wall = time.perf_counter() - t0
+        return {
+            "specs": n_specs,
+            "campaign_wall_s": round(campaign_wall, 4),
+            "specs_per_sec": round(n_specs / campaign_wall, 2)
+            if campaign_wall else 0.0,
+            "keys_checked": rep["keys_checked"],
+            "falsified_runs": rep["falsified_runs"],
+            "unique_signatures": rep["unique_signatures"],
+            "banked": len(rep["banked"]),
+            "shrink_from_ops": sres.from_ops,
+            "shrink_to_ops": sres.to_ops,
+            "shrink_checks": sres.checks,
+            "shrink_launches": sres.launches,
+            "shrink_one_minimal": sres.one_minimal,
+            "shrink_wall_s": round(batched_wall, 4),
+            "shrink_checks_per_sec": round(sres.checks / batched_wall, 1)
+            if batched_wall else 0.0,
+            "sequential_shrink_wall_s": round(seq_wall, 4),
+            "speedup_vs_sequential": round(seq_wall / batched_wall, 2)
+            if batched_wall else 0.0,
+            "replay_entries": replay["entries"],
+            "replay_checked": replay["checked"],
+            "replay_ok": replay["ok"],
+            "replay_wall_s": round(replay_wall, 4),
+        }
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+
+
 def build_stream_run(n_keys: int = 16, ops_per_key: int = 400,
                      seed: int = 0x57CA):
     """ONE generated independent-key run for the streaming lane: per-key
@@ -1479,6 +1583,7 @@ def main():
                 "sweep": obs.sweep_stats(None),
                 "elle": obs.elle_stats(None),
                 "serve": obs.serve_stats(None),
+                "campaign": obs.campaign_stats(None),
                 # Which tuning profile the run INTENDED to use (ISSUE 4:
                 # tools/print_profile.py prints the full resolved view).
                 "profile": _profile_record(),
@@ -1559,6 +1664,10 @@ def main():
             # daemon vs the serial baseline, verdicts certified
             # bit-identical to the analyze route; acceptance >= 3x.
             serve_lane = bench_serve(model, min_speedup=3.0)
+            # Scenario-factory lane (ISSUE 15): campaign specs/s end to
+            # end, batched-vs-sequential ddmin shrink checks/s, and the
+            # banked-corpus replay wall.
+            campaign_lane = bench_campaign(model)
             # Inside the capture: the 100k lane's compile/execute/encode
             # seconds must land in the same kernel_phases breakdown as
             # every other lane when it actually runs.
@@ -1585,6 +1694,7 @@ def main():
             "sweep": obs.sweep_stats(cap.metrics),
             "elle": obs.elle_stats(cap.metrics),
             "serve": obs.serve_stats(cap.metrics),
+            "campaign": obs.campaign_stats(cap.metrics),
             "profile": _profile_record(),
             "health": health_rec,
             "degraded": True,
@@ -1626,6 +1736,7 @@ def main():
         "streaming": stream_lane,
         "elle": elle_lane,
         "serve": serve_lane,
+        "campaign": campaign_lane,
     }
     if "roofline" in corpus:
         detail["roofline"] = corpus["roofline"]
@@ -1668,6 +1779,10 @@ def main():
         # zeros permitted, never absent (the degraded records above
         # carry the all-zero shape).
         "serve": obs.serve_stats(cap.metrics),
+        # Scenario-factory accounting over the same capture (ISSUE 15):
+        # spec/falsification/shrink/bank counters — zeros permitted,
+        # never absent.
+        "campaign": obs.campaign_stats(cap.metrics),
         # The tuning profile this round resolved (ISSUE 4): hash +
         # non-default fields with provenance; detail.tuned measures it.
         "profile": _profile_record(),

@@ -36,7 +36,7 @@ from .findings import Finding
 # with threads or event loops. "" means top-level modules (compose.py).
 KERNEL_SCOPES = ("ops", "parallel", "sched", "stream", "tune")
 CONCURRENCY_SCOPES = ("runner", "stream", "sched", "db", "web", "clients",
-                      "control", "serve")
+                      "control", "serve", "campaign")
 
 PACKAGE_NAME = "jepsen_etcd_demo_tpu"
 

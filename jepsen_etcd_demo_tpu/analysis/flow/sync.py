@@ -80,8 +80,11 @@ from .index import FlowIndex
 # Package scopes the concurrency model covers: everything with threads,
 # handlers or locks in it. None (files outside the package — the
 # fixture mini-projects) and "" (top-level modules) are always in.
+# "campaign" joined in ISSUE 15: the scenario-factory executor spawns
+# worker pools and in-process cluster serve threads — JTL505's
+# join-on-shutdown discipline applies to all of them.
 SYNC_SCOPES = ("serve", "stream", "sched", "runner", "web", "obs", "db",
-               "clients", "control")
+               "clients", "control", "campaign")
 
 _ANNOT_RE = re.compile(r"#\s*jtsan:\s*(.+?)\s*$")
 _DIRECTIVES = ("returns", "alias-of", "guarded-by", "hb")

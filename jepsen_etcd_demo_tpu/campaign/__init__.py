@@ -1,0 +1,58 @@
+"""campaign — the scenario factory (ISSUE 15 tentpole; ROADMAP item 5).
+
+The harness can check millions of configs/s (sched/, stream/, serve/)
+but until now explored scenarios one handcrafted `jepsen-tpu test` at a
+time. This package closes that gap: the MACHINE imagines the scenarios,
+runs them at high concurrency, and turns what falsifies into a
+regression corpus.
+
+  * specs.py   — deterministic ScenarioSpec sampler over the existing
+    generator algebra (mix/stagger/phases, compose.py) × workload
+    families × nemesis schedules × injectable-bug axes × cluster
+    shapes. Same seed -> same spec list, always.
+  * vclock.py  — the virtual-time asyncio loop that executes a REAL
+    composed fake_test deterministically and at memory speed: every
+    stagger delay, nemesis sleep and time-limit is virtual, so a
+    30-virtual-second scenario runs in milliseconds and two runs of the
+    same spec produce the IDENTICAL history.
+  * cluster.py — the in-process minietcd cluster (db/minietcd.py's
+    KeyStore + HTTP handler served from ephemeral ports inside this
+    process) for live-backend scenarios — the substrate the new fault
+    planes (nemesis/cluster_faults.py: member churn, disk faults,
+    lease skew) operate on.
+  * engine.py  — the executor: runs specs (virtual or live, live with
+    stream/'s fail-fast abort), batches every per-key history through
+    sched.check_corpus so campaign throughput rides the same bucket /
+    warm-kernel-pool discipline as everything else (or submits them to
+    the serve scheduler as the "campaign" background tenant).
+  * triage.py  — anomaly signatures (dedupe falsifying runs) and the
+    TPU-parallel ddmin shrinker: every delta-debugging round's
+    candidate op-subsets are re-checked as ONE vmapped corpus launch.
+  * bank.py    — the regression corpus: minimal witnesses persisted
+    under store/corpus/ with full spec provenance, replayed by
+    `jepsen-tpu campaign --replay-corpus`, the bench campaign lane and
+    tier-1.
+
+See doc/campaign.md for the spec schema, the signature taxonomy, the
+batched-ddmin soundness argument and capacity planning.
+"""
+
+from .bank import BankedWitness, bank_witness, load_corpus, replay_corpus
+from .engine import CampaignReport, run_campaign
+from .specs import ScenarioSpec, sample_specs
+from .triage import Signature, classify, ddmin_shrink, verify_routes
+
+__all__ = [
+    "BankedWitness",
+    "CampaignReport",
+    "ScenarioSpec",
+    "Signature",
+    "bank_witness",
+    "classify",
+    "ddmin_shrink",
+    "load_corpus",
+    "replay_corpus",
+    "run_campaign",
+    "sample_specs",
+    "verify_routes",
+]

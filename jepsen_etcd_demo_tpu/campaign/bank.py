@@ -1,0 +1,189 @@
+"""The regression corpus bank: minimal witnesses under store/corpus/.
+
+Every shrunk, route-verified counterexample the campaign finds is
+banked as one JSON file under
+
+    <store>/corpus/<signature-slug>/<content-hash>.json
+
+carrying the minimal history, the checker expectation (valid False +
+dead_step), the signature, and full provenance: the ScenarioSpec that
+produced it, the campaign (seed, spec count) it ran in, and the shrink
+accounting (from/to op counts, rounds, candidate checks). File names
+are content hashes (signature + model + history bytes), so re-running
+the same campaign re-banks byte-identically instead of duplicating —
+and the bank's CONTENT is deterministic even though `banked_at` is not
+part of the hash.
+
+`replay_corpus` is the regression lane: load every banked witness,
+re-check them all in one corpus-batched launch per model (the same
+bucket/warm-pool discipline the campaign used), and demand each still
+falsifies with its banked dead_step. `jepsen-tpu campaign
+--replay-corpus`, the bench campaign lane and tier-1 all drive it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass
+from datetime import datetime, timezone
+from pathlib import Path
+from typing import Optional
+
+from ..ops.op import Op, history_from_jsonl, history_to_jsonl
+from ..store.store import CORPUS_DIRNAME
+from .triage import Signature
+
+BANK_VERSION = 1
+
+
+@dataclass
+class BankedWitness:
+    path: Path
+    signature: dict
+    model: str
+    history: list[Op]
+    expect: dict
+    spec: dict
+    campaign: dict
+    shrink: dict
+
+    @classmethod
+    def load(cls, path: Path) -> "BankedWitness":
+        d = json.loads(path.read_text())
+        return cls(path=path, signature=d["signature"], model=d["model"],
+                   history=history_from_jsonl(d["history"]),
+                   expect=d["expect"], spec=d.get("spec", {}),
+                   campaign=d.get("campaign", {}),
+                   shrink=d.get("shrink", {}))
+
+
+def corpus_root(store_root: str | Path) -> Path:
+    return Path(store_root) / CORPUS_DIRNAME
+
+
+def _content_hash(sig_slug: str, model: str, history_jsonl: str) -> str:
+    h = hashlib.sha1()
+    h.update(sig_slug.encode())
+    h.update(model.encode())
+    h.update(history_jsonl.encode())
+    return h.hexdigest()[:16]
+
+
+def bank_witness(store_root: str | Path, sig: Signature, model: str,
+                 history: list[Op], expect: dict, spec: dict,
+                 campaign: dict, shrink: dict) -> Path:
+    """Persist one minimal witness; idempotent by content hash."""
+    hist_jsonl = history_to_jsonl(history)
+    name = _content_hash(sig.slug, model, hist_jsonl)
+    out = corpus_root(store_root) / sig.slug / f"{name}.json"
+    if out.exists():
+        return out
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps({
+        "version": BANK_VERSION,
+        "signature": sig.to_dict(),
+        "model": model,
+        "history": hist_jsonl,
+        "expect": expect,
+        "spec": spec,
+        "campaign": campaign,
+        "shrink": shrink,
+        "banked_at": datetime.now(timezone.utc).isoformat(),
+    }, indent=2))
+    return out
+
+
+def load_corpus(store_root: str | Path) -> list[BankedWitness]:
+    """Every banked witness, in deterministic (slug, hash) order.
+    Unreadable entries are skipped with a stderr note, never fatal —
+    the replay lane must report on the healthy majority."""
+    import sys
+
+    root = corpus_root(store_root)
+    out: list[BankedWitness] = []
+    if not root.is_dir():
+        return out
+    for path in sorted(root.glob("*/*.json")):
+        try:
+            out.append(BankedWitness.load(path))
+        except (ValueError, KeyError, OSError) as e:
+            print(f"# skipping corpus entry {path}: {e}", file=sys.stderr)
+    return out
+
+
+def replay_corpus(store_root: str | Path,
+                  route_check=None) -> dict:
+    """Re-falsify the whole bank: one corpus-batched launch per model
+    (via `route_check(encs, model) -> results`, default
+    sched.check_corpus). Returns the replay report; `ok` is False when
+    any banked witness no longer falsifies (a checker regression — the
+    exact event the bank exists to catch) or falsifies at a different
+    dead_step than banked."""
+    from .. import obs, sched
+    from ..checkers.linearizable import Linearizable
+
+    if route_check is None:
+        def route_check(encs, model):
+            results, _kernel, _stats = sched.check_corpus(encs, model)
+            return results
+
+    entries = load_corpus(store_root)
+    failures: list[dict] = []
+    checked = 0
+    by_model: dict[str, list[BankedWitness]] = {}
+    for w in entries:
+        by_model.setdefault(w.model, []).append(w)
+    for model_name in sorted(by_model):
+        group = by_model[model_name]
+        lin = Linearizable(model=model_name)
+        encs, bank = [], []
+        for w in group:
+            try:
+                encs.append(lin.encode(w.history))
+                bank.append(w)
+            except Exception as e:
+                failures.append({"path": str(w.path),
+                                 "error": f"encode: {e}"})
+        if not encs:
+            continue
+        results = route_check(encs, lin.model)
+        checked += len(encs)
+        for w, one in zip(bank, results):
+            if one.get("valid") is not False:
+                failures.append({
+                    "path": str(w.path),
+                    "error": f"no longer falsifies (valid="
+                             f"{one.get('valid')!r})"})
+            elif int(one.get("dead_step", -1)) \
+                    != int(w.expect.get("dead_step", -1)):
+                failures.append({
+                    "path": str(w.path),
+                    "error": f"dead_step drifted: banked "
+                             f"{w.expect.get('dead_step')} vs "
+                             f"{one.get('dead_step')}"})
+    m = obs.get_metrics()
+    m.counter("campaign.replayed").add(checked)
+    if failures:
+        m.counter("campaign.replay_failures").add(len(failures))
+    return {
+        "ok": not failures,
+        "entries": len(entries),
+        "checked": checked,
+        "signatures": len({w.signature.get("slug") for w in entries}),
+        "failures": failures,
+    }
+
+
+def bank_summary(store_root: str | Path) -> Optional[dict]:
+    """Cheap index-page summary: witness count per signature slug (a
+    directory listing, no JSON parse). None when no bank exists."""
+    root = corpus_root(store_root)
+    if not root.is_dir():
+        return None
+    per_sig = {d.name: len(list(d.glob("*.json")))
+               for d in sorted(root.iterdir()) if d.is_dir()}
+    per_sig = {k: v for k, v in per_sig.items() if v}
+    if not per_sig:
+        return None
+    return {"signatures": per_sig, "total": sum(per_sig.values())}

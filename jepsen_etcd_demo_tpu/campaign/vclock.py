@@ -1,0 +1,111 @@
+"""Virtual-time asyncio: deterministic, instant execution of a real run.
+
+The runner (runner/core.py) is an asyncio interpreter whose only
+nondeterminism sources are wall-clock time (the recorder's monotonic
+clock drives stagger/time-limit/sleep generators) and the scheduling
+jitter real sleeps introduce. Replace the clock and both vanish: this
+module's loop never blocks in `select` — when the loop would sleep for
+its next timer it ADVANCES a virtual clock by that amount instead — and
+the recorder reads that same virtual clock. The result:
+
+  * a 30-virtual-second scenario executes in milliseconds of real time
+    (the campaign's specs/s comes from here, not from trimming the
+    generator schedules);
+  * two executions of the same composed test with the same seed produce
+    the IDENTICAL history (timer order is (when, tiebreak-counter),
+    ready-queue order is FIFO, no foreign wakeups) — the determinism
+    the spec/verdict reproducibility contract stands on.
+
+Only loops with NO real I/O qualify: the fake in-process cluster
+(clients/fake_kv.py) awaits locks and sleeps exclusively, so fake_test
+compositions run here; live minietcd scenarios (campaign/cluster.py)
+use a normal loop — HTTP round-trips are real time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import selectors
+from typing import Awaitable, Callable, TypeVar
+
+from ..runner.history import HistoryRecorder
+
+T = TypeVar("T")
+
+
+class _InstantSelector:
+    """Selector shim that never blocks: a `select(timeout)` that would
+    have slept advances the owning loop's virtual clock by `timeout`
+    and polls (timeout 0) instead. Registration calls delegate to a
+    real selector so the loop's self-pipe keeps working."""
+
+    def __init__(self, loop: "VirtualTimeLoop"):
+        self._loop = loop
+        self._inner = selectors.DefaultSelector()
+
+    def select(self, timeout=None):
+        if timeout:
+            self._loop._vtime += timeout
+        return self._inner.select(0)
+
+    def register(self, *a, **kw):
+        return self._inner.register(*a, **kw)
+
+    def unregister(self, *a, **kw):
+        return self._inner.unregister(*a, **kw)
+
+    def modify(self, *a, **kw):
+        return self._inner.modify(*a, **kw)
+
+    def get_map(self):
+        return self._inner.get_map()
+
+    def get_key(self, fileobj):
+        return self._inner.get_key(fileobj)
+
+    def close(self):
+        return self._inner.close()
+
+
+class VirtualTimeLoop(asyncio.SelectorEventLoop):
+    """SelectorEventLoop whose `time()` is a virtual clock advanced by
+    would-be sleeps. Timers (`call_later`, and everything built on them:
+    asyncio.sleep, wait_for, Condition timeouts) fire in exact virtual
+    order with zero real delay."""
+
+    def __init__(self):
+        self._vtime = 0.0
+        super().__init__(None)
+        self._selector = _InstantSelector(self)
+
+    def time(self) -> float:
+        return self._vtime
+
+
+class VirtualRecorder(HistoryRecorder):
+    """HistoryRecorder whose clock is the virtual loop's, so generator
+    combinators (stagger/time-limit/sleep) see virtual time and the
+    recorded op timestamps are deterministic."""
+
+    def __init__(self, loop: VirtualTimeLoop, listener=None):
+        super().__init__(start_ns=0, listener=listener)
+        self._loop = loop
+
+    def now(self) -> int:
+        return int(self._loop.time() * 1e9)
+
+
+def run_virtual(main: Callable[[VirtualTimeLoop, VirtualRecorder],
+                               Awaitable[T]]) -> T:
+    """Run `main(loop, recorder)` to completion on a fresh virtual-time
+    loop. The loop is private to this call (never installed as the
+    thread default beyond it) so campaign executor threads can each
+    drive their own scenario concurrently."""
+    loop = VirtualTimeLoop()
+    recorder = VirtualRecorder(loop)
+    try:
+        asyncio.set_event_loop(loop)
+        return loop.run_until_complete(main(loop, recorder))
+    finally:
+        asyncio.set_event_loop(None)
+        loop.close()

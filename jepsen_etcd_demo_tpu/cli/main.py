@@ -199,6 +199,59 @@ def build_parser() -> argparse.ArgumentParser:
     _add_sweep_mode_flag(c)
     _add_mesh_shape_flag(c)
 
+    g = sub.add_parser(
+        "campaign",
+        help="the scenario factory (campaign/; doc/campaign.md): sample "
+             "N deterministic scenarios over the generator algebra, run "
+             "them against in-process clusters, corpus-batch-check "
+             "everything, dedupe falsifying runs by anomaly signature, "
+             "ddmin-shrink one witness per signature at TPU parallelism "
+             "and bank the minimal counterexamples under store/corpus/")
+    g.add_argument("--specs", type=positive_int, default=256,
+                   help="scenario count (default 256)")
+    g.add_argument("--seed", type=int, default=0,
+                   help="campaign seed: same seed -> same spec list -> "
+                        "same verdicts, signatures and minimal "
+                        "witnesses (determinism!)")
+    g.add_argument("--families", default=None,
+                   help="comma-separated workload families (default: "
+                        "register,gset,queue,multiregister)")
+    g.add_argument("--bug-rate", type=float, default=0.25,
+                   help="fraction of specs carrying a seeded injectable "
+                        "bug (default 0.25)")
+    g.add_argument("--live", type=int, default=0,
+                   help="how many specs run against a live in-process "
+                        "minietcd cluster (real HTTP, stream fail-fast, "
+                        "the member-churn/disk-fault/lease-skew planes; "
+                        "default 0 — sim only)")
+    g.add_argument("--scale", type=positive_float, default=1.0,
+                   help="schedule-size multiplier (bench smokes use <1)")
+    g.add_argument("--workers", type=positive_int, default=4,
+                   help="executor threads for sim scenarios (default 4)")
+    g.add_argument("--route", default="direct",
+                   choices=["direct", "serve"],
+                   help="check route: direct = sched.check_corpus on "
+                        "the warm pool (default); serve = submit every "
+                        "wave to the continuous-batching scheduler as "
+                        "the 'campaign' tenant")
+    g.add_argument("--no-shrink", action="store_true",
+                   help="triage only — skip the ddmin shrinker")
+    g.add_argument("--no-bank", action="store_true",
+                   help="do not persist minimal witnesses")
+    g.add_argument("--max-shrink-checks", type=positive_int, default=4096,
+                   help="candidate-recheck budget per shrink "
+                        "(default 4096)")
+    g.add_argument("--replay-corpus", action="store_true",
+                   help="skip the campaign: re-falsify every banked "
+                        "witness under store/corpus/ in one batched "
+                        "launch per model; exit 1 if any no longer "
+                        "falsifies")
+    g.add_argument("--store", default="store",
+                   help="results store root (the corpus bank lives at "
+                        "<store>/corpus/)")
+    _add_sweep_mode_flag(g)
+    _add_mesh_shape_flag(g)
+
     u = sub.add_parser(
         "tune",
         help="autotune the KernelLimits knob space on THIS machine and "
@@ -665,9 +718,7 @@ def _cmd_corpus_checked(args, multislice: bool) -> int:
         return 0
     t0 = time.perf_counter()
     invalid, kernels, n_keys = [], set(), 0
-    sched_stats = {"launches": 0, "steps_real": 0, "steps_padded": 0,
-                   "sweep_steps_sparse": 0, "sweep_steps_dense": 0,
-                   "configs_pruned": 0, "sparse_overflow_rounds": 0}
+    sched_stats: dict = {}
     for model_name, entries in sorted(by_model.items()):
         model = Linearizable(model=model_name).model
         if multislice:
@@ -681,10 +732,7 @@ def _cmd_corpus_checked(args, multislice: bool) -> int:
         else:
             results, kernel, stats = sched.check_corpus(
                 [e[2] for e in entries], model)
-            for f in ("launches", "steps_real", "steps_padded",
-                      "sweep_steps_sparse", "sweep_steps_dense",
-                      "configs_pruned", "sparse_overflow_rounds"):
-                sched_stats[f] += stats.get(f, 0)
+            sched.fold_stats(sched_stats, stats)
         kernels.add(kernel)
         n_keys += len(entries)
         invalid.extend({"run": r, "key": k, "model": model_name}
@@ -724,6 +772,39 @@ def _cmd_corpus_checked(args, multislice: bool) -> int:
         out["devices"] = jax.device_count()
     print(json.dumps(out))
     return 0 if not invalid else 1
+
+
+def cmd_campaign(args) -> int:
+    """`jepsen-tpu campaign`: the scenario factory end to end, or
+    (--replay-corpus) the regression lane over the banked corpus. One
+    obs capture and one warm kernel pool span the whole campaign —
+    that amortization is the design (campaign/engine.py)."""
+    from .. import obs
+    from ..campaign import replay_corpus, run_campaign
+
+    enable_compilation_cache(args.store)
+    _apply_sweep_mode(args)
+    _apply_mesh_shape(args)
+    families = ([f.strip() for f in args.families.split(",") if f.strip()]
+                if args.families else None)
+    with obs.capture():
+        if args.replay_corpus:
+            report = replay_corpus(args.store)
+            print(json.dumps(report))
+            return 0 if report["ok"] else 1
+        try:
+            report = run_campaign(
+                n_specs=args.specs, seed=args.seed, families=families,
+                bug_rate=args.bug_rate, live=args.live,
+                scale=args.scale, workers=args.workers,
+                route=args.route, shrink=not args.no_shrink,
+                bank=not args.no_bank, store_root=args.store,
+                max_shrink_checks=args.max_shrink_checks)
+        except ValueError as e:   # e.g. an unknown --families entry
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    print(json.dumps(report.to_dict()))
+    return 0
 
 
 def cmd_tune(args) -> int:
@@ -844,6 +925,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return cmd_analyze(args)
     if args.command == "corpus":
         return cmd_corpus(args)
+    if args.command == "campaign":
+        return cmd_campaign(args)
     if args.command == "tune":
         return cmd_tune(args)
     if args.command == "plan":

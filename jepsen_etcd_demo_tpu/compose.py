@@ -461,6 +461,7 @@ def fake_test(opts: dict, store: Optional[FakeKVStore] = None) -> dict:
     opts["local_mode"] = True
     if store is None:
         store = FakeKVStore(seed=int(opts.get("seed", 0)),
+                            op_delay_s=float(opts.get("op_delay", 0.0)),
                             stale_read_prob=float(
                                 opts.get("stale_read_prob", 0.0)),
                             lost_write_prob=float(

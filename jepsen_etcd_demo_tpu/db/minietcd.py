@@ -59,6 +59,30 @@ from urllib.parse import parse_qs, urlparse
 VERSION = "2.3.8-minietcd"   # v2-era version string: _etcd_version probes
 #                              parse it as (2,3) => v2 API default-on
 
+# Campaign fault plane (ISSUE 15 satellite; nemesis/cluster_faults.py
+# DiskFaultNemesis): persistence faults a KeyStore can be told to
+# inject. ENV-GATED: `fault_mode` is honored only while this variable is
+# set truthy, so a production minietcd can never be bent by a stray
+# attribute write — the nemesis sets both, scoped to its fault window.
+FAULT_HOOK_ENV = "JEPSEN_TPU_MINIETCD_FAULT_HOOK"
+FAULT_DISK_FULL = "disk-full"        # acked writes never reach the disk
+FAULT_CORRUPT_WRITE = "corrupt-write"  # snapshot garbles the last value
+
+
+def fault_hook_enabled() -> bool:
+    return os.environ.get(FAULT_HOOK_ENV, "").lower() \
+        in ("1", "true", "yes", "on")
+
+
+def _garble(value: str) -> str:
+    """Deterministic on-disk corruption that stays in the op language:
+    numeric register values bump by one (guaranteed != the acked value,
+    still encodable by the checker), anything else reverses."""
+    try:
+        return str(int(value) + 1)
+    except (TypeError, ValueError):
+        return value[::-1] if value else "corrupt"
+
 
 class KeyStore:
     """The single-copy store: key -> (value, modifiedIndex), one global
@@ -73,6 +97,11 @@ class KeyStore:
         self.lock = threading.Lock()
         self.path = (os.path.join(data_dir, "minietcd.json")
                      if data_dir else None)
+        # Campaign fault plane (env-gated, see FAULT_HOOK_ENV): which
+        # persistence fault to inject, and how many writes it has bent —
+        # the DiskFaultNemesis's observability counter.
+        self.fault_mode: str | None = None
+        self.faults_injected = 0
         if self.path and os.path.exists(self.path):
             with open(self.path) as f:
                 snap = json.load(f)
@@ -82,18 +111,45 @@ class KeyStore:
     def _persist_locked(self) -> None:
         if not self.path:
             return
+        mode = self.fault_mode if fault_hook_enabled() else None
+        if mode == FAULT_DISK_FULL:
+            # The seeded bug: a server that swallows ENOSPC — the write
+            # is acked and served from memory but never reaches the
+            # disk, so a crash-restart from the snapshot silently loses
+            # it (the lost-acked-write the checker falsifies after the
+            # nemesis's restart leg).
+            self.faults_injected += 1
+            return
+        data = self.data
+        if mode == FAULT_CORRUPT_WRITE:
+            # Corrupt-on-write: the snapshot garbles the most recently
+            # modified key's value on its way to disk; the in-memory
+            # copy stays correct, so the corruption surfaces only after
+            # a restart reloads it (an invented read the checker
+            # falsifies).
+            data = dict(self.data)
+            latest = max(data, key=lambda k: data[k][1], default=None)
+            if latest is not None:
+                v, idx = data[latest]
+                data[latest] = (_garble(v), idx)
+                self.faults_injected += 1
         # Atomic replace: a daemon kill -9 (the KillNemesis) must never
         # leave a torn snapshot — either the old state or the new one.
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(self.path))
         with os.fdopen(fd, "w") as f:
             json.dump({"index": self.index,
-                       "keys": {k: list(v) for k, v in self.data.items()}},
+                       "keys": {k: list(v) for k, v in data.items()}},
                       f)
         os.replace(tmp, self.path)
 
     # Each method returns (status, body) in etcd v2 wire shape.
 
-    def get(self, key: str):
+    def get(self, key: str, quorum: bool = False):
+        # `quorum` is part of the store-frontend protocol (the handler
+        # forwards the client's ?quorum=true): the single-copy KeyStore
+        # is linearizable either way, but frontends that bend reads
+        # (campaign/cluster._MemberStore's lease plane) must see it to
+        # honor etcd's q=true bypass.
         with self.lock:
             children = sorted(
                 (idx, k, v) for k, (v, idx) in self.data.items()
@@ -231,7 +287,13 @@ def _handler_for(store: KeyStore):
                 self._reply(200, {"etcdserver": VERSION,
                                   "health": "true"})
                 return
-            self._reply(*store.get(self._key()))
+            # Forward q=true: the plain KeyStore ignores it, but the
+            # campaign's leased cluster frontends serve non-quorum
+            # reads from an expired lease snapshot — a quorum read must
+            # bypass that (etcd's q=true semantics).
+            self._reply(*store.get(
+                self._key(),
+                quorum=self._params().get("quorum") == "true"))
 
         def do_PUT(self):
             # Real etcd v2 accepts the payload fields in EITHER location

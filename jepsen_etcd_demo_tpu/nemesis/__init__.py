@@ -15,3 +15,5 @@ from .partition import (  # noqa: F401
 from .process_faults import KillNemesis, PauseNemesis  # noqa: F401
 from .clock import (ClockSkewNemesis, ClockStrobeNemesis,  # noqa: F401
                     FakeClockSkewNemesis)
+from .cluster_faults import (DiskFaultNemesis,  # noqa: F401
+                             LeaseSkewNemesis, MemberChurnNemesis)

@@ -1,0 +1,167 @@
+"""Cluster fault planes the roadmap's item 4 named and never shipped:
+membership churn, disk faults, and lease/watch skew (ISSUE 15
+satellite). All three operate on the in-process minietcd cluster
+(campaign/cluster.MiniCluster) — the campaign's live backend — through
+the standard Nemesis protocol, so the composition layer schedules them
+exactly like the partition/kill/pause family.
+
+Each plane carries its own SEEDED BUG so the campaign (and the golden
+tests in tests/test_campaign.py) can prove the checker falsifies it:
+
+  * MemberChurnNemesis — healthy churn (spawn/teardown of standby
+    members over the shared store) preserves linearizability;
+    fork=True boots the standby from a snapshot FORK — a stale replica
+    whose reads falsify.
+  * DiskFaultNemesis — drives the env-gated KeyStore persistence hook
+    (db/minietcd.py): "disk-full" acks writes that never reach the
+    snapshot, "corrupt-write" garbles the last value on its way to
+    disk; the :stop leg crash-restarts the storage plane from disk,
+    surfacing the lost/corrupted state the checker falsifies. The env
+    gate is set only for the fault window and always restored.
+  * LeaseSkewNemesis — grants a minority of members a frozen read
+    lease (the clock-skewed leaseholder): their non-quorum reads
+    answer from the expired snapshot until :stop revokes — the
+    stale-read plane quorum reads are immune to, matching etcd's
+    q=true semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+from .. import obs
+from ..db.minietcd import FAULT_HOOK_ENV
+from ..ops.op import Op
+from .base import Nemesis, random_minority
+
+
+class MemberChurnNemesis(Nemesis):
+    """:start tears down a random minority of members and spawns one
+    standby replacement per removed member; :stop restores the original
+    membership. `fork` seeds the stale-replica bug on every spawned
+    standby."""
+
+    def __init__(self, cluster, seed: int = 0, fork: bool = False):
+        self.cluster = cluster
+        self.rng = random.Random(seed)
+        self.fork = fork
+        self.churned: list[str] = []
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        if op.f == "start":
+            self.churned = random_minority(self.rng,
+                                           self.cluster.members())
+            for node in self.churned:
+                self.cluster.teardown_member(node)
+                # The standby replacement: same node name, fresh
+                # frontend (fork=True -> the seeded stale-replica bug).
+                self.cluster.spawn_member(node, fork=self.fork)
+                obs.get_tracer().event("fault.member_churn", node=node,
+                                       fork=self.fork)
+            value = {"churned": self.churned, "fork": self.fork}
+        elif op.f == "stop":
+            for node in self.churned:
+                # Heal: replace whatever serves the node with a faithful
+                # shared-store member.
+                self.cluster.spawn_member(node, fork=False)
+                obs.get_tracer().event("fault.member_restore", node=node)
+            value = {"restored": self.churned}
+            self.churned = []
+        else:
+            value = f"unknown nemesis op {op.f}"
+        return Op(type="info", f=op.f, value=value, process=op.process)
+
+    async def teardown(self, test: dict) -> None:
+        for node in self.churned:
+            self.cluster.spawn_member(node, fork=False)
+        self.churned = []
+
+
+class DiskFaultNemesis(Nemesis):
+    """:start arms the KeyStore persistence fault (mode "disk-full" or
+    "corrupt-write") behind its env gate; :stop disarms it and
+    CRASH-RESTARTS the storage plane from disk — the leg that turns the
+    silently-bent persistence into checker-visible lost/invented
+    state."""
+
+    def __init__(self, cluster, mode: str = "disk-full", seed: int = 0):
+        self.cluster = cluster
+        self.mode = mode
+        self.rng = random.Random(seed)
+        self._env_prev: str | None = None
+        self._armed = False
+
+    def _arm(self) -> None:
+        if not self._armed:
+            self._env_prev = os.environ.get(FAULT_HOOK_ENV)
+            os.environ[FAULT_HOOK_ENV] = "1"
+            self._armed = True
+        self.cluster.store.fault_mode = self.mode
+
+    def _disarm(self) -> None:
+        self.cluster.store.fault_mode = None
+        if self._armed:
+            if self._env_prev is None:
+                os.environ.pop(FAULT_HOOK_ENV, None)
+            else:
+                os.environ[FAULT_HOOK_ENV] = self._env_prev
+            self._armed = False
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        if op.f == "start":
+            self._arm()
+            obs.get_tracer().event("fault.disk", mode=self.mode)
+            value = {"disk_fault": self.mode}
+        elif op.f == "stop":
+            injected = self.cluster.store.faults_injected
+            # Restart BEFORE disarming: restart_from_disk copies the
+            # armed fault_mode onto the fresh store, so a client write
+            # racing this :stop cannot slip a healthy full-dict persist
+            # in between and silently heal the lost/garbled state the
+            # restart exists to surface. _disarm then clears the fresh
+            # store's mode + the env gate.
+            self.cluster.restart_from_disk()
+            self._disarm()
+            obs.get_tracer().event("fault.disk_restart", mode=self.mode,
+                                   injected=injected)
+            value = {"restarted_after": self.mode, "injected": injected}
+        else:
+            value = f"unknown nemesis op {op.f}"
+        return Op(type="info", f=op.f, value=value, process=op.process)
+
+    async def teardown(self, test: dict) -> None:
+        self._disarm()
+
+
+class LeaseSkewNemesis(Nemesis):
+    """:start freezes a read lease on a random minority of members —
+    the clock-skewed leaseholders serve non-quorum reads from the
+    expired snapshot; :stop revokes every lease."""
+
+    def __init__(self, cluster, seed: int = 0):
+        self.cluster = cluster
+        self.rng = random.Random(seed)
+        self.leased: list[str] = []
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        if op.f == "start":
+            self.leased = random_minority(self.rng,
+                                          self.cluster.members())
+            for node in self.leased:
+                self.cluster.grant_lease(node)
+                obs.get_tracer().event("fault.lease_skew", node=node)
+            value = {"leased": self.leased}
+        elif op.f == "stop":
+            self.cluster.revoke_leases()
+            obs.get_tracer().event("fault.lease_revoke",
+                                   nodes=self.leased)
+            value = {"revoked": self.leased}
+            self.leased = []
+        else:
+            value = f"unknown nemesis op {op.f}"
+        return Op(type="info", f=op.f, value=value, process=op.process)
+
+    async def teardown(self, test: dict) -> None:
+        self.cluster.revoke_leases()
+        self.leased = []

@@ -174,6 +174,26 @@ HEALTH_GAUGE = "health.state"
 # absent.
 # jtflow: metrics preregistered
 SYNC_COUNTERS = ("sync.lock_acquisitions", "sync.order_edges")
+# Scenario factory (campaign/, ISSUE 15): executed specs, fail-fast
+# aborted live runs, per-key checks, falsifying runs, ddmin shrinker
+# candidate checks + batched launches, banked minimal witnesses, and
+# the regression-corpus replay accounting — pre-registered so every
+# capture's metrics.json carries them (zeros permitted, never absent;
+# campaign_stats() is the bench/web reader).
+# jtflow: metrics preregistered
+CAMPAIGN_COUNTERS = ("campaign.specs", "campaign.aborted_runs",
+                     "campaign.keys_checked",
+                     "campaign.keys_skipped_hard",
+                     "campaign.runs_falsified",
+                     "campaign.shrink_checks", "campaign.shrink_launches",
+                     "campaign.banked", "campaign.replayed",
+                     "campaign.replay_failures")
+# Occupancy/effectiveness gauges: distinct anomaly signatures the last
+# triage pass produced, the last shrink's minimal/original op ratio,
+# and end-to-end scenario throughput.
+# jtflow: metrics preregistered
+CAMPAIGN_GAUGES = ("campaign.unique_signatures", "campaign.shrink_ratio",
+                   "campaign.specs_per_sec")
 
 _NULL_TRACER = Tracer(enabled=False)
 _NULL_METRICS = MetricsRegistry(enabled=False)
@@ -192,9 +212,9 @@ class Capture:
         if enabled:
             for name in PHASE_COUNTERS + SCHED_COUNTERS + SWEEP_COUNTERS \
                     + COST_COUNTERS + ELLE_COUNTERS + SERVE_COUNTERS \
-                    + SYNC_COUNTERS:
+                    + SYNC_COUNTERS + CAMPAIGN_COUNTERS:
                 self.metrics.counter(name)
-            for name in ELLE_GAUGES + SERVE_GAUGES:
+            for name in ELLE_GAUGES + SERVE_GAUGES + CAMPAIGN_GAUGES:
                 self.metrics.gauge(name)
             self.metrics.histogram(SERVE_HISTOGRAM)
             self.metrics.gauge(PHASE_GAUGE)
@@ -638,6 +658,51 @@ def serve_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
     if h and h.get("p50") is not None:
         out["latency_p50_s"] = round(float(h["p50"]), 6)
         out["latency_p99_s"] = round(float(h.get("p99") or 0.0), 6)
+    return out
+
+
+def campaign_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
+    """The scenario factory's bench/web contract fields (campaign/,
+    ISSUE 15), from a registry snapshot: spec/abort/check/falsification
+    counters, shrinker accounting, bank and replay counters, and the
+    signature/ratio/throughput gauges. Zeros when no registry / no
+    campaign ran — like every reader here, the contract is "zeros
+    permitted, never absent"."""
+    out = {"specs": 0, "aborted_runs": 0, "keys_checked": 0,
+           "keys_skipped_hard": 0, "runs_falsified": 0,
+           "shrink_checks": 0, "shrink_launches": 0,
+           "banked": 0, "replayed": 0, "replay_failures": 0,
+           "unique_signatures": 0, "shrink_ratio": 0.0,
+           "specs_per_sec": 0.0}
+    if metrics is None or not metrics.enabled:
+        return out
+    snap = metrics.snapshot()
+
+    def counter_value(key: str) -> int:
+        rec = snap.get(key)
+        return int(rec["value"]) if rec \
+            and rec.get("type") == "counter" else 0
+
+    out["specs"] = counter_value("campaign.specs")
+    out["aborted_runs"] = counter_value("campaign.aborted_runs")
+    out["keys_checked"] = counter_value("campaign.keys_checked")
+    out["keys_skipped_hard"] = \
+        counter_value("campaign.keys_skipped_hard")
+    out["runs_falsified"] = counter_value("campaign.runs_falsified")
+    out["shrink_checks"] = counter_value("campaign.shrink_checks")
+    out["shrink_launches"] = counter_value("campaign.shrink_launches")
+    out["banked"] = counter_value("campaign.banked")
+    out["replayed"] = counter_value("campaign.replayed")
+    out["replay_failures"] = counter_value("campaign.replay_failures")
+    g = snap.get("campaign.unique_signatures")
+    if g and g.get("last") is not None:
+        out["unique_signatures"] = int(g["last"])
+    g = snap.get("campaign.shrink_ratio")
+    if g and g.get("last") is not None:
+        out["shrink_ratio"] = round(float(g["last"]), 4)
+    g = snap.get("campaign.specs_per_sec")
+    if g and g.get("last") is not None:
+        out["specs_per_sec"] = round(float(g["last"]), 2)
     return out
 
 

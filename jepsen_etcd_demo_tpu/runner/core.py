@@ -251,6 +251,59 @@ async def interpret_generators(test: dict, recorder: HistoryRecorder,
     return recorder.history
 
 
+async def _setup_run(test: dict
+                     ) -> tuple[Optional[Client], Optional[Nemesis]]:
+    """Node + client data-plane + nemesis setup (reference
+    Client.setup!, set.clj:15-16) — the run-lifecycle prologue shared
+    by run_test and run_workload."""
+    await _setup_nodes(test)
+    client_proto: Optional[Client] = test.get("client")
+    if client_proto is not None:
+        c = await client_proto.open(test, test["nodes"][0])
+        await c.setup(test)
+        await c.close(test)
+    nemesis: Optional[Nemesis] = test.get("nemesis")
+    if nemesis is not None:
+        await nemesis.setup(test)
+    return client_proto, nemesis
+
+
+async def _teardown_run(test: dict, client_proto: Optional[Client],
+                        nemesis: Optional[Nemesis], store_dir=None
+                        ) -> None:
+    """The matching epilogue: nemesis heal -> client data-plane
+    teardown -> node teardown (with log download when a store dir is
+    given). ONE copy of the ordering — a reorder here serves
+    `jepsen-tpu test` and the campaign alike."""
+    if nemesis is not None:
+        await nemesis.teardown(test)
+    if client_proto is not None:
+        c = await client_proto.open(test, test["nodes"][0])
+        await c.teardown(test)
+        await c.close(test)
+    await _teardown_nodes(test, store_dir)
+
+
+async def run_workload(test: dict, recorder: HistoryRecorder,
+                       stop_check=None) -> list[Op]:
+    """The slim embedding path (campaign/engine.py): client/nemesis
+    setup -> generator interpretation -> client/nemesis teardown,
+    WITHOUT the store, telemetry capture, or check phase `run_test`
+    wraps around it. Callers that run thousands of scenarios (the
+    scenario factory) own those concerns in batch: one obs capture per
+    campaign, one corpus-batched check per campaign — paying the
+    per-run versions thousands of times over is exactly the overhead
+    the campaign exists to amortize. The caller supplies the recorder
+    (a virtual-clock one for deterministic sim runs) and the optional
+    fail-fast `stop_check` (same contract as interpret_generators)."""
+    client_proto, nemesis = await _setup_run(test)
+    try:
+        return await interpret_generators(test, recorder,
+                                          stop_check=stop_check)
+    finally:
+        await _teardown_run(test, client_proto, nemesis)
+
+
 async def _setup_nodes(test: dict):
     db = test.get("db")
     os_setup = test.get("os_setup")
@@ -314,18 +367,7 @@ async def _run_test_inner(test: dict, store) -> dict:
     t0 = time.monotonic()
     with tracer.span("setup", nodes=len(test["nodes"]),
                      workload=str(test.get("workload", ""))):
-        await _setup_nodes(test)
-
-        # Client/nemesis data-plane setup (reference Client.setup!,
-        # set.clj:15-16)
-        client_proto: Optional[Client] = test.get("client")
-        if client_proto is not None:
-            c = await client_proto.open(test, test["nodes"][0])
-            await c.setup(test)
-            await c.close(test)
-        nemesis: Optional[Nemesis] = test.get("nemesis")
-        if nemesis is not None:
-            await nemesis.setup(test)
+        client_proto, nemesis = await _setup_run(test)
 
     log.info("=== running workload")
     # Streaming check mode (ISSUE 5): the recorder's listener feeds a
@@ -372,13 +414,8 @@ async def _run_test_inner(test: dict, store) -> dict:
             # its own thread underneath the teardown below.
             session.finish_input()
         with tracer.span("teardown"):
-            if nemesis is not None:
-                await nemesis.teardown(test)
-            if client_proto is not None:
-                c = await client_proto.open(test, test["nodes"][0])
-                await c.teardown(test)
-                await c.close(test)
-            await _teardown_nodes(test, store.path if store else None)
+            await _teardown_run(test, client_proto, nemesis,
+                                store.path if store else None)
 
     run_s = time.monotonic() - t0
     log.info("=== run complete: %d history entries in %.1fs; checking",

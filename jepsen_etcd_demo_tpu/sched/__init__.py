@@ -19,7 +19,7 @@ See doc/perf.md for the operator-facing story.
 from .compile_cache import (compile_cache_dir, enable_persistent_cache,
                             kernel_cache)
 from .engine import (assign_step_buckets, check_corpus, corpus_executor,
-                     submit_corpus)
+                     fold_stats, submit_corpus)
 from .pipeline import InflightWindow, double_buffer
 
 __all__ = [
@@ -29,6 +29,7 @@ __all__ = [
     "corpus_executor",
     "double_buffer",
     "enable_persistent_cache",
+    "fold_stats",
     "InflightWindow",
     "kernel_cache",
     "submit_corpus",

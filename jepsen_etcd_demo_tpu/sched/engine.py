@@ -170,6 +170,23 @@ class _Stats:
         return out
 
 
+#: The numeric stats-dict fields a multi-call consumer folds (the CLI
+#: corpus verb sums across models; the campaign engine sums across
+#: check waves). One list so the two cannot drift from to_dict().
+FOLDABLE_STATS = ("launches", "steps_real", "steps_padded",
+                  "sweep_steps_sparse", "sweep_steps_dense",
+                  "configs_pruned", "sparse_overflow_rounds")
+
+
+def fold_stats(total: dict, stats: dict) -> dict:
+    """Accumulate one check_corpus stats dict into a running total
+    (missing keys initialize to 0; padding_waste is derived by the
+    consumer from the folded step counters)."""
+    for f in FOLDABLE_STATS:
+        total[f] = total.get(f, 0) + int(stats.get(f, 0) or 0)
+    return total
+
+
 def check_corpus(encs: Sequence, model=None, f_cap: int = 256
                  ) -> tuple[list[dict], str, dict]:
     """Check a corpus of encoded histories through the bucketed scheduler;

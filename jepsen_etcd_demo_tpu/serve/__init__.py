@@ -19,11 +19,13 @@ process-wide warm-kernel pool.
 See doc/serve.md for the API schema and capacity-planning notes.
 """
 
-from .scheduler import CoalescingScheduler, Rejected, ServeRequest
+from .scheduler import (CAMPAIGN_TENANT, CoalescingScheduler, Rejected,
+                        ServeRequest)
 from .sessions import ServeSession, SessionManager, op_from_dict
 from .daemon import ServeDaemon, make_serve_handler, serve_check
 
 __all__ = [
+    "CAMPAIGN_TENANT",
     "CoalescingScheduler",
     "Rejected",
     "ServeDaemon",

@@ -73,6 +73,13 @@ ORACLE_KERNEL = "cpu-oracle-shed"
 # client-supplied tenant ids must not grow process state unboundedly.
 TENANT_LATENCY_TENANTS = 256
 
+# The scenario factory's tenant id (campaign/engine.py submits its
+# check waves here under route="serve"): campaign traffic rides the
+# same WFQ rotation as everyone else — one turn per rotation like any
+# tenant, so a million-scenario campaign cannot starve an interactive
+# tenant, which is the whole point of submitting it AS a tenant.
+CAMPAIGN_TENANT = "campaign"
+
 
 class Rejected(Exception):
     """A submission the scheduler refused to admit. ``status`` is the
@@ -212,6 +219,48 @@ class CoalescingScheduler:
         m.counter("serve.requests").add(1)
         m.gauge("serve.queue_depth").set(depth)
         return req
+
+    def submit_many(self, tenant: str, encs, model_name: str = "cas-register"
+                    ) -> list[ServeRequest]:
+        """Admit a WAVE of same-tenant requests under one lock
+        acquisition (the campaign's check batches: thousands of tiny
+        histories, where per-submit lock churn and wakeups would
+        dominate). All-or-nothing against the admission bound — a wave
+        that would overrun ``serve_max_inflight`` is Rejected whole, so
+        the caller chunks by ``max_inflight()`` and drains between
+        waves exactly like any well-behaved tenant."""
+        m = obs.get_metrics()
+        sup = health.get_supervisor()
+        if sup.snapshot()["state"] == health.WEDGED:
+            m.counter("serve.rejected_wedged").add(len(encs))
+            raise Rejected(
+                "backend wedged; shedding new work "
+                f"(retry after {RETRY_AFTER_S}s)", 503,
+                retry_after_s=RETRY_AFTER_S)
+        tenant = str(tenant)
+        reqs = [ServeRequest(tenant=tenant, model_name=model_name, enc=e)
+                for e in encs]
+        with self._lock:
+            if self._inflight.get(tenant, 0) + len(reqs) \
+                    > self.max_inflight():
+                m.counter("serve.rejected_inflight").add(len(reqs))
+                raise Rejected(
+                    f"tenant {tenant!r} wave of {len(reqs)} would "
+                    f"overrun the in-flight bound "
+                    f"({self.max_inflight()}); chunk and drain", 429)
+            q = self._queues.get(tenant)
+            if q is None:
+                q = self._queues[tenant] = deque()
+                self._rotation.append(tenant)
+            q.extend(reqs)
+            self._inflight[tenant] = \
+                self._inflight.get(tenant, 0) + len(reqs)
+            self._pending += len(reqs)
+            depth = self._pending
+            self._lock.notify_all()
+        m.counter("serve.requests").add(len(reqs))
+        m.gauge("serve.queue_depth").set(depth)
+        return reqs
 
     def model_for(self, name: str):
         """Resolved (and cached) Model instance per model name. The

@@ -16,6 +16,11 @@ TEST_FILE = "test.json"
 HISTORY_FILE = "history.jsonl"
 HISTORY_TENSOR_FILE = "history.npz"
 RESULTS_FILE = "results.json"
+# The campaign's regression-corpus bank (campaign/bank.py) lives under
+# <store>/corpus/<signature>/<hash>.json — NOT run dirs; runs() below
+# must skip it or the web index (and `jepsen-tpu corpus`) would try to
+# render every banked witness as a broken run.
+CORPUS_DIRNAME = "corpus"
 
 
 def _jsonable_test(test: dict) -> dict:
@@ -109,7 +114,8 @@ class Store:
         if not self.root.exists():
             return out
         for test_dir in sorted(self.root.iterdir()):
-            if not test_dir.is_dir() or test_dir.name in ("latest", "current"):
+            if not test_dir.is_dir() or test_dir.name in (
+                    "latest", "current", CORPUS_DIRNAME):
                 continue
             for run in sorted(test_dir.iterdir()):
                 if run.is_dir() and not run.is_symlink():

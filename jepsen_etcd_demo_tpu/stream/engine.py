@@ -368,7 +368,12 @@ class StreamSession:
         self.model = model
         self.keyed = keyed
         self.k0 = k_slots
-        self.aborted = False        # set by the runner's fail-fast watcher
+        # Fail-fast abort latch. An Event, not a bare bool: the runner's
+        # watcher (event-loop thread) sets it while the consumer thread
+        # is mid-dispatch — the consumer and the finalize path both key
+        # off it to STOP dispatching (see finalize: an aborted session
+        # must not launch its buffered tails).
+        self._abort = threading.Event()
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._streams: dict[Any, KeyStream] = {}
         self._key_of_process: dict[Any, Any] = {}
@@ -385,6 +390,17 @@ class StreamSession:
         self._thread = threading.Thread(target=self._consume,
                                         name="stream-check", daemon=True)
         self._thread.start()
+
+    @property
+    def aborted(self) -> bool:
+        return self._abort.is_set()
+
+    @aborted.setter
+    def aborted(self, value: bool) -> None:
+        if value:
+            self._abort.set()
+        else:
+            self._abort.clear()
 
     # -- event-loop side --------------------------------------------------
     def feed(self, op: Op) -> None:
@@ -439,6 +455,15 @@ class StreamSession:
                 return
             if self._broken is not None:
                 continue   # drain cheaply; post-hoc owns the check now
+            if self._abort.is_set():
+                # Fail-fast already fired: the verdict is decided and
+                # the runner is tearing the workers down. Dispatching
+                # the still-queued tail would launch more chunks whose
+                # spans land after the run span closed — and an abort
+                # landing mid-dispatch used to leave the final partial
+                # chunk's span in exactly that orphaned state. Drain
+                # cheaply instead; post-hoc owns every verdict now.
+                continue
             t0 = time.monotonic()
             try:
                 self._feed_one(op, live=self._run_live.is_set())
@@ -492,7 +517,7 @@ class StreamSession:
         """Eager-flush keys whose buffers sat idle past the interval
         (enable_eager_flush); O(keys) per sweep, each stale key costs at
         most one padded chunk launch per interval."""
-        if self._eager_flush_s is None:
+        if self._eager_flush_s is None or self._abort.is_set():
             return
         cutoff = time.monotonic() - self._eager_flush_s
         for key, ks in self._streams.items():
@@ -537,6 +562,25 @@ class StreamSession:
         self._thread.join()
         metrics = obs.get_metrics()
         results: dict[Any, dict] = {}
+        if self._abort.is_set():
+            # Fail-fast teardown (ISSUE 15 satellite): the run was
+            # aborted because some key's streamed frontier died — the
+            # post-hoc checker re-checks the recorded history whole, so
+            # per-key finalize work here is pure waste. Worse than
+            # waste: every key with a buffered tail would dispatch one
+            # more padded chunk, emitting a telemetry span AFTER the
+            # run span closed (the abort routinely lands mid-dispatch),
+            # and a campaign's thousands of aborted runs turned those
+            # orphan spans into tracer-cap truncation-footer noise.
+            # Abandon every tail instead: no further dispatches, no new
+            # spans — and a partial-prefix sweep must not settle a key
+            # as valid anyway (the prefix proves nothing about the
+            # whole history), so returning NO streamed results is the
+            # only sound choice. tests/test_campaign.py pins both the
+            # no-new-spans and the no-settle halves.
+            self._finalize_stats(metrics, abandoned=len(self._streams))
+            self._results = {}
+            return None
         if self._broken is None:
             for key, ks in self._streams.items():
                 t0 = time.monotonic()
@@ -560,9 +604,17 @@ class StreamSession:
                         metrics.counter("encode.event_bytes").add(
                             int(enc.events[: enc.n_events].nbytes))
                         metrics.counter("encode.histories").add(1)
-        # The consumer-thread wall minus the time spent inside chunk
-        # dispatches (those already land in wgl.compile_s/execute_s via
-        # instrument_kernel) — the honest host-encode share.
+        self._finalize_stats(metrics, streamed_keys=len(results))
+        self._results = results
+        return results or None
+
+    def _finalize_stats(self, metrics, streamed_keys: int = 0,
+                        abandoned: int = 0) -> None:
+        """Publish the session gauges + build the results.json stream
+        record — shared by the normal and the aborted finalize paths.
+        The consumer-thread wall minus the time spent inside chunk
+        dispatches (those already land in wgl.compile_s/execute_s via
+        instrument_kernel) is the honest host-encode share."""
         dispatch_s = sum(ks.dispatch_s for ks in self._streams.values())
         encode_s = max(0.0, self._encode_s - dispatch_s)
         metrics.counter("encode.encode_s").add(encode_s)
@@ -574,7 +626,7 @@ class StreamSession:
         self._stats = {
             "overlap_ratio": round(overlap, 4),
             "keys": len(self._streams),
-            "streamed_keys": len(results),
+            "streamed_keys": streamed_keys,
             "chunks": sum(ks.chunks for ks in self._streams.values()),
             "restarts": sum(ks.restarts for ks in self._streams.values()),
             "steps_total": int(total),
@@ -584,10 +636,12 @@ class StreamSession:
             "dispatch_s": round(dispatch_s, 4),
             "failfast_aborted": self.aborted,
         }
+        if abandoned:
+            # How many keys' buffered tails the abort abandoned — the
+            # fail-fast accounting the campaign report surfaces.
+            self._stats["abandoned_keys"] = abandoned
         if self._broken:
             self._stats["fallback"] = self._broken
-        self._results = results
-        return results or None
 
     def stats(self) -> dict:
         """The results.json ``stream`` record (finalize() must have run)."""

@@ -138,6 +138,26 @@ def _stream_columns(results: dict) -> tuple[str, str]:
     return mode, (f"{ov:.0%}" if isinstance(ov, (int, float)) else "")
 
 
+def _corpus_banner_html(store: Store) -> str:
+    """Regression-corpus summary strip for the index (campaign/bank.py,
+    ISSUE 15): banked minimal witnesses per anomaly signature. Empty
+    string when the store has no bank."""
+    try:
+        from ..campaign.bank import bank_summary
+
+        summary = bank_summary(store.root)
+    except Exception:
+        return ""
+    if not summary:
+        return ""
+    sigs = ", ".join(f"{html.escape(slug)} ({n})"
+                     for slug, n in sorted(summary["signatures"].items()))
+    return (f"<p style='background:#eef3fb;padding:8px'>regression "
+            f"corpus: <b>{summary['total']}</b> banked witness(es) — "
+            f"{sigs} — replay with <code>jepsen-tpu campaign "
+            f"--replay-corpus</code></p>")
+
+
 def _index_html(store: Store) -> str:
     rows = []
     for run in reversed(store.runs()):
@@ -179,6 +199,7 @@ def _index_html(store: Store) -> str:
         "</head><body><h2>test runs</h2>"
         "<p><a href='/live'>live</a> · <a href='/metrics'>metrics</a> · "
         "<a href='/healthz'>healthz</a></p>"
+        f"{_corpus_banner_html(store)}"
         f"<table><tr><th>run</th><th>valid</th><th>detail</th>"
         f"<th>check eps</th><th>pad waste</th>"
         f"<th>sweep</th><th>live tiles</th>"
@@ -546,11 +567,13 @@ start one with <code>jepsen-tpu test &hellip; --live-port</code></p>
 <table id='stats'><tr>
 <th>ops ok</th><th>ops/s</th><th>ops fail</th><th>stream overlap</th>
 <th>watermark lag</th><th>frontier peak</th><th>serve queue</th>
-<th>batch fill</th></tr><tr>
+<th>batch fill</th><th>campaign specs</th><th>falsified</th>
+<th>banked</th></tr><tr>
 <td id='ok'>0</td><td id='rate'>&ndash;</td><td id='fail'>0</td>
 <td id='overlap'>&ndash;</td><td id='lag'>&ndash;</td>
 <td id='frontier'>&ndash;</td><td id='squeue'>&ndash;</td>
-<td id='sfill'>&ndash;</td></tr></table>
+<td id='sfill'>&ndash;</td><td id='cspecs'>&ndash;</td>
+<td id='cfals'>&ndash;</td><td id='cbank'>&ndash;</td></tr></table>
 <h3>nemesis / events</h3><ul id='events'></ul>
 <h3>span tree</h3><ul class='tree' id='spans'></ul>
 <script>
@@ -576,6 +599,12 @@ function met(name, m){
     el('squeue').textContent = m.last;
   else if (name === 'serve.batch_fill' && m.last !== null)
     el('sfill').textContent = (100 * m.last).toFixed(0) + '%';
+  else if (name === 'campaign.specs')
+    el('cspecs').textContent = m.value;
+  else if (name === 'campaign.runs_falsified')
+    el('cfals').textContent = m.value;
+  else if (name === 'campaign.banked')
+    el('cbank').textContent = m.value;
   else if (name === 'health.state') setHealth(m.last);
 }
 function setHealth(v){

@@ -1,0 +1,459 @@
+"""Scenario factory (ISSUE 15): spec-sampler determinism, campaign
+end-to-end determinism, signature dedupe, the batched ddmin shrinker's
+1-minimality + dense/batched bit-identity, bank round-trip + replay,
+the stream fail-fast abort accounting (no post-abort chunk spans, no
+partial-prefix settling), the new cluster fault planes' golden
+falsifications, and a tiny end-to-end campaign on CPU."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import time
+from dataclasses import replace
+
+import pytest
+
+from jepsen_etcd_demo_tpu import obs, sched
+from jepsen_etcd_demo_tpu.campaign import (ScenarioSpec, bank_witness,
+                                           ddmin_shrink, load_corpus,
+                                           replay_corpus, run_campaign,
+                                           sample_specs, verify_routes)
+from jepsen_etcd_demo_tpu.campaign.bank import bank_summary
+from jepsen_etcd_demo_tpu.campaign.cluster import MiniCluster, _MemberStore
+from jepsen_etcd_demo_tpu.campaign.triage import (classify, logical_ops,
+                                                  make_check_batch,
+                                                  _rebuild)
+from jepsen_etcd_demo_tpu.checkers.linearizable import Linearizable
+from jepsen_etcd_demo_tpu.db.minietcd import FAULT_HOOK_ENV, KeyStore
+from jepsen_etcd_demo_tpu.models import CASRegister
+from jepsen_etcd_demo_tpu.nemesis.cluster_faults import (DiskFaultNemesis,
+                                                         LeaseSkewNemesis,
+                                                         MemberChurnNemesis)
+from jepsen_etcd_demo_tpu.ops.limits import limits, set_limits
+from jepsen_etcd_demo_tpu.ops.op import INVOKE, OK, Op
+from jepsen_etcd_demo_tpu.stream import StreamSession
+from jepsen_etcd_demo_tpu.utils.fuzz import (gen_register_history,
+                                             mutate_history)
+
+REGISTER = Linearizable(model="cas-register").model
+
+
+def _h(*rows):
+    return [Op(type=t, f=f, value=v, process=p, index=i)
+            for i, (t, f, v, p) in enumerate(rows)]
+
+
+def _direct(encs, model):
+    return sched.check_corpus(encs, model)[0]
+
+
+def _seeded_invalid(seed: int = 0xD0, n_ops: int = 60):
+    """A register history the checker falsifies, found by mutation."""
+    probe = make_check_batch(REGISTER, _direct)
+    rng = random.Random(seed)
+    for _ in range(32):
+        cand = mutate_history(
+            rng, gen_register_history(rng, n_ops=n_ops, n_procs=5,
+                                      p_info=0.01))
+        if probe([cand])[0]:
+            return cand
+    raise AssertionError("could not seed an invalid history")
+
+
+# -- spec sampler -----------------------------------------------------------
+
+class TestSpecs:
+    def test_sampler_deterministic(self):
+        a = sample_specs(64, seed=42, bug_rate=0.3, live=4)
+        b = sample_specs(64, seed=42, bug_rate=0.3, live=4)
+        assert a == b                      # frozen dataclasses, by value
+        assert a != sample_specs(64, seed=43, bug_rate=0.3, live=4)
+        # The live prefix draws the cluster backend, the rest sim.
+        assert [s.backend for s in a[:4]] == ["minietcd"] * 4
+        assert all(s.backend == "sim" for s in a[4:])
+
+    def test_live_member_churn_carries_seeded_fork(self):
+        """The live lane's member-churn bug must be reachable from the
+        sampler: seeded live churn specs arm the forked standby."""
+        specs = sample_specs(32, seed=2, bug_rate=1.0, live=32)
+        churn = [s for s in specs if s.nemesis == "member-churn"]
+        assert churn, "no member-churn specs sampled"
+        assert all(s.faults.get("churn_fork") == 1.0 for s in churn)
+        healthy = sample_specs(32, seed=2, bug_rate=0.0, live=32)
+        assert all("churn_fork" not in s.faults for s in healthy)
+
+    def test_spec_roundtrip_and_unknown_family(self):
+        spec = sample_specs(3, seed=9)[2]
+        assert ScenarioSpec.from_dict(spec.to_dict()) == spec
+        with pytest.raises(ValueError, match="unknown campaign famil"):
+            sample_specs(2, seed=0, families=["register", "mutex"])
+
+
+# -- triage: signatures -----------------------------------------------------
+
+class TestSignatures:
+    def _sig(self, h):
+        res = Linearizable(backend="jax").check({}, h)
+        assert res["valid"] is False
+        return classify("register", REGISTER, h, res)
+
+    def test_same_anomaly_dedupes_different_witnesses(self):
+        s1 = self._sig(_h((INVOKE, "read", None, 0), (OK, "read", 4, 0)))
+        s2 = self._sig(_h((INVOKE, "write", 1, 0), (OK, "write", 1, 0),
+                          (INVOKE, "write", 2, 0), (OK, "write", 2, 0),
+                          (INVOKE, "read", None, 1), (OK, "read", 1, 1)))
+        assert s1.slug == s2.slug
+        assert s1.anomaly == "stale-read" and s1.failing_f == "read"
+
+    def test_different_anomalies_split(self):
+        stale = self._sig(_h((INVOKE, "read", None, 0), (OK, "read", 4, 0)))
+        cas = self._sig(_h((INVOKE, "write", 3, 0), (OK, "write", 3, 0),
+                           (INVOKE, "cas", (1, 2), 0), (OK, "cas", (1, 2), 0)))
+        assert cas.anomaly == "cas-divergence"
+        assert cas.slug != stale.slug
+
+
+# -- triage: the batched ddmin shrinker -------------------------------------
+
+class TestShrinker:
+    def test_ddmin_one_minimal_and_route_identical(self):
+        bad = _seeded_invalid()
+        probe = make_check_batch(REGISTER, _direct)
+        res = ddmin_shrink(bad, probe, max_checks=4096)
+        assert res.one_minimal and not res.budget_exhausted
+        assert res.to_ops <= res.from_ops
+        assert res.launches <= res.rounds   # one batched launch per round
+        # Still a witness...
+        assert probe([res.minimal])[0]
+        # ...and 1-minimal for real: removing ANY single logical op
+        # makes the candidate pass (checked as one batched launch).
+        groups = logical_ops(res.minimal)
+        cands = [_rebuild(groups[:i] + groups[i + 1:])
+                 for i in range(len(groups))]
+        assert not any(probe(cands))
+        # The banking gate: dense / batched / oracle verdicts agree.
+        verify = verify_routes(res.minimal, REGISTER)
+        assert verify["identical"] is True
+        assert verify["batched"]["valid"] is False
+        assert verify["dense"]["dead_step"] == verify["batched"]["dead_step"]
+
+    def test_budget_exhaustion_still_returns_witness(self):
+        bad = _seeded_invalid()
+        probe = make_check_batch(REGISTER, _direct)
+        res = ddmin_shrink(bad, probe, max_checks=2)
+        assert res.budget_exhausted
+        assert probe([res.minimal])[0]
+
+
+# -- bank -------------------------------------------------------------------
+
+class TestBank:
+    def _bank_one(self, root, h, dead_step, slug_suffix=""):
+        res = Linearizable(backend="jax").check({}, h)
+        sig = classify("register", REGISTER, h, res)
+        return bank_witness(
+            root, sig, "cas-register", h,
+            expect={"valid": False, "dead_step": dead_step},
+            spec={"spec_id": 0}, campaign={"seed": 1}, shrink={})
+
+    def test_roundtrip_replay_and_idempotence(self, tmp_path):
+        h = _h((INVOKE, "read", None, 0), (OK, "read", 4, 0))
+        res = Linearizable(backend="jax").check({}, h)
+        p1 = self._bank_one(tmp_path, h, int(res["dead_step"]))
+        p2 = self._bank_one(tmp_path, h, int(res["dead_step"]))
+        assert p1 == p2                       # content-hash idempotent
+        entries = load_corpus(tmp_path)
+        assert len(entries) == 1
+        w = entries[0]
+        assert [o.to_json() for o in w.history] == [o.to_json() for o in h]
+        assert w.expect["valid"] is False
+        replay = replay_corpus(tmp_path)
+        assert replay["ok"] and replay["checked"] == 1
+        summary = bank_summary(tmp_path)
+        assert summary["total"] == 1
+
+    def test_replay_catches_drift(self, tmp_path):
+        h = _h((INVOKE, "read", None, 0), (OK, "read", 4, 0))
+        self._bank_one(tmp_path, h, dead_step=7)   # wrong on purpose
+        replay = replay_corpus(tmp_path)
+        assert replay["ok"] is False
+        assert "dead_step drifted" in replay["failures"][0]["error"]
+
+    def test_replay_catches_no_longer_falsifying(self, tmp_path):
+        valid = _h((INVOKE, "write", 1, 0), (OK, "write", 1, 0),
+                   (INVOKE, "read", None, 0), (OK, "read", 1, 0))
+        sig = classify("register", REGISTER, valid, {"dead_step": 0})
+        bank_witness(tmp_path, sig, "cas-register", valid,
+                     expect={"valid": False, "dead_step": 0},
+                     spec={}, campaign={}, shrink={})
+        replay = replay_corpus(tmp_path)
+        assert replay["ok"] is False
+        assert "no longer falsifies" in replay["failures"][0]["error"]
+
+
+# -- stream fail-fast abort (ISSUE 15 bugfix satellite) ---------------------
+
+class TestFailFastAbort:
+    def test_abort_dispatches_nothing_and_settles_nothing(self):
+        """An aborted session must not launch its buffered tails: no
+        stream.chunk span lands after the abort (the old mid-dispatch
+        orphan-span/truncation-footer noise), no key settles from a
+        partial prefix, and the abandonment is accounted."""
+        prev = set_limits(replace(limits(), stream_flush_ops=8,
+                                  stream_max_lag_chunks=1))
+        try:
+            with obs.capture() as cap:
+                h = gen_register_history(random.Random(5), n_ops=160,
+                                         n_procs=4)
+                sess = StreamSession(CASRegister(), keyed=False)
+                for op in h[:100]:
+                    sess.feed(op)
+                deadline = time.monotonic() + 60
+                while sess._fed < 100 and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                assert sess._fed == 100, "consumer never drained"
+                chunks_before = sess._streams[None].chunks
+                assert chunks_before >= 1    # some chunks really flew
+                sess.aborted = True
+                for op in h[100:]:           # post-abort tail: drain only
+                    sess.feed(op)
+                assert sess.finalize() is None
+                st = sess.stats()
+                assert st["failfast_aborted"] is True
+                assert st["streamed_keys"] == 0      # nothing settles
+                assert st["abandoned_keys"] == 1
+                assert st["chunks"] == chunks_before  # no new dispatches
+                spans = [r for r in cap.tracer.records()
+                         if r.get("name") == "stream.chunk"]
+                # Every dispatched chunk's span is present AND closed
+                # (spans append at close); none were born post-abort.
+                assert len(spans) == chunks_before
+                assert all("t1_ns" in r for r in spans)
+        finally:
+            set_limits(prev)
+
+
+# -- cluster fault planes (ISSUE 15 satellite) ------------------------------
+
+NEM_START = Op(type="info", f="start", value=None, process="nemesis")
+NEM_STOP = Op(type="info", f="stop", value=None, process="nemesis")
+
+
+def _read(cluster, node, quorum=False):
+    status, body = _MemberStore(cluster, node).get("k", quorum=quorum)
+    assert status == 200, body
+    return int(body["node"]["value"])
+
+
+def _check(h):
+    return Linearizable(backend="jax").check({}, h)
+
+
+def _stale_read_history(observed: int):
+    """w1 -> w2 -> read(observed), sequential: linearizable iff the
+    read saw 2."""
+    return _h((INVOKE, "write", 1, 0), (OK, "write", 1, 0),
+              (INVOKE, "write", 2, 0), (OK, "write", 2, 0),
+              (INVOKE, "read", None, 1), (OK, "read", observed, 1))
+
+
+class TestMemberChurn:
+    def test_forked_standby_falsifies_healthy_churn_passes(self):
+        cluster = MiniCluster(nodes=("n1", "n2", "n3"))
+        try:
+            nem = MemberChurnNemesis(cluster, seed=3, fork=True)
+            writer = _MemberStore(cluster, "n1")
+            writer.put("k", "1", None, None)
+            urls_before = {n: cluster.url(n) for n in cluster.members()}
+            asyncio.run(nem.invoke({}, NEM_START))
+            assert nem.churned                 # a minority churned
+            stale_node = nem.churned[0]
+            # Respawn reuses the node's port: clients pinned to the old
+            # URL reconnect (else churned workers :fail forever and the
+            # forked replica serves no reads).
+            assert cluster.url(stale_node) == urls_before[stale_node]
+            healthy = next(n for n in cluster.members()
+                           if n not in nem.churned)
+            _MemberStore(cluster, healthy).put("k", "2", None, None)
+            # The seeded bug: the forked standby never saw w2.
+            observed = _read(cluster, stale_node)
+            assert observed == 1
+            assert _check(_stale_read_history(observed))["valid"] is False
+            # :stop heals — the restored member serves the shared store.
+            asyncio.run(nem.invoke({}, NEM_STOP))
+            observed = _read(cluster, stale_node)
+            assert observed == 2
+            assert _check(_stale_read_history(observed))["valid"] is True
+        finally:
+            cluster.close()
+
+    def test_healthy_churn_keeps_shared_store(self):
+        cluster = MiniCluster(nodes=("n1", "n2", "n3"))
+        try:
+            nem = MemberChurnNemesis(cluster, seed=3, fork=False)
+            _MemberStore(cluster, "n1").put("k", "1", None, None)
+            asyncio.run(nem.invoke({}, NEM_START))
+            churned = nem.churned[0]
+            _MemberStore(cluster, "n2").put("k", "2", None, None)
+            assert _read(cluster, churned) == 2    # no fork, no bug
+            asyncio.run(nem.invoke({}, NEM_STOP))
+        finally:
+            cluster.close()
+
+
+class TestDiskFaults:
+    def test_disk_full_loses_acked_write_after_restart(self, tmp_path):
+        cluster = MiniCluster(nodes=("n1", "n2", "n3"),
+                              data_dir=str(tmp_path))
+        try:
+            nem = DiskFaultNemesis(cluster, mode="disk-full")
+            m = _MemberStore(cluster, "n1")
+            m.put("k", "1", None, None)          # persisted
+            asyncio.run(nem.invoke({}, NEM_START))
+            m.put("k", "2", None, None)          # acked, never on disk
+            assert _read(cluster, "n1") == 2     # served from memory
+            asyncio.run(nem.invoke({}, NEM_STOP))   # disarm + restart
+            observed = _read(cluster, "n1")
+            assert observed == 1                 # the lost acked write
+            assert _check(_stale_read_history(observed))["valid"] is False
+            # The env gate and fault mode are restored after the window.
+            assert FAULT_HOOK_ENV not in os.environ
+            assert cluster.store.fault_mode is None
+        finally:
+            cluster.close()
+
+    def test_corrupt_write_invents_value_after_restart(self, tmp_path):
+        cluster = MiniCluster(nodes=("n1", "n2", "n3"),
+                              data_dir=str(tmp_path))
+        try:
+            nem = DiskFaultNemesis(cluster, mode="corrupt-write")
+            m = _MemberStore(cluster, "n1")
+            m.put("k", "1", None, None)
+            asyncio.run(nem.invoke({}, NEM_START))
+            m.put("k", "2", None, None)          # garbled on its way down
+            asyncio.run(nem.invoke({}, NEM_STOP))
+            observed = _read(cluster, "n1")
+            assert observed == 3                 # _garble("2") — invented
+            assert _check(_stale_read_history(observed))["valid"] is False
+        finally:
+            cluster.close()
+
+    def test_fault_mode_inert_without_env_gate(self, tmp_path):
+        """A stray fault_mode write without the env gate must not bend
+        persistence — the production-safety half of the hook."""
+        st = KeyStore(str(tmp_path))
+        st.fault_mode = "disk-full"
+        st.put("k", "9", None, None)
+        assert KeyStore(str(tmp_path)).get("k")[1]["node"]["value"] == "9"
+        assert st.faults_injected == 0
+
+
+class TestLeaseSkew:
+    def test_leased_member_serves_stale_quorum_bypasses(self):
+        cluster = MiniCluster(nodes=("n1", "n2", "n3"))
+        try:
+            nem = LeaseSkewNemesis(cluster, seed=5)
+            _MemberStore(cluster, "n1").put("k", "1", None, None)
+            asyncio.run(nem.invoke({}, NEM_START))
+            assert nem.leased
+            leased = nem.leased[0]
+            _MemberStore(cluster, "n2").put("k", "2", None, None)
+            observed = _read(cluster, leased)           # expired lease
+            assert observed == 1
+            assert _check(_stale_read_history(observed))["valid"] is False
+            # etcd q=true semantics: quorum reads bypass the lease.
+            assert _read(cluster, leased, quorum=True) == 2
+            asyncio.run(nem.invoke({}, NEM_STOP))
+            assert _read(cluster, leased) == 2          # revoked
+        finally:
+            cluster.close()
+
+
+# -- engine plumbing --------------------------------------------------------
+
+class TestPlumbing:
+    def test_fold_stats_accumulates(self):
+        total: dict = {}
+        sched.fold_stats(total, {"launches": 2, "steps_real": 10})
+        sched.fold_stats(total, {"launches": 3, "steps_padded": 4,
+                                 "unrelated": 99})
+        assert total["launches"] == 5 and total["steps_real"] == 10
+        assert total["steps_padded"] == 4 and "unrelated" not in total
+
+
+# -- campaigns end to end ---------------------------------------------------
+
+def _verdict_view(report) -> dict:
+    """The deterministic face of a campaign report: everything except
+    wall-clock and store-root-dependent path prefixes."""
+    d = report.to_dict()
+    d.pop("wall_s"), d.pop("specs_per_sec")
+    d["banked"] = sorted(os.path.basename(p) for p in d["banked"])
+    return d
+
+
+class TestCampaign:
+    def test_campaign_deterministic_end_to_end(self, tmp_path):
+        kw = dict(n_specs=16, seed=5, families=["register", "queue"],
+                  bug_rate=0.5, scale=0.3, workers=2,
+                  max_shrink_checks=512)
+        r1 = run_campaign(store_root=str(tmp_path / "a"), **kw)
+        r2 = run_campaign(store_root=str(tmp_path / "b"), **kw)
+        assert _verdict_view(r1) == _verdict_view(r2)
+        assert r1.executed == 16 and r1.run_errors == 0
+
+    def test_serve_route_verdict_parity(self):
+        specs = sample_specs(10, seed=21, bug_rate=0.6, scale=0.3)
+        direct = run_campaign(specs=specs, seed=21, shrink=False,
+                              bank=False)
+        serve = run_campaign(specs=specs, seed=21, shrink=False,
+                             bank=False, route="serve")
+        assert serve.route == "serve"
+        assert direct.falsified_keys == serve.falsified_keys
+        assert set(direct.signatures) == set(serve.signatures)
+
+    def test_tiny_campaign_falsifies_shrinks_banks_replays(self, tmp_path):
+        """The acceptance shape: >= 64 specs with seeded stale-read
+        bugs falsify, triage to >= 1 signature, shrink to verified
+        1-minimal witnesses, bank, and re-falsify from the store."""
+        with obs.capture() as cap:
+            report = run_campaign(
+                n_specs=64, seed=0xE7CD, families=["register"],
+                bug_rate=0.5, scale=0.25, workers=4,
+                max_shrink_checks=1024, store_root=str(tmp_path))
+        assert report.executed == 64 and report.run_errors == 0
+        assert report.falsified_runs > 0
+        assert len(report.signatures) >= 1
+        assert "register-cas-register-stale-read" in report.signatures
+        assert report.shrinks, "nothing shrunk"
+        for rec in report.shrinks:
+            assert rec["verified_identical"] is True
+            assert rec["to_ops"] <= rec["from_ops"]
+        assert any(rec["one_minimal"] for rec in report.shrinks)
+        assert report.banked, "nothing banked"
+        # The campaign.* obs contract: counters visible in the capture.
+        stats = obs.campaign_stats(cap.metrics)
+        assert stats["specs"] == 64
+        assert stats["runs_falsified"] == report.falsified_runs
+        assert stats["banked"] == len(report.banked)
+        assert stats["unique_signatures"] == len(report.signatures)
+        # The regression lane: every banked witness still falsifies.
+        replay = replay_corpus(str(tmp_path))
+        assert replay["ok"] is True
+        assert replay["checked"] == len(load_corpus(str(tmp_path)))
+        assert replay["checked"] >= 1
+
+    def test_cli_campaign_smoke(self, tmp_path, capsys):
+        from jepsen_etcd_demo_tpu.cli.main import main
+        rc = main(["campaign", "--specs", "6", "--seed", "3",
+                   "--families", "register", "--scale", "0.3",
+                   "--no-shrink", "--store", str(tmp_path)])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["specs"] == 6 and out["executed"] == 6
+        rc = main(["campaign", "--replay-corpus",
+                   "--store", str(tmp_path)])
+        assert rc == 0

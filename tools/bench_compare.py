@@ -68,6 +68,13 @@ LANES: list[tuple[str, tuple]] = [
     # throughput is the gated headline; the latency quantiles and
     # batch-fill context ride the informational lanes below.
     ("serve_agg_eps", ("detail", "serve", "events_per_sec")),
+    # Campaign lane (ISSUE 15): end-to-end scenario throughput and the
+    # batched shrinker's candidate-recheck rate are the gated
+    # headlines; the sequential-baseline speedup and replay wall are
+    # ratios/lower-better context on the informational lanes below.
+    ("campaign_specs_eps", ("detail", "campaign", "specs_per_sec")),
+    ("campaign_shrink_cps",
+     ("detail", "campaign", "shrink_checks_per_sec")),
 ]
 # Scaling-efficiency lanes (ISSUE 12): events/s PER CHIP on the mesh
 # and the per-chip-vs-single-device efficiency ratio, recorded by
@@ -118,6 +125,15 @@ INFO_LANES: list[tuple[str, tuple]] = [
     ("serve_p99_ms", ("detail", "serve", "latency_p99_ms")),
     ("serve_batch_fill", ("detail", "serve", "batch_fill_avg")),
     ("serve_cache_hit_rate", ("detail", "serve", "cache_hit_rate")),
+    # Campaign lane context (ISSUE 15): the batched-vs-sequential
+    # shrink speedup is a ratio of two measurements, the replay wall is
+    # LOWER-better, and the banked count tracks what the fuzzer found
+    # (legitimately moves with the spec mix) — all informational; the
+    # gates stay on specs/s and shrink-checks/s above.
+    ("campaign_shrink_speedup",
+     ("detail", "campaign", "speedup_vs_sequential")),
+    ("campaign_replay_wall_s", ("detail", "campaign", "replay_wall_s")),
+    ("campaign_banked", ("detail", "campaign", "banked")),
 ]
 
 
