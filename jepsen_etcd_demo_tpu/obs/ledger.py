@@ -1,0 +1,782 @@
+"""obs/ledger.py — the scaling ledger: launch-level time attribution.
+
+ROADMAP item 1 names the problem this module answers: the 8-device
+dryrun measures ``efficiency_vs_single: 0.14`` and nothing in the
+system can say which of encode / H2D / compile / padding /
+straggler-wait / dispatch-gap eats the other 86%. The ledger is the
+instrument: every dispatch through the KernelPlan spine emits a
+:class:`LaunchRecord` (plan ``cache_key()``, bucket shape,
+real-vs-padded steps and batch fill, phase wall, and the host-side gap
+since the previous instrumented event), encode and H2D staging emit
+sibling event records, and :func:`attribute` decomposes a measured
+wall-clock window into named loss buckets that must account for >=95%
+of it.
+
+Layering: stdlib-only, imported BY ``obs/__init__`` (never the other
+way at module scope). Emission is two-layered so call sites stay
+decoupled from the spine:
+
+  * ``instrument_kernel`` (obs/__init__) emits the launch record — it
+    already wraps every compiled kernel, so every dispatch is covered.
+  * callers that KNOW the launch economics (sched/engine.py bucket
+    launches, parallel/dense.py sharded launches, plan/dispatch.py's
+    choke point) open a :func:`launch_context` around the call; the
+    emission layer folds the context's plan identity / padding / shard
+    fields into the record without any plumbing through the kernel
+    caches.
+
+Per-process artifacts: a file-backed ledger streams records to
+``ledger-<proc>.jsonl`` next to the store artifacts via a writer
+thread (joined on close). The first line is a clock handshake —
+``time.monotonic_ns()`` and ``time.time()`` sampled back to back — so
+:func:`merge_ledgers` can fold a pod's per-process files into one
+wall-clock timeline without trusting any cross-host monotonic
+relationship (skew between processes shifts that process's records
+coherently; ordering within a process is always exact).
+
+Loss-bucket decomposition (doc/telemetry.md "Scaling ledger" chapter):
+per execute/fetch record with padding context, ``fill = steps_real /
+steps_padded`` splits the span into useful and waste; the waste splits
+into straggler wait (the mesh idling behind its slowest shard:
+``D * max(shard_real) - sum(shard_real)`` of the padded-step excess)
+and pure bucket padding. Host time not covered by any instrumented
+span inside the window is the dispatch gap; wall outside the
+instrumented window is ``other_s``.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import queue
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Iterator, Optional
+
+from .sync import maybe_wrap
+
+LEDGER_FILE_PREFIX = "ledger-"
+LEDGER_SCHEMA = "ledger/1"
+PROC_ENV = "JEPSEN_TPU_PROC"
+LEDGER_ENV = "JEPSEN_TPU_LEDGER"
+
+# The closed loss-bucket set every attribution reports (zeros
+# permitted, never absent — the bench/report contract). execute_s is
+# the USEFUL share of device-facing spans; padding_s / straggler_s are
+# the waste carved out of them; dispatch_gap_s is host time inside the
+# window no instrumented span covers; other_s is wall outside the
+# instrumented window.
+BUCKETS = ("encode_s", "h2d_s", "compile_s", "execute_s",
+           "padding_s", "straggler_s", "dispatch_gap_s", "other_s")
+
+# Record kinds whose spans carry padding context and decompose into
+# useful/padding/straggler (dispatch wall + the blocking result fetch).
+_DEVICE_KINDS = ("execute", "fetch")
+
+
+def ledger_enabled() -> bool:
+    return os.environ.get(LEDGER_ENV, "1").lower() \
+        not in ("0", "false", "no", "off")
+
+
+def process_index() -> int:
+    """This process's ledger index (the <proc> in ledger-<proc>.jsonl).
+    Multi-process launchers export JEPSEN_TPU_PROC; single-process runs
+    are proc 0."""
+    try:
+        return int(os.environ.get(PROC_ENV, "0"))
+    except ValueError:
+        return 0
+
+
+# -- launch context ---------------------------------------------------------
+# Call sites that know the launch economics (bucket shape, padding,
+# shard layout, plan identity) publish them here; the emission layer
+# (instrument_kernel / record_fetch) folds them into the record. A
+# contextvar so nested captures, threads and the serve daemon's
+# dispatch thread each see their own context.
+
+_CTX: contextvars.ContextVar[Optional[dict]] = \
+    contextvars.ContextVar("jepsen_tpu_ledger_ctx", default=None)
+
+
+@contextmanager
+def launch_context(**fields: Any) -> Iterator[None]:
+    """Annotate every ledger record emitted inside the block with these
+    launch fields (plan cache_key/family/label, steps_real/padded,
+    batch_real/padded, shard_real, n_shards). Nesting merges — inner
+    fields win."""
+    cur = _CTX.get()
+    tok = _CTX.set({**cur, **fields} if cur else dict(fields))
+    try:
+        yield
+    finally:
+        _CTX.reset(tok)
+
+
+def current_context() -> Optional[dict]:
+    return _CTX.get()
+
+
+def plan_context(plan: Any) -> dict:
+    """The launch-context fields a KernelPlan contributes to its
+    records: cache identity, family/label, and the mesh's shard
+    count/shape."""
+    fields: dict[str, Any] = {
+        "cache_key": str(plan.cache_key()),
+        "plan_family": plan.family,
+        "label": plan.label,
+    }
+    mesh = getattr(plan, "mesh", None)
+    if mesh is not None:
+        fields["n_shards"] = int(mesh.total)
+        fields["mesh_shape"] = list(mesh.shape)
+    else:
+        fields["n_shards"] = 1
+    return fields
+
+
+def shard_real_steps(step_counts: list[int], n_shards: int) -> list[int]:
+    """Per-shard real step totals for a contiguous [B]-axis partition
+    of a padded batch (the sharded routes split the batch into
+    n_shards equal contiguous blocks)."""
+    b = len(step_counts)
+    if n_shards <= 1 or b % n_shards:
+        return [int(sum(step_counts))]
+    per = b // n_shards
+    return [int(sum(step_counts[i * per:(i + 1) * per]))
+            for i in range(n_shards)]
+
+
+# -- records ----------------------------------------------------------------
+
+_CTX_FIELDS = ("cache_key", "plan_family", "label", "mesh_shape",
+               "n_shards", "batch_real", "batch_padded",
+               "steps_real", "steps_padded", "shard_real")
+
+
+@dataclass
+class LaunchRecord:
+    """One ledger line: an instrumented span plus its launch context.
+    kind is the phase — "compile" / "execute" (instrument_kernel),
+    "fetch" (the blocking device->host result wait), "encode" (host
+    history->tensor encoding) or "h2d" (host->device staging, with
+    bytes)."""
+
+    kind: str
+    kernel: str = ""
+    t0_ns: int = 0
+    t1_ns: int = 0
+    gap_s: float = 0.0
+    bytes: int = 0
+    ctx: dict = field(default_factory=dict)
+
+    @property
+    def dur_s(self) -> float:
+        return max(0, self.t1_ns - self.t0_ns) / 1e9
+
+    def as_line(self) -> dict:
+        out = {"kind": self.kind, "t0_ns": self.t0_ns,
+               "t1_ns": self.t1_ns, "dur_s": round(self.dur_s, 6)}
+        if self.kernel:
+            out["kernel"] = self.kernel
+        if self.gap_s > 0:
+            out["gap_s"] = round(self.gap_s, 6)
+        if self.bytes:
+            out["bytes"] = int(self.bytes)
+        for k in _CTX_FIELDS:
+            v = self.ctx.get(k)
+            if v is not None:
+                out[k] = v
+        return out
+
+
+def _decompose(rec: dict) -> tuple[float, float, float]:
+    """Split one device-facing span into (useful_s, padding_s,
+    straggler_s). fill = steps_real/steps_padded is the useful share;
+    of the waste, the straggler share is the padded-step excess the
+    mesh paid waiting for its slowest shard: D*max(shard_real) -
+    sum(shard_real) over (steps_padded - steps_real) — provably <= 1
+    since D*max(shard_real) <= steps_padded."""
+    dur = float(rec.get("dur_s", 0.0) or 0.0)
+    sp = int(rec.get("steps_padded") or 0)
+    sr = int(rec.get("steps_real") or 0)
+    if sp <= 0 or sr <= 0 or sr >= sp:
+        return dur, 0.0, 0.0
+    waste = dur * (1.0 - sr / sp)
+    strag = 0.0
+    shards = rec.get("shard_real")
+    if isinstance(shards, list) and len(shards) > 1:
+        mx = max(shards)
+        lag = len(shards) * mx - sum(shards)
+        if lag > 0:
+            strag = waste * min(1.0, lag / (sp - sr))
+    return dur - waste, waste - strag, strag
+
+
+# -- the ledger -------------------------------------------------------------
+
+class Ledger:
+    """One capture's launch ledger: an in-memory record list, the
+    running metric fold (ledger.* counters on the capture's registry),
+    and — when bound to an output directory — a writer thread
+    streaming ``ledger-<proc>.jsonl`` (joined on close; a dead store
+    dir degrades to dropped lines, never a failed run)."""
+
+    MAX_RECORDS = 100_000
+
+    def __init__(self, out_dir: Optional[str | Path] = None,
+                 metrics: Any = None, enabled: bool = True,
+                 proc: Optional[int] = None):
+        self.enabled = enabled and ledger_enabled()
+        self.proc = process_index() if proc is None else proc
+        self._metrics = metrics
+        # The clock handshake: monotonic origin + wall clock sampled
+        # back to back. Merge maps t_ns -> wall via this pair.
+        self.mono_ns = time.monotonic_ns()
+        self.wall_s = time.time()
+        self._records: list[dict] = []
+        self._bucket_totals: dict[str, float] = {}
+        self.dropped = 0
+        self._last_end_ns = 0
+        self._lock = maybe_wrap(threading.Lock(),
+                                "obs.ledger.Ledger._lock")
+        self._queue: Optional[queue.SimpleQueue] = None
+        self._thread: Optional[threading.Thread] = None
+        self.path: Optional[Path] = None
+        if self.enabled and out_dir is not None:
+            self.path = Path(out_dir) / \
+                f"{LEDGER_FILE_PREFIX}{self.proc}.jsonl"
+            self._queue = queue.SimpleQueue()
+            self._thread = threading.Thread(
+                target=self._drain, name="ledger-writer", daemon=True)
+            self._thread.start()
+
+    # -- writer thread ------------------------------------------------------
+
+    def _drain(self) -> None:
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            fh = open(self.path, "w", encoding="utf-8")
+        except OSError:
+            # Observability is never a failure mode: drain the queue to
+            # nowhere so record() keeps not blocking.
+            fh = None
+        try:
+            if fh is not None:
+                meta = {"kind": "meta", "schema": LEDGER_SCHEMA,
+                        "proc": self.proc, "pid": os.getpid(),
+                        "mono_ns": self.mono_ns, "wall_s": self.wall_s}
+                fh.write(json.dumps(meta) + "\n")
+            while True:
+                line = self._queue.get()
+                if line is None:
+                    break
+                if fh is not None:
+                    try:
+                        fh.write(line + "\n")
+                    except OSError:
+                        fh = None
+        finally:
+            if fh is not None:
+                try:
+                    fh.close()
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        """Flush and join the writer thread (idempotent). File-backed
+        ledgers MUST be closed before the file is read or merged."""
+        if self._thread is None:
+            return
+        self._queue.put(None)
+        self._thread.join(timeout=10.0)
+        self._thread = None
+
+    # -- emission -----------------------------------------------------------
+
+    def _emit(self, rec: LaunchRecord) -> None:
+        line = rec.as_line()
+        with self._lock:
+            if len(self._records) >= self.MAX_RECORDS:
+                self.dropped += 1
+                return
+            self._records.append(line)
+            gap_ns = rec.t0_ns - self._last_end_ns \
+                if self._last_end_ns else 0
+            self._last_end_ns = max(self._last_end_ns, rec.t1_ns)
+        if gap_ns > 0:
+            rec.gap_s = line["gap_s"] = round(gap_ns / 1e9, 6)
+        self._fold(rec, line)
+        if self._queue is not None:
+            self._queue.put(json.dumps(line))
+
+    def _fold(self, rec: LaunchRecord, line: dict) -> None:
+        """Running ledger.* metric totals on the capture's registry —
+        the zeros-never-absent bench surface (obs.ledger_stats) and the
+        /metrics ledger families."""
+        m = self._metrics
+        if m is None or not getattr(m, "enabled", False):
+            return
+        if rec.kind == "encode":
+            m.counter("ledger.encode_s").add(rec.dur_s)
+            self._bucket(m, "encode_s", rec.dur_s)
+        elif rec.kind == "h2d":
+            m.counter("ledger.h2d_s").add(rec.dur_s)
+            m.counter("ledger.h2d_bytes").add(rec.bytes)
+            self._bucket(m, "h2d_s", rec.dur_s)
+        elif rec.kind == "compile":
+            m.counter("ledger.launches").add(1)
+            m.counter("ledger.compile_s").add(rec.dur_s)
+            self._bucket(m, "compile_s", rec.dur_s)
+        else:
+            if rec.kind == "execute":
+                m.counter("ledger.launches").add(1)
+            useful, pad, strag = _decompose(line)
+            m.counter("ledger.execute_s").add(useful)
+            self._bucket(m, "execute_s", useful)
+            if pad > 0:
+                m.counter("ledger.padding_s").add(pad)
+                self._bucket(m, "padding_s", pad)
+            if strag > 0:
+                m.counter("ledger.straggler_s").add(strag)
+                self._bucket(m, "straggler_s", strag)
+            sp = int(line.get("steps_padded") or 0)
+            if sp > 0:
+                m.gauge("ledger.step_fill").set(
+                    round(int(line.get("steps_real") or 0) / sp, 4))
+            bp = int(line.get("batch_padded") or 0)
+            if bp > 0:
+                m.gauge("ledger.batch_fill").set(
+                    round(int(line.get("batch_real") or 0) / bp, 4))
+        if rec.gap_s > 0:
+            m.counter("ledger.dispatch_gap_s").add(rec.gap_s)
+            self._bucket(m, "dispatch_gap_s", rec.gap_s)
+
+    def _bucket(self, m: Any, name: str, dt: float) -> None:
+        """Cumulative per-bucket seconds as a labeled gauge family
+        (/metrics renders jepsen_tpu_ledger_bucket_s{bucket=...})."""
+        with self._lock:
+            total = self._bucket_totals.get(name, 0.0) + dt
+            self._bucket_totals[name] = total
+        # jtlint: disable=JTL107 -- bounded family: name comes from the
+        # closed BUCKETS tuple above; the exporter folds the members
+        # into one labeled Prometheus family (ledger.bucket_s).
+        m.gauge(f"ledger.bucket_s.{name}").set(round(total, 6))
+
+    def record_launch(self, kernel: str, phase: str, t0_ns: int,
+                      t1_ns: int) -> None:
+        """One instrumented kernel call (instrument_kernel's hook).
+        phase is "compile" (first call of a geometry) or "execute"."""
+        if not self.enabled:
+            return
+        self._emit(LaunchRecord(kind=phase, kernel=kernel, t0_ns=t0_ns,
+                                t1_ns=t1_ns,
+                                ctx=current_context() or {}))
+
+    def record_fetch(self, t0_ns: int, t1_ns: int,
+                     ctx: Optional[dict] = None) -> None:
+        """The blocking device->host result wait of one launch — on
+        async backends this is where device time actually surfaces, so
+        it decomposes under the same padding context as its launch."""
+        if not self.enabled:
+            return
+        self._emit(LaunchRecord(kind="fetch", t0_ns=t0_ns, t1_ns=t1_ns,
+                                ctx=ctx if ctx is not None
+                                else (current_context() or {})))
+
+    def record_encode(self, dur_s: float,
+                      t1_ns: Optional[int] = None) -> None:
+        """Host-side history->tensor encoding seconds (the existing
+        encode.encode_s sites feed this with their measured interval)."""
+        if not self.enabled or dur_s <= 0:
+            return
+        t1 = time.monotonic_ns() if t1_ns is None else t1_ns
+        self._emit(LaunchRecord(kind="encode", t0_ns=t1 - int(dur_s * 1e9),
+                                t1_ns=t1))
+
+    def record_h2d(self, nbytes: int, t0_ns: int, t1_ns: int) -> None:
+        """Host->device staging: bytes moved + the enqueue wall (a
+        lower bound — async backends overlap the copy with dispatch)."""
+        if not self.enabled:
+            return
+        self._emit(LaunchRecord(kind="h2d", bytes=int(nbytes),
+                                t0_ns=t0_ns, t1_ns=t1_ns,
+                                ctx=current_context() or {}))
+
+    # -- reading ------------------------------------------------------------
+
+    def records(self) -> list[dict]:
+        with self._lock:
+            return list(self._records)
+
+    def wall_records(self) -> list[dict]:
+        """Records with absolute wall-clock spans (t0_s/t1_s), mapped
+        through this ledger's clock handshake."""
+        return [_to_wall(r, self.mono_ns, self.wall_s, self.proc)
+                for r in self.records()]
+
+    def attribution(self, t0_ns: Optional[int] = None,
+                    t1_ns: Optional[int] = None,
+                    wall_s: Optional[float] = None) -> dict:
+        """Decompose this ledger's records over a measured window (ns
+        anchors from the caller's own monotonic_ns samples, or a plain
+        wall_s length). See :func:`attribute`."""
+        if t0_ns is not None and t1_ns is not None and wall_s is None:
+            wall_s = max(0, t1_ns - t0_ns) / 1e9
+        recs = self.wall_records()
+        w0 = None
+        if t0_ns is not None:
+            w0 = self.wall_s + (t0_ns - self.mono_ns) / 1e9
+        return attribute(recs, wall_s=wall_s, window_start_s=w0)
+
+
+# -- attribution ------------------------------------------------------------
+
+def empty_attribution() -> dict:
+    """The zeros-never-absent ledger attribution shape (degraded bench
+    paths, runs that never launched)."""
+    return {"wall_s": 0.0, "window_s": 0.0, "coverage": 0.0,
+            "launches": 0, "h2d_bytes": 0, "overlap_s": 0.0,
+            "buckets": {k: 0.0 for k in BUCKETS}, "top_losses": []}
+
+
+def _union_len(spans: list[tuple[float, float]]) -> float:
+    total = 0.0
+    end = None
+    for a, b in sorted(spans):
+        if end is None or a > end:
+            total += b - a
+            end = b
+        elif b > end:
+            total += b - end
+            end = b
+    return total
+
+
+def attribute(records: list[dict], wall_s: Optional[float] = None,
+              window_start_s: Optional[float] = None) -> dict:
+    """Decompose a record timeline into the named loss buckets.
+
+    The instrumented window is [first span start, last span end]; the
+    in-window time no span covers is ``dispatch_gap_s`` (host-side
+    scheduling/partitioning/drain logic); wall outside the window is
+    ``other_s``. Concurrent spans overlap — ``overlap_s`` reports the
+    double-booked seconds so buckets-minus-overlap ties back to the
+    window exactly. ``coverage`` is the explained share of wall: every
+    bucket except other_s, capped at 1.0 (overlap can push the raw sum
+    past the wall)."""
+    out = empty_attribution()
+    spans = [(float(r["t0_s"]), float(r["t1_s"])) for r in records
+             if r.get("t1_s", 0) > r.get("t0_s", 0)]
+    if not spans:
+        if wall_s:
+            out["wall_s"] = round(wall_s, 6)
+            out["buckets"]["other_s"] = round(wall_s, 6)
+        return out
+    b = out["buckets"]
+    for r in records:
+        kind = r.get("kind")
+        dur = float(r.get("dur_s", 0.0) or 0.0)
+        if kind == "encode":
+            b["encode_s"] += dur
+        elif kind == "h2d":
+            b["h2d_s"] += dur
+            out["h2d_bytes"] += int(r.get("bytes") or 0)
+        elif kind == "compile":
+            out["launches"] += 1
+            b["compile_s"] += dur
+        elif kind in _DEVICE_KINDS:
+            if kind == "execute":
+                out["launches"] += 1
+            useful, pad, strag = _decompose(r)
+            b["execute_s"] += useful
+            b["padding_s"] += pad
+            b["straggler_s"] += strag
+    lo = min(a for a, _ in spans)
+    hi = max(bb for _, bb in spans)
+    if window_start_s is not None:
+        lo = min(lo, window_start_s)
+    union = _union_len(spans)
+    window = hi - lo
+    b["dispatch_gap_s"] = max(0.0, window - union)
+    if wall_s is None:
+        wall_s = window
+    b["other_s"] = max(0.0, wall_s - window)
+    out["wall_s"] = wall_s
+    out["window_s"] = window
+    out["overlap_s"] = max(0.0, sum(bb - a for a, bb in spans) - union)
+    explained = sum(v for k, v in b.items() if k != "other_s")
+    out["coverage"] = min(1.0, explained / wall_s) if wall_s > 0 else 0.0
+    for k in b:
+        b[k] = round(b[k], 6)
+    for k in ("wall_s", "window_s", "overlap_s", "coverage"):
+        out[k] = round(out[k], 6)
+    out["top_losses"] = sorted(
+        ([k, v] for k, v in b.items() if k != "execute_s" and v > 0),
+        key=lambda kv: -kv[1])
+    return out
+
+
+def by_plan(records: list[dict]) -> list[dict]:
+    """Per-plan roll-up of device-facing spans: launches, seconds,
+    useful/waste split — the report's "where the chip-seconds went by
+    kernel" table."""
+    agg: dict[str, dict] = {}
+    for r in records:
+        if r.get("kind") not in ("compile",) + _DEVICE_KINDS:
+            continue
+        key = r.get("label") or r.get("kernel") or "?"
+        a = agg.setdefault(key, {"label": key, "launches": 0,
+                                 "seconds": 0.0, "useful_s": 0.0,
+                                 "waste_s": 0.0})
+        dur = float(r.get("dur_s", 0.0) or 0.0)
+        a["seconds"] += dur
+        if r.get("kind") == "compile":
+            a["launches"] += 1
+            a["useful_s"] += dur
+            continue
+        if r.get("kind") == "execute":
+            a["launches"] += 1
+        useful, pad, strag = _decompose(r)
+        a["useful_s"] += useful
+        a["waste_s"] += pad + strag
+    out = sorted(agg.values(), key=lambda a: -a["seconds"])
+    for a in out:
+        for k in ("seconds", "useful_s", "waste_s"):
+            a[k] = round(a[k], 6)
+    return out
+
+
+def straggler_table(records: list[dict]) -> list[dict]:
+    """Per-launch shard imbalance rows for ragged corpora: the bucket
+    the whole mesh paid vs each shard's real steps — the "corpus
+    ragged 17" smoking gun, quantified."""
+    rows = []
+    for r in records:
+        shards = r.get("shard_real")
+        if r.get("kind") not in _DEVICE_KINDS \
+                or not isinstance(shards, list) or len(shards) < 2:
+            continue
+        _, _, strag = _decompose(r)
+        if strag <= 0:
+            continue
+        rows.append({"label": r.get("label") or r.get("kernel") or "?",
+                     "steps_padded": int(r.get("steps_padded") or 0),
+                     "shard_real": [int(s) for s in shards],
+                     "straggler_s": round(strag, 6)})
+    return sorted(rows, key=lambda x: -x["straggler_s"])
+
+
+# -- per-process files and the pod merge ------------------------------------
+
+def read_ledger(path: str | Path) -> tuple[Optional[dict], list[dict],
+                                           list[str]]:
+    """One ledger-<proc>.jsonl -> (meta, records, warnings). Truncated
+    or partially-written files (a killed process) degrade to the parsed
+    prefix plus a counted warning — never an exception."""
+    path = Path(path)
+    warnings: list[str] = []
+    meta: Optional[dict] = None
+    records: list[dict] = []
+    try:
+        text = path.read_text(encoding="utf-8")
+    except OSError as e:
+        return None, [], [f"{path.name}: unreadable ({e})"]
+    lines = text.splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            # A killed writer leaves a partial trailing line; anything
+            # after it is untrustworthy. Keep the parsed prefix.
+            warnings.append(
+                f"{path.name}: truncated at line {i + 1} "
+                f"({len(lines) - i} line(s) dropped)")
+            break
+        if rec.get("kind") == "meta":
+            meta = rec
+        else:
+            records.append(rec)
+    if meta is None:
+        warnings.append(f"{path.name}: missing clock handshake meta "
+                        f"line; records skipped")
+        return None, [], warnings
+    return meta, records, warnings
+
+
+def _to_wall(rec: dict, mono_ns: int, wall_s: float, proc: int) -> dict:
+    out = dict(rec)
+    out["proc"] = proc
+    out["t0_s"] = wall_s + (rec.get("t0_ns", 0) - mono_ns) / 1e9
+    out["t1_s"] = wall_s + (rec.get("t1_ns", 0) - mono_ns) / 1e9
+    return out
+
+
+def ledger_paths(run_dir: str | Path) -> list[Path]:
+    return sorted(Path(run_dir).glob(f"{LEDGER_FILE_PREFIX}*.jsonl"))
+
+
+def merge_ledgers(paths: list[str | Path]) -> dict:
+    """Fold per-process ledger files into one wall-ordered pod
+    timeline. Each file's clock handshake maps its monotonic spans to
+    wall clock independently — cross-process wall skew shifts one
+    process's records coherently but can never reorder records WITHIN
+    a process (skew-tolerant by construction). Returns {"records",
+    "procs", "warnings"}."""
+    merged: list[dict] = []
+    procs: list[int] = []
+    warnings: list[str] = []
+    for p in paths:
+        meta, records, warns = read_ledger(p)
+        warnings.extend(warns)
+        if meta is None:
+            continue
+        proc = int(meta.get("proc", 0))
+        procs.append(proc)
+        mono = int(meta.get("mono_ns", 0))
+        wall = float(meta.get("wall_s", 0.0))
+        merged.extend(_to_wall(r, mono, wall, proc) for r in records)
+    merged.sort(key=lambda r: (r["t0_s"], r["proc"]))
+    return {"records": merged, "procs": sorted(procs),
+            "warnings": warnings}
+
+
+# -- span-tree critical path ------------------------------------------------
+
+def critical_path(trace_records: list[dict]) -> list[dict]:
+    """The longest root-to-leaf chain through a telemetry.jsonl span
+    tree (runner/serve paths), with per-span self time (duration minus
+    the union of its children) — the "what would speeding X up actually
+    buy" view."""
+    spans = [r for r in trace_records
+             if r.get("kind") == "span" and r.get("t1_ns") is not None]
+    if not spans:
+        return []
+    children: dict[Any, list[dict]] = {}
+    by_id = {}
+    for s in spans:
+        by_id[s.get("id")] = s
+        children.setdefault(s.get("parent"), []).append(s)
+    roots = [s for s in spans
+             if s.get("parent") not in by_id or s.get("parent") is None]
+    if not roots:
+        return []
+
+    def dur(s: dict) -> int:
+        return max(0, int(s["t1_ns"]) - int(s["t0_ns"]))
+
+    path = []
+    cur = max(roots, key=dur)
+    while cur is not None:
+        kids = children.get(cur.get("id"), [])
+        child_union = _union_len(
+            [(int(k["t0_ns"]) / 1e9, int(k["t1_ns"]) / 1e9)
+             for k in kids])
+        path.append({"name": cur.get("name", "?"),
+                     "dur_s": round(dur(cur) / 1e9, 6),
+                     "self_s": round(max(0.0, dur(cur) / 1e9
+                                         - child_union), 6)})
+        cur = max(kids, key=dur) if kids else None
+    return path
+
+
+# -- rolling-window SLO gauges ----------------------------------------------
+
+def slo_target_s() -> float:
+    """The serve SLO latency target (p99 threshold) in seconds."""
+    try:
+        return float(os.environ.get("JEPSEN_TPU_SERVE_SLO_P99_S", "1.0"))
+    except ValueError:
+        return 1.0
+
+
+def slo_budget() -> float:
+    """The SLO error budget: the tolerated breach fraction (burn rate
+    1.0 means breaches exactly consume the budget)."""
+    try:
+        return float(os.environ.get("JEPSEN_TPU_SERVE_SLO_BUDGET",
+                                    "0.01"))
+    except ValueError:
+        return 0.01
+
+
+class RollingWindow:
+    """A time-bounded latency window for the serve daemon's live SLO
+    gauges: p50/p99 over the last window_s seconds (the cumulative
+    request histogram can't forget, so a recovered daemon would wear
+    its worst minute forever) plus the burn rate — the breach fraction
+    over the error budget."""
+
+    def __init__(self, window_s: float = 60.0, maxlen: int = 4096):
+        self.window_s = window_s
+        self.maxlen = maxlen
+        self._items: list[tuple[float, float]] = []
+
+    def observe(self, value: float, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        self._items.append((now, float(value)))
+        self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        cut = now - self.window_s
+        i = 0
+        n = len(self._items)
+        while i < n and self._items[i][0] < cut:
+            i += 1
+        if i or n > self.maxlen:
+            self._items = self._items[max(i, n - self.maxlen):]
+
+    def values(self, now: Optional[float] = None) -> list[float]:
+        self._prune(time.monotonic() if now is None else now)
+        return [v for _, v in self._items]
+
+    def quantiles(self, now: Optional[float] = None) \
+            -> tuple[float, float]:
+        vals = sorted(self.values(now))
+        if not vals:
+            return 0.0, 0.0
+
+        def q(p: float) -> float:
+            return vals[min(len(vals) - 1, int(p * len(vals)))]
+
+        return q(0.50), q(0.99)
+
+    def burn_rate(self, slo_s: Optional[float] = None,
+                  budget: Optional[float] = None,
+                  now: Optional[float] = None) -> float:
+        vals = self.values(now)
+        if not vals:
+            return 0.0
+        slo = slo_target_s() if slo_s is None else slo_s
+        bud = slo_budget() if budget is None else budget
+        breach = sum(1 for v in vals if v > slo) / len(vals)
+        return round(breach / bud, 4) if bud > 0 else 0.0
+
+
+# -- report rendering -------------------------------------------------------
+
+def render_waterfall(att: dict, width: int = 40) -> list[str]:
+    """The where-did-the-chip-seconds-go waterfall as text lines:
+    every bucket, ranked, with its share bar of the measured wall."""
+    wall = att.get("wall_s") or 0.0
+    lines = [f"wall {wall:.3f}s  coverage "
+             f"{100.0 * att.get('coverage', 0.0):.1f}%  "
+             f"launches {att.get('launches', 0)}"]
+    buckets = att.get("buckets") or {}
+    ranked = sorted(buckets.items(), key=lambda kv: -kv[1])
+    for name, sec in ranked:
+        frac = sec / wall if wall > 0 else 0.0
+        bar = "#" * max(0, min(width, int(round(frac * width))))
+        lines.append(f"  {name:<15} {sec:>9.3f}s {100 * frac:>5.1f}% "
+                     f"|{bar}")
+    if att.get("overlap_s"):
+        lines.append(f"  (overlap {att['overlap_s']:.3f}s of concurrent "
+                     f"spans double-booked above)")
+    return lines

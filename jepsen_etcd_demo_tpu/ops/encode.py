@@ -42,7 +42,7 @@ from typing import Any, Optional, Sequence
 
 import numpy as np
 
-from ..obs import get_metrics
+from ..obs import get_ledger, get_metrics
 from .op import Op, INVOKE, OK, FAIL, INFO
 
 # Value encoding. The reference register draws values from (rand-int 5), i.e.
@@ -299,7 +299,9 @@ def encode_events(invocations: Sequence[Invocation], k_slots: int = 32
     # that will cross the host->device boundary (SURVEY §5.1 — the
     # harness's own hot loop needs a breakdown, not just the op history).
     m = get_metrics()
-    m.counter("encode.encode_s").add(time.monotonic() - t_enc)
+    dt_enc = time.monotonic() - t_enc
+    m.counter("encode.encode_s").add(dt_enc)
+    get_ledger().record_encode(dt_enc)
     m.counter("encode.histories").add(1)
     m.counter("encode.event_bytes").add(int(events.nbytes))
     return EncodedHistory(events=events, n_events=len(rows), n_ops=n_ops,
@@ -609,7 +611,9 @@ def encode_return_steps(enc: EncodedHistory) -> ReturnSteps:
     last = last_inv[ret_pos]                   # [R, K]
     tabs = np.where(last[:, :, None] >= 0,
                     ev[np.maximum(last, 0)][:, :, 2:6], 0).astype(np.int32)
-    get_metrics().counter("encode.encode_s").add(time.monotonic() - t_enc)
+    dt_enc = time.monotonic() - t_enc
+    get_metrics().counter("encode.encode_s").add(dt_enc)
+    get_ledger().record_encode(dt_enc)
     return ReturnSteps(
         slot_tabs=tabs,
         slot_active=active,

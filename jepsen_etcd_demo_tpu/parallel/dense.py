@@ -23,6 +23,7 @@ are stripped back.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Sequence
 
 import jax
@@ -36,6 +37,7 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 from ..models.base import Model
+from .. import obs
 from ..obs import instrument_kernel
 from ..ops import wgl3
 from ..ops.limits import limits
@@ -272,6 +274,9 @@ def check_steps_sharded(model: Model, cfg: DenseConfig, steps,
     the batch-axis twin of the scheduler's step-length buckets
     (sched/engine.py). Pad histories are all-pad scans (targets=-1,
     zero work) and are stripped before assembly."""
+    from ..obs import ledger as obs_ledger
+    from ..plan import plan_dense_batch, resolve
+
     if mesh is None:
         mesh = batch_mesh()
     mult = batch_multiple(model, cfg, mesh, n_steps=r_cap,
@@ -280,11 +285,30 @@ def check_steps_sharded(model: Model, cfg: DenseConfig, steps,
                                 floor=limits().batch_bucket_floor)
     target = (b_bucket + mult - 1) // mult * mult
     arrays, b = pad_batch_arrays(wgl3.stack_steps3(steps, r_cap), target)
-    check, name = sharded_packed_batch_checker(
-        model, cfg, mesh, n_steps=r_cap, batch=arrays[2].shape[0])
-    out = wgl3.unpack_np(np.asarray(check(*(jnp.asarray(a)
-                                            for a in arrays)))[:b])
-    return wgl3.assemble_batch_results(out, steps, cfg), name
+    b_pad = arrays[2].shape[0]
+    p = plan_dense_batch(model, cfg, n_steps=r_cap, batch=b_pad,
+                         mesh=mesh)
+    check = resolve(p)
+    # Scaling ledger launch context: the bucket economics of this one
+    # sharded launch — per-shard real steps make straggler wait (the
+    # mesh idling behind its slowest shard on a ragged corpus)
+    # attributable, not folklore.
+    step_counts = [s.n_steps for s in steps] + [0] * (b_pad - b)
+    lctx = obs_ledger.plan_context(p)
+    lctx.update(batch_real=b, batch_padded=b_pad,
+                steps_real=sum(step_counts),
+                steps_padded=b_pad * r_cap)
+    if lctx.get("n_shards", 1) > 1:
+        lctx["shard_real"] = obs_ledger.shard_real_steps(
+            step_counts, lctx["n_shards"])
+    with obs_ledger.launch_context(**lctx):
+        dev = check(*(jnp.asarray(a) for a in arrays))
+        t0f = time.monotonic_ns()
+        fetched = np.asarray(dev)
+        obs.get_ledger().record_fetch(t0f, time.monotonic_ns(),
+                                      ctx=lctx)
+    out = wgl3.unpack_np(fetched[:b])
+    return wgl3.assemble_batch_results(out, steps, cfg), p.label
 
 
 def check_batch_sharded(encs: Sequence, model: Model,

@@ -29,6 +29,7 @@ and batch pads are all-pad histories stripped before assembly
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Sequence
 
@@ -86,11 +87,12 @@ def _dense_bucket_launcher(model, cfg, b: int, r: int):
     (doc/analysis.md "Contracts"). The plan's cache key carries the
     mesh identity, so an elastic re-shard between runs can only MISS
     the kernel LRU, never serve a stale compiled launch.
-    Returns (run, kernel_name)."""
+    Returns (run, plan) — the plan's label is the kernel name and its
+    identity feeds the scaling ledger's launch context."""
     from .. import plan as kplan
 
     p = kplan.plan_dense_batch(model, cfg, n_steps=r, batch=b)
-    return kplan.resolve(p), p.label
+    return kplan.resolve(p), p
 
 
 def _launch_multiple(model, cfg, b: int, r: int) -> int:
@@ -286,14 +288,30 @@ def check_corpus(encs: Sequence, model=None, f_cap: int = 256
                     b0 = _batch_bucket(len(part), chunk)
                     mult = _launch_multiple(model, cfg, b0, r)
                     b = (b0 + mult - 1) // mult * mult
-                    run, name = _dense_bucket_launcher(model, cfg, b, r)
+                    run, plan_obj = _dense_bucket_launcher(model, cfg,
+                                                           b, r)
                     padded = part_steps + [_pad_rs(k)] * (b - len(part))
-                    arrays = wgl3.stack_steps3(padded, r)
-                    pending.append((part, part_steps, run(*arrays)))
-                    stats.record_launch(
-                        sum(s.n_steps for s in part_steps), b, r)
-                    kernels.add(name)
-            for part, part_steps, dev in pending:
+                    # Scaling ledger launch context: plan identity +
+                    # the bucket economics (real vs padded steps/batch,
+                    # per-shard real steps for straggler attribution) —
+                    # the instrumented kernel call and the H2D staging
+                    # inside the block inherit it.
+                    real = sum(s.n_steps for s in part_steps)
+                    lctx = obs.ledger.plan_context(plan_obj)
+                    lctx.update(batch_real=len(part), batch_padded=b,
+                                steps_real=real, steps_padded=b * r)
+                    if lctx.get("n_shards", 1) > 1:
+                        lctx["shard_real"] = obs.ledger.shard_real_steps(
+                            [s.n_steps for s in padded],
+                            lctx["n_shards"])
+                    with obs.ledger.launch_context(**lctx):
+                        arrays = wgl3.stack_steps3(padded, r)
+                        dev = run(*arrays)
+                    pending.append((part, part_steps, dev, lctx))
+                    stats.record_launch(real, b, r)
+                    kernels.add(plan_obj.label)
+            for part, part_steps, dev, lctx in pending:
+                t0f = time.monotonic_ns()
                 try:
                     fetched = np.asarray(dev)
                 except Exception as e:
@@ -303,6 +321,11 @@ def check_corpus(encs: Sequence, model=None, f_cap: int = 256
                     supervisor.note_failure(f"{type(e).__name__}: {e}",
                                             source="sched.dispatch")
                     raise
+                # The drain fetch is where async device time surfaces
+                # on the host — ledger it under the launch's context so
+                # padding/straggler decomposition sees the real wait.
+                obs.get_ledger().record_fetch(t0f, time.monotonic_ns(),
+                                              ctx=lctx)
                 out = wgl3.unpack_np(fetched[:len(part)])
                 for i, one in zip(part, wgl3.assemble_batch_results(
                         out, part_steps, cfg)):

@@ -165,6 +165,13 @@ class CoalescingScheduler:
         self._shed_cpu = 0
         self._fill_sum = 0.0
         self._tenant_latency: dict[str, deque] = {}
+        # Rolling-window SLO gauges (obs/ledger.py RollingWindow,
+        # ISSUE 16): p50/p99 over the last minute + burn rate, updated
+        # by the dispatch thread only; stats() reads the copied dict.
+        self._slo_window = obs.ledger.RollingWindow()
+        self._slo = {"slo_p50_s": 0.0, "slo_p99_s": 0.0,
+                     "slo_burn_rate": 0.0,
+                     "slo_target_s": obs.ledger.slo_target_s()}
         self._thread = threading.Thread(target=self._run,
                                         name="serve-dispatch", daemon=True)
         self._thread.start()
@@ -429,6 +436,7 @@ class CoalescingScheduler:
                 "latency_s": round(latency, 4),
             }
             m.histogram("serve.request_latency_s").observe(latency)
+            self._slo_window.observe(latency, now=now)
             # Under the lock: tenant_latencies()/stats() iterate this
             # dict from handler threads — an unlocked setdefault here
             # could resize it mid-iteration (jtsan JTL501 finding).
@@ -442,8 +450,19 @@ class CoalescingScheduler:
         if shed:
             m.counter("serve.shed_cpu").add(len(batch))
         m.gauge("serve.batch_fill").set(fill)
+        # The live SLO cells (/live, ledger_stats): rolling-window
+        # quantiles, not the cumulative histogram — a recovered daemon
+        # must not wear its worst minute forever.
+        p50, p99 = self._slo_window.quantiles(now=now)
+        burn = self._slo_window.burn_rate(now=now)
+        m.gauge("serve.slo_p50_s").set(round(p50, 6))
+        m.gauge("serve.slo_p99_s").set(round(p99, 6))
+        m.gauge("serve.slo_burn_rate").set(burn)
         with self._lock:
             self._batches += 1
+            self._slo.update(slo_p50_s=round(p50, 6),
+                             slo_p99_s=round(p99, 6),
+                             slo_burn_rate=burn)
             self._requests_done += len(batch)
             self._fill_sum += fill
             if len(batch) > 1:
@@ -577,6 +596,7 @@ class CoalescingScheduler:
                 "coalesce_ms": int(self.coalesce_s() * 1000),
                 "max_batch": self.max_batch(),
                 "max_inflight": self.max_inflight(),
+                "slo": dict(self._slo),
                 "tenants": per_tenant,
             }
         out["kernel_cache"] = sched.kernel_cache().stats()

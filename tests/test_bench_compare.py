@@ -313,3 +313,66 @@ def test_multichip_r06_record_loads_and_self_compares():
     assert 0 < scal["efficiency_vs_single"] <= 8
     res = bench_compare.compare(rec, rec)
     assert res["comparable"] is True and res["regressions"] == []
+
+
+# -- scaling-ledger schema gate (ISSUE 16) ----------------------------------
+
+def _ledger_stats(**over) -> dict:
+    base = {k: 0.0 for k in bench_compare.LEDGER_STATS_KEYS}
+    base.update(over)
+    return base
+
+
+def _att(coverage: float, wall_s: float = 1.0) -> dict:
+    return {"wall_s": wall_s, "coverage": coverage,
+            "buckets": {"execute_s": wall_s * coverage}}
+
+
+def test_ledger_lanes_are_informational_never_gated():
+    """Loss-bucket seconds are load-dependent diagnostics: a 10x
+    padding_s jump annotates the comparison but never fails it."""
+    old, new = _record(1000.0), _record(1000.0)
+    old["ledger"] = _ledger_stats(execute_s=5.0, padding_s=0.1)
+    new["ledger"] = _ledger_stats(execute_s=5.0, padding_s=3.0)
+    res = bench_compare.compare(old, new, threshold_pct=10.0)
+    assert res["regressions"] == []
+    by_lane = {r["lane"]: r for r in res["lanes"]}
+    assert by_lane["ledger_padding_s"]["informational"] is True
+
+
+def test_check_ledger_record_requires_object_on_every_record():
+    rec = _record(1000.0)
+    assert bench_compare.check_ledger_record(rec) == \
+        ["record omits the `ledger` object entirely"]
+    rec["ledger"] = _ledger_stats()
+    del rec["ledger"]["padding_s"]
+    assert any("padding_s" in p
+               for p in bench_compare.check_ledger_record(rec))
+
+
+def test_check_ledger_record_degraded_needs_only_zeros():
+    """The degraded paths owe the zeros object, nothing more — no
+    windowed attribution exists when no lane ran."""
+    rec = {"value": 0, "degraded": True, "backend": "none",
+           "ledger": _ledger_stats()}
+    assert bench_compare.check_ledger_record(rec) == []
+
+
+def test_check_ledger_record_gates_low_coverage_and_omission():
+    """A non-degraded record whose corpus_sched lane omits the windowed
+    attribution, or whose buckets explain < 95% of the measured wall,
+    fails the schema gate by name."""
+    rec = _record(1000.0)
+    rec["ledger"] = _ledger_stats()
+    probs = bench_compare.check_ledger_record(rec)
+    assert any("omits its windowed ledger attribution" in p
+               for p in probs)
+    rec["detail"]["corpus_sched"]["ledger"] = _att(coverage=0.80)
+    probs = bench_compare.check_ledger_record(rec)
+    assert any("explain only 80.0%" in p for p in probs)
+    rec["detail"]["corpus_sched"]["ledger"] = _att(coverage=0.97)
+    assert bench_compare.check_ledger_record(rec) == []
+    # The MULTICHIP surface is held to the same bar.
+    rec["scaling"] = {"ledger": _att(coverage=0.5)}
+    assert any("scaling.ledger" in p
+               for p in bench_compare.check_ledger_record(rec))

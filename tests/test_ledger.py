@@ -1,0 +1,414 @@
+"""The scaling ledger (ISSUE 16): launch-level time attribution,
+per-process jsonl files, the skew-tolerant pod merge, loss-bucket
+decomposition, straggler accounting, the SLO rolling window, and the
+report surfaces (tools/scaling_report.py CLI, web waterfall panel)."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from jepsen_etcd_demo_tpu import obs
+from jepsen_etcd_demo_tpu.obs import ledger
+
+import scaling_report  # noqa: E402  (tools/ on path above)
+
+
+def _exec(t0_s: float, t1_s: float, **kw) -> dict:
+    rec = {"kind": "execute", "t0_s": t0_s, "t1_s": t1_s,
+           "dur_s": t1_s - t0_s}
+    rec.update(kw)
+    return rec
+
+
+class TestAttribution:
+    def test_padding_vs_straggler_split(self):
+        """A half-full bucket where ONE shard did all the real work is
+        pure straggler wait; evenly spread real work is pure padding."""
+        lopsided = _exec(0.0, 1.0, steps_real=50, steps_padded=100,
+                         shard_real=[50, 0])
+        att = ledger.attribute([lopsided], wall_s=1.0)
+        # fill = 0.5 -> 0.5s waste; D*max-sum = 2*50-50 = 50 = the
+        # whole padding budget -> all waste is straggler wait.
+        assert att["buckets"]["execute_s"] == pytest.approx(0.5)
+        assert att["buckets"]["straggler_s"] == pytest.approx(0.5)
+        assert att["buckets"]["padding_s"] == pytest.approx(0.0)
+
+        even = _exec(0.0, 1.0, steps_real=50, steps_padded=100,
+                     shard_real=[25, 25])
+        att = ledger.attribute([even], wall_s=1.0)
+        assert att["buckets"]["padding_s"] == pytest.approx(0.5)
+        assert att["buckets"]["straggler_s"] == pytest.approx(0.0)
+
+    def test_dispatch_gap_is_window_minus_span_union(self):
+        recs = [_exec(0.0, 1.0, steps_real=1, steps_padded=1),
+                _exec(2.0, 3.0, steps_real=1, steps_padded=1)]
+        att = ledger.attribute(recs, wall_s=4.0)
+        assert att["window_s"] == pytest.approx(3.0)
+        assert att["buckets"]["dispatch_gap_s"] == pytest.approx(1.0)
+        assert att["buckets"]["other_s"] == pytest.approx(1.0)
+        assert att["buckets"]["execute_s"] == pytest.approx(2.0)
+        # Everything but other_s explains 3 of 4 wall seconds.
+        assert att["coverage"] == pytest.approx(0.75)
+
+    def test_overlap_reported_not_double_counted_in_gap(self):
+        recs = [_exec(0.0, 2.0, steps_real=1, steps_padded=1),
+                _exec(1.0, 3.0, steps_real=1, steps_padded=1)]
+        att = ledger.attribute(recs, wall_s=3.0)
+        assert att["overlap_s"] == pytest.approx(1.0)
+        assert att["buckets"]["dispatch_gap_s"] == pytest.approx(0.0)
+
+    def test_top_losses_exclude_execute_and_rank(self):
+        recs = [_exec(0.0, 1.0, steps_real=25, steps_padded=100,
+                      shard_real=[13, 12])]
+        att = ledger.attribute(recs, wall_s=1.0)
+        names = [k for k, _ in att["top_losses"]]
+        assert "execute_s" not in names
+        assert names[0] == "padding_s"
+
+    def test_empty_attribution_shape_is_zeros_never_absent(self):
+        att = ledger.empty_attribution()
+        assert set(att["buckets"]) == set(ledger.BUCKETS)
+        assert att["wall_s"] == 0.0 and att["coverage"] == 0.0
+        assert att["top_losses"] == []
+        # No records but a known wall: everything is other_s.
+        att = ledger.attribute([], wall_s=2.0)
+        assert att["buckets"]["other_s"] == pytest.approx(2.0)
+
+    def test_encode_h2d_compile_fold_into_their_buckets(self):
+        recs = [
+            {"kind": "encode", "t0_s": 0.0, "t1_s": 0.1, "dur_s": 0.1},
+            {"kind": "h2d", "t0_s": 0.1, "t1_s": 0.2, "dur_s": 0.1,
+             "bytes": 1024},
+            {"kind": "compile", "t0_s": 0.2, "t1_s": 0.7, "dur_s": 0.5},
+            _exec(0.7, 1.0, steps_real=10, steps_padded=10),
+        ]
+        att = ledger.attribute(recs, wall_s=1.0)
+        b = att["buckets"]
+        assert b["encode_s"] == pytest.approx(0.1)
+        assert b["h2d_s"] == pytest.approx(0.1)
+        assert b["compile_s"] == pytest.approx(0.5)
+        assert b["execute_s"] == pytest.approx(0.3)
+        assert att["h2d_bytes"] == 1024
+        assert att["launches"] == 2       # compile + execute
+        assert att["coverage"] == pytest.approx(1.0)
+
+    def test_shard_real_steps_contiguous_split(self):
+        assert ledger.shard_real_steps([3, 2, 1, 0], 2) == [5, 1]
+        # Not divisible -> single-shard fallback, never a crash.
+        assert ledger.shard_real_steps([3, 2, 1], 2) == [6]
+
+
+class TestStragglerTable:
+    def test_rows_require_shards_and_positive_wait(self):
+        recs = [_exec(0.0, 1.0, steps_real=50, steps_padded=100,
+                      shard_real=[50, 0], label="k"),
+                _exec(1.0, 2.0, steps_real=100, steps_padded=100,
+                      shard_real=[50, 50], label="k"),
+                _exec(2.0, 3.0, steps_real=1, steps_padded=2)]
+        rows = ledger.straggler_table(recs)
+        assert len(rows) == 1
+        assert rows[0]["label"] == "k"
+        assert rows[0]["shard_real"] == [50, 0]
+        assert rows[0]["straggler_s"] == pytest.approx(0.5)
+
+
+class TestLedgerObject:
+    def test_records_fold_into_metrics_and_file(self, tmp_path):
+        with obs.capture(str(tmp_path)) as cap:
+            led = cap.ledger
+            t0 = time.monotonic_ns()
+            led.record_launch("k", "compile", t0, t0 + 10_000_000)
+            with ledger.launch_context(steps_real=5, steps_padded=10,
+                                       batch_real=1, batch_padded=2):
+                led.record_launch("k", "execute", t0 + 10_000_000,
+                                  t0 + 30_000_000)
+            led.record_encode(0.005)
+            led.record_h2d(4096, t0, t0 + 1_000_000)
+        stats = obs.ledger_stats(cap.metrics)
+        assert stats["launches"] == 2
+        assert stats["compile_s"] == pytest.approx(0.01, rel=0.01)
+        assert stats["execute_s"] == pytest.approx(0.01, rel=0.01)
+        assert stats["padding_s"] == pytest.approx(0.01, rel=0.01)
+        assert stats["h2d_bytes"] == 4096
+        assert stats["encode_s"] == pytest.approx(0.005, rel=0.01)
+        assert stats["step_fill"] == pytest.approx(0.5)
+        assert stats["batch_fill"] == pytest.approx(0.5)
+        # The file landed next to the artifacts: meta first, then
+        # records, writer joined by capture exit.
+        path = tmp_path / "ledger-0.jsonl"
+        assert path.exists()
+        lines = [json.loads(x) for x in
+                 path.read_text().splitlines()]
+        assert lines[0]["kind"] == "meta"
+        assert lines[0]["schema"] == ledger.LEDGER_SCHEMA
+        assert {x["kind"] for x in lines[1:]} == \
+            {"compile", "execute", "encode", "h2d"}
+
+    def test_close_joins_writer_thread_and_is_idempotent(self, tmp_path):
+        led = ledger.Ledger(out_dir=str(tmp_path), metrics=None)
+        writer = led._thread
+        assert writer is not None and writer.is_alive()
+        led.close()
+        assert not writer.is_alive()
+        led.close()                      # second close is a no-op
+        assert [t.name for t in threading.enumerate()
+                if t.name == "ledger-writer"] == []
+
+    def test_disabled_ledger_records_nothing(self, tmp_path):
+        led = ledger.Ledger(out_dir=str(tmp_path), enabled=False)
+        led.record_launch("k", "execute", 0, 1_000_000)
+        led.close()
+        assert led.records() == []
+        assert list(tmp_path.glob("ledger-*.jsonl")) == []
+
+    def test_env_gate_disables(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(ledger.LEDGER_ENV, "0")
+        led = ledger.Ledger(out_dir=str(tmp_path))
+        led.record_launch("k", "execute", 0, 1_000_000)
+        led.close()
+        assert not led.enabled and led.records() == []
+
+    def test_ledger_stats_zeros_never_absent(self):
+        stats = obs.ledger_stats(None)
+        for k in ("launches", "encode_s", "h2d_s", "h2d_bytes",
+                  "compile_s", "execute_s", "padding_s", "straggler_s",
+                  "dispatch_gap_s", "step_fill", "batch_fill",
+                  "slo_p50_s", "slo_p99_s", "slo_burn_rate"):
+            assert stats[k] == 0
+
+    def test_instrument_kernel_emits_compile_then_execute(self):
+        fn = obs.instrument_kernel("ledger_k", lambda: None)
+        with obs.capture() as cap:
+            fn()
+            fn()
+            kinds = [r["kind"] for r in cap.ledger.records()]
+        assert kinds == ["compile", "execute"]
+        assert obs.ledger_stats(cap.metrics)["launches"] == 2
+
+    def test_attribution_over_monotonic_anchors(self):
+        with obs.capture() as cap:
+            t0 = time.monotonic_ns()
+            cap.ledger.record_launch("k", "execute", t0 + 1_000_000,
+                                     t0 + 11_000_000)
+            t1 = t0 + 20_000_000
+        att = cap.ledger.attribution(t0_ns=t0, t1_ns=t1)
+        assert att["wall_s"] == pytest.approx(0.02)
+        assert att["buckets"]["execute_s"] == pytest.approx(0.01)
+        # 1ms lead-in before the span start is dispatch gap (the
+        # window is anchored at t0, not at the first span).
+        assert att["buckets"]["dispatch_gap_s"] == pytest.approx(
+            0.001, abs=1e-6)
+
+
+class TestLaunchContext:
+    def test_nested_contexts_merge_inner_wins(self):
+        with ledger.launch_context(a=1, b=2):
+            with ledger.launch_context(b=3, c=4):
+                assert ledger.current_context() == {"a": 1, "b": 3,
+                                                    "c": 4}
+            assert ledger.current_context() == {"a": 1, "b": 2}
+        assert ledger.current_context() is None
+
+    def test_plan_context_carries_identity_and_mesh(self):
+        from jepsen_etcd_demo_tpu.plan.core import KernelPlan
+
+        p = KernelPlan(family="wgl3", label="wgl3-dense", n_steps=8,
+                       batch=4)
+        ctx = ledger.plan_context(p)
+        assert ctx["label"] == "wgl3-dense"
+        assert ctx["n_shards"] == 1
+        assert ctx["cache_key"] == str(p.cache_key())
+
+
+# -- per-process files: the pod merge (satellite 3) -------------------------
+
+_WRITER = r"""
+import sys
+sys.path.insert(0, {repo!r})
+from jepsen_etcd_demo_tpu.obs import ledger
+
+out, proc, anchor = sys.argv[1], int(sys.argv[2]), float(sys.argv[3])
+led = ledger.Ledger(out_dir=out, proc=proc)
+
+def at(wall):
+    # Map a target WALL time through this process's OWN clock
+    # handshake: each subprocess has a different monotonic origin, so
+    # the raw t*_ns values are mutually meaningless across files.
+    return led.mono_ns + int((wall - led.wall_s) * 1e9)
+
+for i, off in enumerate([0.010, 0.030] if proc == 0 else [0.020, 0.040]):
+    led.record_launch(f"k{{proc}}", "execute", at(anchor + off),
+                      at(anchor + off + 0.005))
+led.close()
+"""
+
+
+class TestPodMerge:
+    def test_two_subprocess_writers_merge_into_ordered_timeline(
+            self, tmp_path):
+        """Two REAL processes, each with its own monotonic origin
+        (guaranteed skew), write interleaved launches against a shared
+        wall anchor; the merge orders them into one pod timeline."""
+        anchor = time.time()
+        script = _WRITER.format(repo=str(REPO))
+        procs = [subprocess.run(
+            [sys.executable, "-c", script, str(tmp_path), str(i),
+             repr(anchor)], capture_output=True, text=True, timeout=60)
+            for i in (0, 1)]
+        for p in procs:
+            assert p.returncode == 0, p.stderr
+        paths = ledger.ledger_paths(tmp_path)
+        assert [p.name for p in paths] == ["ledger-0.jsonl",
+                                           "ledger-1.jsonl"]
+        # The raw monotonic origins really are skewed between files.
+        metas = [json.loads(p.read_text().splitlines()[0])
+                 for p in paths]
+        assert metas[0]["mono_ns"] != metas[1]["mono_ns"]
+        assert metas[0]["pid"] != metas[1]["pid"]
+        merged = ledger.merge_ledgers(paths)
+        assert merged["warnings"] == []
+        assert merged["procs"] == [0, 1]
+        assert [r["kernel"] for r in merged["records"]] == \
+            ["k0", "k1", "k0", "k1"]
+        # Mapped wall times reconstruct the anchor offsets (the two
+        # handshakes happened within the subprocess lifetimes, so the
+        # mapping is exact up to clock granularity).
+        offs = [r["t0_s"] - anchor for r in merged["records"]]
+        assert offs == pytest.approx([0.010, 0.020, 0.030, 0.040],
+                                     abs=2e-3)
+
+    def test_truncated_file_degrades_to_counted_warning(self, tmp_path):
+        led = ledger.Ledger(out_dir=str(tmp_path), proc=0)
+        t0 = led.mono_ns
+        for i in range(3):
+            led.record_launch("k", "execute", t0 + i * 1000,
+                              t0 + i * 1000 + 500)
+        led.close()
+        path = tmp_path / "ledger-0.jsonl"
+        text = path.read_text()
+        # A killed writer leaves a partial trailing line.
+        path.write_text(text[: text.rindex('"kind"') + 8])
+        meta, records, warnings = ledger.read_ledger(path)
+        assert meta is not None
+        assert len(records) == 2
+        assert len(warnings) == 1 and "truncated at line 4" in \
+            warnings[0]
+        merged = ledger.merge_ledgers([path])
+        assert len(merged["records"]) == 2
+        assert any("truncated" in w for w in merged["warnings"])
+
+    def test_meta_less_file_is_skipped_with_warning(self, tmp_path):
+        bad = tmp_path / "ledger-7.jsonl"
+        bad.write_text('{"kind": "execute", "t0_ns": 1, "t1_ns": 2}\n')
+        merged = ledger.merge_ledgers([bad])
+        assert merged["records"] == [] and merged["procs"] == []
+        assert any("missing clock handshake" in w
+                   for w in merged["warnings"])
+
+
+class TestCriticalPath:
+    def test_longest_chain_with_self_time(self):
+        recs = [
+            {"kind": "span", "id": 1, "parent": None, "name": "run",
+             "t0_ns": 0, "t1_ns": 10_000_000_000},
+            {"kind": "span", "id": 2, "parent": 1, "name": "check",
+             "t0_ns": 1_000_000_000, "t1_ns": 9_000_000_000},
+            {"kind": "span", "id": 3, "parent": 1, "name": "setup",
+             "t0_ns": 0, "t1_ns": 500_000_000},
+            {"kind": "span", "id": 4, "parent": 2, "name": "kernel",
+             "t0_ns": 2_000_000_000, "t1_ns": 8_000_000_000},
+            {"kind": "event", "name": "noise"},
+        ]
+        path = ledger.critical_path(recs)
+        assert [h["name"] for h in path] == ["run", "check", "kernel"]
+        assert path[0]["dur_s"] == pytest.approx(10.0)
+        # run's self time: 10 - union(check, setup) = 10 - 8.5
+        assert path[0]["self_s"] == pytest.approx(1.5)
+        assert path[1]["self_s"] == pytest.approx(2.0)
+        assert ledger.critical_path([]) == []
+
+
+class TestRollingWindow:
+    def test_quantiles_and_pruning(self):
+        w = ledger.RollingWindow(window_s=10.0)
+        for i in range(100):
+            w.observe((i + 1) / 100.0, now=100.0)
+        p50, p99 = w.quantiles(now=100.0)
+        assert p50 == pytest.approx(0.5, abs=0.02)
+        assert p99 == pytest.approx(0.99, abs=0.02)
+        # Outside the window everything is pruned.
+        assert w.values(now=200.0) == []
+
+    def test_burn_rate_is_breach_share_over_budget(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_SERVE_SLO_P99_S", "1.0")
+        monkeypatch.setenv("JEPSEN_TPU_SERVE_SLO_BUDGET", "0.01")
+        w = ledger.RollingWindow(window_s=60.0)
+        for v in [0.5] * 98 + [2.0] * 2:
+            w.observe(v, now=10.0)
+        # 2% of requests breach a 1% budget -> burning 2x.
+        assert w.burn_rate(now=10.0) == pytest.approx(2.0)
+        assert ledger.slo_target_s() == pytest.approx(1.0)
+
+
+# -- report surfaces --------------------------------------------------------
+
+def _write_pod_dir(tmp_path) -> Path:
+    led = ledger.Ledger(out_dir=str(tmp_path), proc=0)
+    t0 = led.mono_ns
+    led.record_launch("wgl3-dense", "compile", t0, t0 + 50_000_000)
+    with ledger.launch_context(label="wgl3-dense", steps_real=60,
+                               steps_padded=100, batch_real=3,
+                               batch_padded=4, n_shards=2,
+                               shard_real=[50, 10]):
+        led.record_launch("wgl3-dense", "execute", t0 + 50_000_000,
+                          t0 + 150_000_000)
+    led.close()
+    return tmp_path
+
+
+class TestScalingReportCLI:
+    def test_build_and_render_decompose_the_wall(self, tmp_path):
+        _write_pod_dir(tmp_path)
+        paths = scaling_report.collect_paths([str(tmp_path)])
+        report = scaling_report.build_report(paths, wall_s=0.15)
+        att = report["attribution"]
+        assert att["coverage"] >= 0.95
+        assert att["launches"] == 2
+        assert att["buckets"]["straggler_s"] > 0
+        text = scaling_report.render_report(report)
+        assert "where the chip-seconds went" in text
+        assert "straggler launches" in text
+        assert "wgl3-dense" in text
+
+    def test_main_exit_codes_and_json(self, tmp_path, capsys):
+        assert scaling_report.main([str(tmp_path)]) == 2   # no files
+        _write_pod_dir(tmp_path)
+        assert scaling_report.main([str(tmp_path), "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert set(out["attribution"]["buckets"]) == set(ledger.BUCKETS)
+        assert scaling_report.main([str(tmp_path)]) == 0
+
+
+class TestWebWaterfall:
+    def test_panel_renders_buckets_and_warnings(self, tmp_path):
+        from jepsen_etcd_demo_tpu.web.server import \
+            _ledger_waterfall_html
+
+        assert _ledger_waterfall_html(tmp_path) == ""
+        _write_pod_dir(tmp_path)
+        # Plus a meta-less file: the warning surfaces in the panel.
+        (tmp_path / "ledger-9.jsonl").write_text('{"kind": "x"}\n')
+        page = _ledger_waterfall_html(tmp_path)
+        assert "scaling ledger" in page
+        assert "execute_s" in page and "straggler_s" in page
+        assert "missing clock handshake" in page

@@ -134,7 +134,75 @@ INFO_LANES: list[tuple[str, tuple]] = [
      ("detail", "campaign", "speedup_vs_sequential")),
     ("campaign_replay_wall_s", ("detail", "campaign", "replay_wall_s")),
     ("campaign_banked", ("detail", "campaign", "banked")),
+    # Scaling-ledger lanes (ISSUE 16): loss-bucket seconds are load-
+    # shaped and LOWER-better where they are loss at all — purely
+    # informational context for the gated throughput lanes (a padding_s
+    # move explains a corpus_sched_eps move; it is not itself a
+    # regression). The schema gate is check_ledger_record below, run by
+    # the tier-1 smoke test, never by the lane comparison.
+    ("ledger_execute_s", ("ledger", "execute_s")),
+    ("ledger_padding_s", ("ledger", "padding_s")),
+    ("ledger_straggler_s", ("ledger", "straggler_s")),
+    ("ledger_dispatch_gap_s", ("ledger", "dispatch_gap_s")),
+    ("ledger_encode_s", ("ledger", "encode_s")),
+    ("ledger_h2d_s", ("ledger", "h2d_s")),
+    ("sched_ledger_coverage",
+     ("detail", "corpus_sched", "ledger", "coverage")),
+    ("sched_ledger_overhead_pct",
+     ("detail", "corpus_sched", "ledger_overhead_pct")),
 ]
+
+# The zeros-never-absent `ledger` object every bench record carries
+# (obs.ledger_stats) and the windowed attribution shape
+# (obs.ledger.attribute) the corpus_sched lane / MULTICHIP_SCALING
+# line carry. check_ledger_record validates both.
+LEDGER_STATS_KEYS = ("launches", "encode_s", "h2d_s", "h2d_bytes",
+                     "compile_s", "execute_s", "padding_s",
+                     "straggler_s", "dispatch_gap_s")
+LEDGER_ATT_KEYS = ("wall_s", "coverage", "buckets")
+LEDGER_MIN_COVERAGE = 0.95
+
+
+def check_ledger_record(rec: dict) -> list[str]:
+    """Schema gate for the scaling ledger (ISSUE 16), returning the
+    list of problems (empty = pass). Every record — the degraded paths
+    included — must carry the all-keys `ledger` object (zeros
+    permitted, never absent); a NON-degraded record's windowed
+    attributions (detail.corpus_sched.ledger, scaling.ledger) must
+    additionally explain >= 95% of their measured wall."""
+    problems: list[str] = []
+    led = rec.get("ledger")
+    if not isinstance(led, dict):
+        return ["record omits the `ledger` object entirely"]
+    for key in LEDGER_STATS_KEYS:
+        if key not in led:
+            problems.append(f"ledger object missing key {key!r}")
+    if is_degraded(rec):
+        return problems
+    lane = _dig_raw(rec, ("detail", "corpus_sched"))
+    if isinstance(lane, dict) and "ledger" not in lane:
+        problems.append("non-degraded corpus_sched lane omits its "
+                        "windowed ledger attribution")
+    for where, att in (("detail.corpus_sched.ledger",
+                        _dig_raw(rec, ("detail", "corpus_sched",
+                                       "ledger"))),
+                       ("scaling.ledger",
+                        _dig_raw(rec, ("scaling", "ledger")))):
+        if att is None:
+            continue
+        if not isinstance(att, dict):
+            problems.append(f"{where} is not an attribution object")
+            continue
+        for key in LEDGER_ATT_KEYS:
+            if key not in att:
+                problems.append(f"{where} missing key {key!r}")
+        cov = att.get("coverage")
+        if isinstance(cov, (int, float)) and att.get("wall_s") \
+                and cov < LEDGER_MIN_COVERAGE:
+            problems.append(
+                f"{where} buckets explain only {cov:.1%} of wall "
+                f"(need >= {LEDGER_MIN_COVERAGE:.0%})")
+    return problems
 
 
 def load_record(path: str | Path) -> dict:

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""scaling_report — the where-did-the-chip-seconds-go waterfall.
+
+Merges a run's per-process ``ledger-<proc>.jsonl`` files (written by
+obs.capture next to the store artifacts) into one pod timeline and
+prints the loss-bucket waterfall: every second of measured wall
+decomposed into encode / H2D / compile / useful execute / bucket
+padding / straggler wait / host dispatch gap, ranked — the instrument
+behind ROADMAP item 1's "efficiency_vs_single: 0.14, where did the
+rest go?" question. See doc/telemetry.md "Scaling ledger".
+
+Usage:
+  python tools/scaling_report.py <run_dir>            # merge ledger-*.jsonl
+  python tools/scaling_report.py <file.jsonl> [...]   # explicit files
+  python tools/scaling_report.py <run_dir> --json     # machine-readable
+  python tools/scaling_report.py <run_dir> --wall 12.5  # known wall secs
+
+With a telemetry.jsonl present in the run dir, the report appends the
+span-tree critical path of the runner/serve path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from jepsen_etcd_demo_tpu.obs import ledger  # noqa: E402
+from jepsen_etcd_demo_tpu.obs.trace import read_jsonl  # noqa: E402
+
+
+def collect_paths(args: list[str]) -> list[Path]:
+    paths: list[Path] = []
+    for a in args:
+        p = Path(a)
+        if p.is_dir():
+            paths.extend(ledger.ledger_paths(p))
+        else:
+            paths.append(p)
+    return paths
+
+
+def build_report(paths: list[Path],
+                 wall_s: float | None = None) -> dict:
+    """Merge + attribute + roll up: the full report payload."""
+    merged = ledger.merge_ledgers(paths)
+    att = ledger.attribute(merged["records"], wall_s=wall_s)
+    return {
+        "files": [str(p) for p in paths],
+        "procs": merged["procs"],
+        "warnings": merged["warnings"],
+        "attribution": att,
+        "by_plan": ledger.by_plan(merged["records"]),
+        "stragglers": ledger.straggler_table(merged["records"])[:10],
+    }
+
+
+def render_report(report: dict, trace_path: Path | None = None) -> str:
+    lines = ["scaling report — where the chip-seconds went",
+             f"  processes: {report['procs'] or [0]}  "
+             f"files: {len(report['files'])}"]
+    for w in report["warnings"]:
+        lines.append(f"  WARNING: {w}")
+    lines.append("")
+    lines.extend(ledger.render_waterfall(report["attribution"]))
+    top = report["attribution"].get("top_losses") or []
+    if top:
+        lines.append("")
+        lines.append("top loss sources: "
+                     + ", ".join(f"{k}={v:.3f}s" for k, v in top[:3]))
+    plans = report.get("by_plan") or []
+    if plans:
+        lines.append("")
+        lines.append("by plan:")
+        for a in plans[:8]:
+            lines.append(
+                f"  {a['label']:<36} {a['launches']:>4} launches "
+                f"{a['seconds']:>9.3f}s  useful {a['useful_s']:.3f}s  "
+                f"waste {a['waste_s']:.3f}s")
+    stragglers = report.get("stragglers") or []
+    if stragglers:
+        lines.append("")
+        lines.append("straggler launches (mesh paid the bucket, shards "
+                     "did the steps):")
+        for row in stragglers[:5]:
+            lines.append(
+                f"  {row['label']:<36} bucket {row['steps_padded']:>6} "
+                f"shards {row['shard_real']} "
+                f"wait {row['straggler_s']:.3f}s")
+    if trace_path is not None and trace_path.exists():
+        path = ledger.critical_path(read_jsonl(trace_path))
+        if path:
+            lines.append("")
+            lines.append("critical path (telemetry.jsonl span tree):")
+            for hop in path[:10]:
+                lines.append(f"  {hop['name']:<36} {hop['dur_s']:>9.3f}s"
+                             f"  self {hop['self_s']:.3f}s")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="run dir (merges ledger-*.jsonl) or files")
+    ap.add_argument("--wall", type=float, default=None,
+                    help="measured wall seconds (defaults to the "
+                         "instrumented window)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the report as JSON")
+    ns = ap.parse_args(argv)
+    paths = collect_paths(ns.paths)
+    if not paths:
+        print("scaling_report: no ledger-*.jsonl found", file=sys.stderr)
+        return 2
+    report = build_report(paths, wall_s=ns.wall)
+    if ns.as_json:
+        print(json.dumps(report, indent=2))
+        return 0
+    trace = None
+    first = Path(ns.paths[0])
+    if first.is_dir():
+        cand = first / "telemetry.jsonl"
+        trace = cand if cand.exists() else None
+    print(render_report(report, trace_path=trace))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
