@@ -263,7 +263,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated KernelLimits field or probe-"
                         "group names (default: every knob with a probe "
                         "group; groups: dense_sweep, sparse, sched, "
-                        "pipeline, pallas, stream)")
+                        "pipeline, pallas, stream, pod)")
     u.add_argument("--repeats", type=positive_int, default=2,
                    help="best-of repeats per measurement (default 2)")
     u.add_argument("--scale", type=positive_float, default=1.0,
@@ -313,6 +313,24 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--ready-file", default=None,
                    help="[--check] also write the startup JSON (port, "
                         "url) to this file once bound")
+
+    wu = sub.add_parser(
+        "warmup",
+        help="pre-compile the plan-family corpus into the persistent "
+             "XLA cache (sched/warmup.py; doc/perf.md 'Pod "
+             "efficiency') — run once from a blessed host so fleet "
+             "cold-compiles never land on the dispatch critical path")
+    wu.add_argument("--rungs", type=positive_int, default=2,
+                    help="step-bucket ladder rungs to compile, from the "
+                         "tuned floor (default 2)")
+    wu.add_argument("--k-slots", type=positive_int, default=16,
+                    help="concurrency-slot geometry to warm (default 16)")
+    wu.add_argument("--no-encoder", action="store_true",
+                    help="skip the device-side encoder family")
+    wu.add_argument("--store", default="store",
+                    help="results store root (locates the persistent "
+                         "compile cache at <store>/.xla-cache)")
+    _add_mesh_shape_flag(wu)
 
     pl = sub.add_parser(
         "plan",
@@ -785,6 +803,12 @@ def cmd_campaign(args) -> int:
     enable_compilation_cache(args.store)
     _apply_sweep_mode(args)
     _apply_mesh_shape(args)
+    # Startup pre-warm (ISSUE 17): same hook as the serve daemon — the
+    # campaign's first wave should hit the persistent cache, not
+    # compile on the critical path. Env-gated, never fatal.
+    from ..sched import startup_warmup
+
+    startup_warmup(args.store, source="campaign")
     families = ([f.strip() for f in args.families.split(",") if f.strip()]
                 if args.families else None)
     with obs.capture():
@@ -855,14 +879,40 @@ def cmd_plan(args) -> int:
     return 0 if report["sync"] == "ok" else 1
 
 
+def cmd_warmup(args) -> int:
+    """`jepsen-tpu warmup`: replay the plan-family corpus through the
+    persistent XLA cache (sched/warmup.py) so the fleet's first real
+    launches are disk-cache hits. Prints one WARMUP JSON line — the
+    ledger-armed warmup record (check_ledger_record-clean)."""
+    from ..sched import warmup_plans
+
+    enable_compilation_cache(args.store)
+    _apply_mesh_shape(args)
+    try:
+        rec = warmup_plans(rungs=args.rungs, k_slots=args.k_slots,
+                           encoder=not args.no_encoder,
+                           store_root=args.store)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print("WARMUP " + json.dumps(rec, sort_keys=True))
+    return 0
+
+
 def cmd_serve(args) -> int:
     if getattr(args, "check", False):
         # Checking-as-a-service (serve/, ISSUE 13): the warm pool only
         # pays off across requests if compiles persist, so the daemon
         # enables the same compilation cache production runs use.
+        from ..sched import startup_warmup
         from ..serve.daemon import serve_check
 
         enable_compilation_cache(args.store)
+        # Startup pre-warm (ISSUE 17): fill the persistent cache with
+        # the plan-family corpus BEFORE accepting traffic, so the first
+        # request never pays a cold compile. JEPSEN_TPU_NO_WARMUP=1
+        # skips; failures are swallowed (warmup is an optimization).
+        startup_warmup(args.store, source="serve")
         return serve_check(
             args.store, host=args.host, port=args.port,
             default_model=args.model, coalesce_ms=args.coalesce_ms,
@@ -933,6 +983,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return cmd_plan(args)
     if args.command == "serve":
         return cmd_serve(args)
+    if args.command == "warmup":
+        return cmd_warmup(args)
     return 2
 
 

@@ -156,7 +156,8 @@ def shard_real_steps(step_counts: list[int], n_shards: int) -> list[int]:
 
 _CTX_FIELDS = ("cache_key", "plan_family", "label", "mesh_shape",
                "n_shards", "batch_real", "batch_padded",
-               "steps_real", "steps_padded", "shard_real")
+               "steps_real", "steps_padded", "shard_real",
+               "shard_packed")
 
 
 @dataclass
@@ -568,6 +569,7 @@ def straggler_table(records: list[dict]) -> list[dict]:
         rows.append({"label": r.get("label") or r.get("kernel") or "?",
                      "steps_padded": int(r.get("steps_padded") or 0),
                      "shard_real": [int(s) for s in shards],
+                     "shard_packed": bool(r.get("shard_packed")),
                      "straggler_s": round(strag, 6)})
     return sorted(rows, key=lambda x: -x["straggler_s"])
 

@@ -578,11 +578,29 @@ class ReturnSteps:
 def encode_return_steps(enc: EncodedHistory) -> ReturnSteps:
     """Derive the return-major encoding from the event encoding.
 
-    Vectorized (no per-return [K,4] snapshot loop): for each return event at
-    position p, slot k's table row is the fields of the last EV_INVOKE of
-    slot k before p, and slot k is active iff its invokes before p outnumber
-    its returns strictly before p (the returning op itself counts active).
-    """
+    Placement routes through ``limits().encode_mode``: mode 2 expands
+    the table ON DEVICE (ops/encode_device.py — bit-identical rows, the
+    event stream crosses the H2D boundary instead of the packed table);
+    modes 0/1 run the host expansion below. Every consumer — post-hoc
+    checks AND the streaming IncrementalEncoder prefix (stream/
+    engine.py calls this on its stable rows) — funnels through here, so
+    the one knob governs both paths."""
+    from .limits import limits
+
+    if limits().encode_mode == 2:
+        from . import encode_device
+
+        if encode_device.device_encode_feasible(enc):
+            return encode_device.encode_return_steps_device(enc)
+    return _encode_return_steps_host(enc)
+
+
+def _encode_return_steps_host(enc: EncodedHistory) -> ReturnSteps:
+    """The host expansion, vectorized (no per-return [K,4] snapshot
+    loop): for each return event at position p, slot k's table row is
+    the fields of the last EV_INVOKE of slot k before p, and slot k is
+    active iff its invokes before p outnumber its returns strictly
+    before p (the returning op itself counts active)."""
     t_enc = time.monotonic()
     k = enc.k_slots
     n = enc.n_events

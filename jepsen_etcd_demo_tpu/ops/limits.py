@@ -160,6 +160,30 @@ class KernelLimits:
     # only syncs every N chunks; dead chunks in between are near-free
     # (empty closures).
     sched_poll_chunks: int = _f(8, "tunable", 1, 64, group="pipeline")
+    # [tunable] History-encoding placement (ops/encode.py routing to the
+    # device encoder kernel, ops/encode_device.py): 0 = auto (device on
+    # the mesh-sharded batch lane where the packed-table H2D dominates,
+    # host elsewhere), 1 = host always, 2 = device whenever the geometry
+    # fits a jittable event bucket. Rows are bit-identical in every mode
+    # (tests/test_pod_scaling.py pins host/device differentials), so
+    # this is purely a transfer/fusion placement choice — the pod tune
+    # group measures which side of the H2D boundary wins per machine.
+    encode_mode: int = _f(0, "tunable", 0, 2, group="pod")
+    # [tunable] In-flight launch window of the pod dispatch pipeline
+    # (plan/dispatch.py LaunchPipeline): bucket launch N+1's host prep +
+    # H2D staging overlaps launch N's device execute, bounding both the
+    # speculative depth and the undrained device-result memory. 1
+    # restores the fetch-after-every-launch synchronous loop; the old
+    # unbounded drain-at-end behaviour is depth >= the launch count.
+    pod_pipeline_depth: int = _f(4, "tunable", 1, 8, group="pod")
+    # [tunable] Shard-aware bucketing (sched/engine.py + parallel/
+    # dense.py): 1 = split sharded launches into per-step-length buckets
+    # and LPT-pack histories into contiguous per-shard blocks balanced
+    # by REAL step count, so one ragged straggler no longer pads the
+    # whole mesh (the MULTICHIP_r06 smoking gun); 0 = legacy one-bucket
+    # corpus padding. Verdicts are bit-identical either way — packing
+    # permutes launch order only, never the per-history scan.
+    shard_bucket_mode: int = _f(1, "tunable", 0, 1, group="pod")
     # [arch] Entry capacity of the scheduler's in-process kernel LRU
     # (sched/compile_cache.py, keyed by (kernel, model, bucket shape)).
     kernel_cache_entries: int = _f(256, "arch", 16, 4096)

@@ -104,6 +104,33 @@ def sharded_batch_checker3_packed(model: Model, cfg: DenseConfig,
     return _CACHE[key]
 
 
+def sharded_device_encoder(k_slots: int, e_cap: int, r_cap: int,
+                           mesh: Mesh, axis: str = "batch"):
+    """The device-side history encoder (ops/encode_device.py),
+    batch-sharded: jitted encode(events i32[B, e_cap, 6]) ->
+    (slot_tabs i32[B, r_cap, K, 4], slot_active bool[B, r_cap, K],
+    targets i32[B, r_cap]) with B partitioned over `axis`. Output
+    shardings match the sharded checker's input shardings exactly, so
+    the encoded tables NEVER visit the host: the compact event tensor
+    crosses once and each device expands its own shard in place —
+    killing the packed-table H2D that dominated the r06 pod waterfall.
+    B must be a multiple of the total device count."""
+    from ..ops import encode_device
+
+    axis = _resolve_axis(mesh, axis)
+    key = ("encode-sharded", k_slots, e_cap, r_cap, _mesh_key(mesh), axis)
+    if key not in _CACHE:
+        fn = jax.vmap(encode_device._encode_fn(k_slots, e_cap, r_cap))
+        in_sh = NamedSharding(mesh, P(axis, None, None))
+        out_sh = (NamedSharding(mesh, P(axis, None, None, None)),
+                  NamedSharding(mesh, P(axis, None, None)),
+                  NamedSharding(mesh, P(axis, None)))
+        _CACHE[key] = instrument_kernel(
+            "wgl3-encode-sharded",
+            jax.jit(fn, in_shardings=(in_sh,), out_shardings=out_sh))
+    return _CACHE[key]
+
+
 def sharded_batch_checker2(model: Model, cfg2, mesh: Mesh,
                            axis: str = "batch"):
     """The SORT kernel (ops/wgl2.py — the non-dense production path:
@@ -262,23 +289,48 @@ def pad_batch_arrays(arrays, multiple: int):
 
 
 def check_steps_sharded(model: Model, cfg: DenseConfig, steps,
-                        r_cap: int, mesh: Mesh | None = None
+                        r_cap: int, mesh: Mesh | None = None, *,
+                        encs: Sequence | None = None
                         ) -> tuple[list[dict], str]:
     """Device-side half of the sharded batch check, for callers that
-    already ran wgl3.batch_steps3: pad the [B] axis to the mesh, launch
-    once, strip pads. Returns (per-history results, kernel_name).
+    already ran wgl3.batch_steps3. Returns (per-history results,
+    kernel_name of the last launch).
 
-    The [B] axis pads to a {2^k, 1.5*2^k} BUCKET (then the sharding
-    multiple), not just the multiple: ragged corpora of nearby sizes
-    share one compiled shape instead of recompiling per batch size —
-    the batch-axis twin of the scheduler's step-length buckets
-    (sched/engine.py). Pad histories are all-pad scans (targets=-1,
-    zero work) and are stripped before assembly."""
+    Two bucketing disciplines, switched by limits().shard_bucket_mode:
+
+      0  legacy: ONE launch at the corpus-wide r_cap — every history
+         pays the longest history's step count in padding, and shard
+         load is whatever corpus order dealt (the r06 straggler table's
+         [3913, .., 2305, 0, 0] smoking gun).
+      1  shard-aware (default): histories split into {2^k, 1.5*2^k}
+         step-length buckets, each bucket's batch is LPT-packed
+         (sched/engine.py lpt_shard_order) so contiguous per-shard
+         blocks carry balanced REAL steps, and successive bucket
+         launches overlap through the LaunchPipeline window.
+
+    When `encs` (the EncodedHistory per entry, aligned with `steps`) is
+    given and limits().encode_mode allows it, the packed tables are
+    built ON DEVICE from the compact event tensors
+    (sharded_device_encoder) and never visit the host. Verdicts are
+    bit-identical across all four mode combinations — padding steps are
+    no-ops and the device encoder mirrors the host one exactly."""
+    if mesh is None:
+        mesh = batch_mesh()
+    if not limits().shard_bucket_mode:
+        return _check_steps_one_launch(model, cfg, steps, r_cap, mesh)
+    return _check_steps_bucketed(model, cfg, steps, r_cap, mesh, encs)
+
+
+def _check_steps_one_launch(model: Model, cfg: DenseConfig, steps,
+                            r_cap: int, mesh: Mesh
+                            ) -> tuple[list[dict], str]:
+    """The legacy shard_bucket_mode=0 body: pad the [B] axis to a
+    {2^k, 1.5*2^k} bucket (then the sharding multiple), launch ONCE at
+    the corpus-wide r_cap, strip pads. Pad histories are all-pad scans
+    (targets=-1, zero work)."""
     from ..obs import ledger as obs_ledger
     from ..plan import plan_dense_batch, resolve
 
-    if mesh is None:
-        mesh = batch_mesh()
     mult = batch_multiple(model, cfg, mesh, n_steps=r_cap,
                           batch=len(steps))
     b_bucket = wgl3.step_bucket(len(steps),
@@ -311,12 +363,203 @@ def check_steps_sharded(model: Model, cfg: DenseConfig, steps,
     return wgl3.assemble_batch_results(out, steps, cfg), p.label
 
 
+def _pad_steps(k_slots: int):
+    """An all-pad zero-step ReturnSteps (batch filler — padded_to emits
+    only targets=-1 pad rows, trivially valid)."""
+    from ..ops.encode import ReturnSteps
+
+    return ReturnSteps(
+        slot_tabs=np.zeros((0, k_slots, 4), np.int32),
+        slot_active=np.zeros((0, k_slots), bool),
+        targets=np.zeros((0,), np.int32),
+        n_steps=0, n_ops=0, k_slots=k_slots, max_pending=0, max_value=0)
+
+
+def _pad_enc(k_slots: int):
+    """The event-stream twin of _pad_steps: an empty EncodedHistory the
+    device encoder expands to all-pad rows."""
+    from ..ops.encode import EVENT_WIDTH, EncodedHistory
+
+    return EncodedHistory(
+        events=np.zeros((0, EVENT_WIDTH), np.int32), n_events=0,
+        n_ops=0, k_slots=k_slots, max_pending=0, max_value=0)
+
+
+def _batch_slabs(n: int, floor: int, mult: int) -> list[int]:
+    """Slab decomposition of a launch's batch axis: ladder-shaped slab
+    sizes (multiples of the mesh multiple `mult`) covering `n` rows
+    with bounded tail padding. Rounding one giant launch up the
+    {2^k, 1.5*2^k} ladder costs up to 33% pure batch padding (517 rows
+    -> 768); peeling full rungs first ([512, 8]) keeps every slab but
+    the tail 100% full, on ladder shapes the compile cache already
+    holds — and hands the launch pipeline more launches to overlap."""
+    mult = max(1, mult)
+    slabs: list[int] = []
+    rem = max(0, n)
+    while True:
+        b = wgl3.step_bucket(max(rem, 1), floor=floor)
+        b = (b + mult - 1) // mult * mult
+        # Terminal slab once its padding is small: at most one mesh
+        # row-block or 1/8 of the remaining real rows.
+        if b - rem <= max(mult, rem // 8):
+            slabs.append(b)
+            return slabs
+        # Otherwise peel the largest ladder rung that fits FULL.
+        full = floor
+        nxt = wgl3.step_bucket(full + 1, floor=floor)
+        while nxt <= rem and nxt > full:
+            full = nxt
+            nxt = wgl3.step_bucket(full + 1, floor=floor)
+        full = full // mult * mult
+        if full < mult or full > rem:
+            # No full rung fits below the remainder: pad the tail up.
+            slabs.append(b)
+            return slabs
+        slabs.append(full)
+        rem -= full
+        if rem == 0:
+            return slabs
+
+
+def _check_steps_bucketed(model: Model, cfg: DenseConfig, steps,
+                          r_cap: int, mesh: Mesh, encs
+                          ) -> tuple[list[dict], str]:
+    """The shard-aware discipline: per-length step buckets, LPT shard
+    packing inside each launch, pipelined launches, optional device-side
+    encoding. See check_steps_sharded."""
+    from ..obs import ledger as obs_ledger
+    from ..ops import encode_device
+    from ..ops.encode import reslot_events
+    from ..plan import LaunchPipeline, plan_dense_batch, resolve
+    from ..sched.engine import lpt_shard_order
+
+    lim = limits()
+    # Device-encode engages on this lane for encode_mode 0 (auto) and 2;
+    # 1 pins the host encoder. Per-bucket geometry can still veto it.
+    want_dev = encs is not None and lim.encode_mode != 1
+    if want_dev:
+        encs = [reslot_events(e, cfg.k_slots)
+                if e.k_slots != cfg.k_slots else e for e in encs]
+
+    buckets: dict[int, list[int]] = {}
+    for i, s in enumerate(steps):
+        r = min(wgl3.step_bucket(s.n_steps), r_cap)
+        buckets.setdefault(r, []).append(i)
+
+    results: list = [None] * len(steps)
+
+    def _fetch_launch(entry):
+        part, part_steps, dev, lctx, perm = entry
+        t0f = time.monotonic_ns()
+        fetched = np.asarray(dev)
+        obs.get_ledger().record_fetch(t0f, time.monotonic_ns(),
+                                      ctx=lctx)
+        if perm is None:
+            rows = fetched[:len(part)]
+        else:
+            inv = [0] * len(perm)
+            for j, p in enumerate(perm):
+                inv[p] = j
+            rows = fetched[[inv[p] for p in range(len(part))]]
+        out = wgl3.unpack_np(rows)
+        for i, one in zip(part, wgl3.assemble_batch_results(
+                out, part_steps, cfg)):
+            results[i] = one
+
+    pipe = LaunchPipeline(resolve=_fetch_launch)
+    label = ""
+    slabbed: list[tuple[int, list[int], int]] = []
+    tail_pool: list[tuple[int, list[int]]] = []
+    for r in sorted(buckets):
+        idx = buckets[r]
+        mult = batch_multiple(model, cfg, mesh, n_steps=r,
+                              batch=len(idx))
+        slabs = _batch_slabs(len(idx), lim.batch_bucket_floor, mult)
+        off = 0
+        for k, slab in enumerate(slabs):
+            part = idx[off:off + slab]
+            off += slab
+            if (k == len(slabs) - 1 and len(part) < slab
+                    and len(buckets) > 1):
+                tail_pool.append((r, part))
+            else:
+                slabbed.append((r, part, slab))
+    if tail_pool:
+        # Every bucket's partial tail slab pooled into ONE launch at
+        # the pooled maximum rung: N per-bucket tails of 1-2 real rows
+        # each leave most shards idle (the straggler table's
+        # [52, 50, 0, 0, 0, 0, 0, 0] shape); one pooled launch
+        # LPT-balances the same rows across all shards. Padding the
+        # shorter buckets' histories up to r_t is inert pad rows —
+        # verdicts are unchanged.
+        r_t = max(r for r, _ in tail_pool)
+        pool = [i for _, p in tail_pool for i in p]
+        mult = batch_multiple(model, cfg, mesh, n_steps=r_t,
+                              batch=len(pool))
+        off = 0
+        for slab in _batch_slabs(len(pool), lim.batch_bucket_floor,
+                                 mult):
+            slabbed.append((r_t, pool[off:off + slab], slab))
+            off += slab
+    for r, part, b_pad in slabbed:
+        part_steps = [steps[i] for i in part]
+        padded = part_steps + [_pad_steps(cfg.k_slots)] * (
+            b_pad - len(part))
+        p = plan_dense_batch(model, cfg, n_steps=r, batch=b_pad,
+                             mesh=mesh)
+        check = resolve(p)
+        lctx = obs_ledger.plan_context(p)
+        lctx.update(batch_real=len(part), batch_padded=b_pad,
+                    steps_real=sum(s.n_steps for s in part_steps),
+                    steps_padded=b_pad * r)
+        perm = None
+        n_shards = lctx.get("n_shards", 1)
+        if n_shards > 1:
+            perm = lpt_shard_order([s.n_steps for s in padded],
+                                   n_shards)
+            if perm == list(range(len(padded))):
+                perm = None
+            else:
+                padded = [padded[j] for j in perm]
+                lctx["shard_packed"] = True
+            lctx["shard_real"] = obs_ledger.shard_real_steps(
+                [s.n_steps for s in padded], n_shards)
+        # Device-encode geometry check is per bucket: the one-hot
+        # expansion must fit the launch element budget at this bucket's
+        # event capacity.
+        e_cap = 0
+        if want_dev:
+            e_cap = encode_device.event_bucket(
+                max((encs[i].n_events for i in part), default=1))
+            if e_cap * max(1, cfg.k_slots) > lim.stack_element_budget:
+                e_cap = 0
+        with obs_ledger.launch_context(**lctx):
+            if e_cap:
+                bucket_encs = ([encs[i] for i in part]
+                               + [_pad_enc(cfg.k_slots)]
+                               * (b_pad - len(part)))
+                if perm is not None:
+                    bucket_encs = [bucket_encs[j] for j in perm]
+                ev = encode_device.stack_events(bucket_encs, e_cap)
+                enc_fn = sharded_device_encoder(cfg.k_slots, e_cap, r,
+                                                mesh)
+                dev = check(*enc_fn(ev))
+            else:
+                arrays = wgl3.stack_steps3(padded, r)
+                dev = check(*arrays)
+        pipe.submit((part, part_steps, dev, lctx, perm))
+        label = p.label
+    pipe.drain()
+    return results, label
+
+
 def check_batch_sharded(encs: Sequence, model: Model,
                         mesh: Mesh | None = None) -> tuple[list[dict], str]:
-    """Batch-sharded dense check over encoded histories: one launch,
-    [B] partitioned over the mesh. Mirrors wgl3.check_batch_encoded3's
-    result schema; returns (per-history results, kernel_name). Caller
-    guarantees dense feasibility under one shared DenseConfig; ragged B
-    is padded internally."""
+    """Batch-sharded dense check over encoded histories: [B] partitioned
+    over the mesh, shard-aware bucketing and device-side encoding when
+    the knobs allow. Mirrors wgl3.check_batch_encoded3's result schema;
+    returns (per-history results, kernel_name). Caller guarantees dense
+    feasibility under one shared DenseConfig; ragged B is padded
+    internally."""
     cfg, steps, r_cap = wgl3.batch_steps3(encs, model)
-    return check_steps_sharded(model, cfg, steps, r_cap, mesh)
+    return check_steps_sharded(model, cfg, steps, r_cap, mesh, encs=encs)

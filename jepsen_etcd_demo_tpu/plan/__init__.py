@@ -10,8 +10,9 @@ doc/perf.md "KernelPlan & pod-scale".
 from .core import (CONTRACTS_FILE, KernelPlan, MeshSpec,  # noqa: F401
                    PlanContractError, build_plan, check_registry,
                    load_contracts, plan_report, verify_registry)
-from .dispatch import (dispatch, dispatch_long,  # noqa: F401
-                       launch_multiple, plan_dense_batch, plan_elle_batch,
+from .dispatch import (LaunchPipeline, dispatch,  # noqa: F401
+                       dispatch_long, launch_multiple, plan_dense_batch,
+                       plan_device_encode, plan_elle_batch,
                        plan_elle_single, plan_long_sweep, plan_resumable,
                        plan_stream_chunk, resolve)
 from .registry import (PLAN_FAMILIES, backend_callable,  # noqa: F401

@@ -209,6 +209,18 @@ def _b_wgl3_multislice(p):
                                                      _mesh_of(p))
 
 
+def _b_wgl3_encode(p):
+    e = _extra(p)
+    return backend_callable("wgl3-encode")(
+        e["k_slots"], e["e_cap"], p.n_steps)
+
+
+def _b_wgl3_encode_sharded(p):
+    e = _extra(p)
+    return backend_callable("wgl3-encode-sharded")(
+        e["k_slots"], e["e_cap"], p.n_steps, _mesh_of(p))
+
+
 def _b_elle_closure(p):
     return backend_callable("elle-closure")(_extra(p)["n_pad"])
 
@@ -245,6 +257,8 @@ _BUILDERS = {
     "wgl3-chunk-dedup": _b_wgl3_chunk_dedup,
     "wgl3-dense-multislice": _b_wgl3_multislice,
     "wgl3-dense-sharded": _b_wgl3_dense_sharded,
+    "wgl3-encode": _b_wgl3_encode,
+    "wgl3-encode-sharded": _b_wgl3_encode_sharded,
     "wgl3-lattice-chunk": _b_wgl3_lattice_chunk,
     "wgl3-pallas": _b_wgl3_pallas,
     "wgl3-pallas-grouped": _b_wgl3_pallas_grouped,
@@ -486,3 +500,106 @@ def plan_elle_single(n_pad: int) -> KernelPlan:
     """One single-graph dense closure launch (ops/cycles.py)."""
     return build_plan("elle-closure", n_pad=n_pad, label="elle-closure",
                       provenance={"backend": "elle"})
+
+
+def plan_device_encode(k_slots: int, e_cap: int, r_cap: int,
+                       batch: Optional[int] = None,
+                       mesh: Any = None) -> KernelPlan:
+    """One device-side history-encode launch (ops/encode_device.py):
+    events[(B,) e_cap, 6] -> the return-major slot-table arrays the
+    dense checkers consume, built on-device. Sharded over the batch
+    mesh when the caller passes one (parallel/dense.py — each shard
+    expands its own histories; only the compact event stream crosses
+    the H2D boundary), single-device otherwise."""
+    prov = {"backend": "device-encode"}
+    if mesh is not None:
+        spec = mesh if isinstance(mesh, MeshSpec) else \
+            MeshSpec.from_mesh(mesh)
+        return build_plan("wgl3-encode-sharded",
+                          label="wgl3-encode-sharded", n_steps=r_cap,
+                          batch=batch, mesh=spec, k_slots=k_slots,
+                          e_cap=e_cap, provenance=prov | {"mesh": "caller"})
+    return build_plan("wgl3-encode", label="wgl3-encode", n_steps=r_cap,
+                      batch=batch, k_slots=k_slots, e_cap=e_cap,
+                      provenance=prov)
+
+
+class LaunchPipeline:
+    """Depth-bounded in-flight launch window for bucketed corpus
+    dispatch — the ``wgl3.check_steps3_long`` double-buffering
+    discipline lifted to WHOLE launches. The caller stages + dispatches
+    launch N+1 (async: host prep and the H2D enqueue overlap launch N's
+    device execute) and push()es an entry per launch; once
+    ``limits().pod_pipeline_depth`` launches are in flight, submit()
+    resolves (fetches) the OLDEST entry before admitting the new one,
+    so undrained device results stay bounded and fetch round trips hide
+    under real device work instead of stalling the tail.
+
+    depth=1 restores the fetch-after-every-launch synchronous loop; a
+    depth at or beyond the launch count reproduces the old unbounded
+    dispatch-all-then-drain behaviour. Ordering and results are
+    bit-identical at any depth — the window only reorders WHEN fetches
+    happen, never what was launched.
+
+    ``rollback()`` is the mid-pipeline falsification escape hatch: it
+    discards every speculative in-flight entry WITHOUT resolving it
+    (speculated launches were wasted device work, not wrong answers)
+    and marks the pipeline aborted so a fail-fast caller stops
+    submitting (tests/test_pod_scaling.py pins depth bounding and
+    rollback)."""
+
+    def __init__(self, depth: Optional[int] = None, resolve=None):
+        from ..ops.limits import limits
+        from ..sched.pipeline import InflightWindow
+
+        if depth is None:
+            depth = limits().pod_pipeline_depth
+        self._win = InflightWindow(depth)
+        self._resolve = resolve
+        self._aborted = False
+        self.dispatched = 0
+        self.rolled_back = 0
+
+    @property
+    def depth(self) -> int:
+        return self._win.depth
+
+    @property
+    def aborted(self) -> bool:
+        return self._aborted
+
+    def __len__(self) -> int:
+        return len(self._win)
+
+    def _resolve_one(self):
+        entry = self._win.pop()
+        return self._resolve(entry) if self._resolve is not None else entry
+
+    def submit(self, entry) -> list:
+        """Admit one dispatched launch; returns the resolved entries the
+        window had to retire to make room (possibly none)."""
+        if self._aborted:
+            raise RuntimeError("submit after rollback")
+        drained = []
+        while self._win.full():
+            drained.append(self._resolve_one())
+        self._win.push(entry)
+        self.dispatched += 1
+        return drained
+
+    def drain(self) -> list:
+        """Resolve every remaining in-flight entry, oldest first."""
+        out = []
+        while self._win:
+            out.append(self._resolve_one())
+        return out
+
+    def rollback(self) -> int:
+        """Discard the speculative window (mid-pipeline falsification):
+        in-flight entries are dropped unresolved, the pipeline refuses
+        further submits. Returns the number of launches discarded."""
+        n = len(self._win)
+        self._win.clear()
+        self._aborted = True
+        self.rolled_back += n
+        return n

@@ -168,6 +168,24 @@ PLAN_FAMILIES = {
         "axes": ["batch"],
         "role": "launch",
     },
+    "wgl3-encode": {
+        "module": "jepsen_etcd_demo_tpu/ops/encode_device.py",
+        "factory": "cached_device_encoder",
+        "donates": [],
+        "packed": None,
+        "carry": None,
+        "axes": [],
+        "role": "launch",
+    },
+    "wgl3-encode-sharded": {
+        "module": "jepsen_etcd_demo_tpu/parallel/dense.py",
+        "factory": "sharded_device_encoder",
+        "donates": [],
+        "packed": None,
+        "carry": None,
+        "axes": ["batch"],
+        "role": "launch",
+    },
     "wgl3-lattice-chunk": {
         "module": "jepsen_etcd_demo_tpu/parallel/lattice.py",
         "factory": "make_lattice_chunk_fn",

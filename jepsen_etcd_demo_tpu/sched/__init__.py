@@ -19,8 +19,9 @@ See doc/perf.md for the operator-facing story.
 from .compile_cache import (compile_cache_dir, enable_persistent_cache,
                             kernel_cache)
 from .engine import (assign_step_buckets, check_corpus, corpus_executor,
-                     fold_stats, submit_corpus)
+                     fold_stats, lpt_shard_order, submit_corpus)
 from .pipeline import InflightWindow, double_buffer
+from .warmup import startup_warmup, warmup_plans
 
 __all__ = [
     "assign_step_buckets",
@@ -32,5 +33,8 @@ __all__ = [
     "fold_stats",
     "InflightWindow",
     "kernel_cache",
+    "lpt_shard_order",
+    "startup_warmup",
     "submit_corpus",
+    "warmup_plans",
 ]

@@ -50,6 +50,49 @@ def assign_step_buckets(step_counts: Sequence[int]) -> list[int]:
     return [wgl3.step_bucket(int(n), floor=floor) for n in step_counts]
 
 
+def lpt_shard_order(step_counts: Sequence[int], n_shards: int
+                    ) -> list[int]:
+    """Deterministic LPT (longest-processing-time) bin packing of a
+    padded launch's histories into the mesh's contiguous per-shard
+    blocks, balanced by REAL step count — the shard-aware half of the
+    bucketed scheduler (limits().shard_bucket_mode).
+
+    The sharded routes split the [B] axis into n_shards equal
+    CONTIGUOUS blocks (obs.ledger.shard_real_steps is the accounting
+    twin), so batch ORDER is the packing degree of freedom: the legacy
+    append-pads-at-the-end order loads the leading shards with every
+    real step while the trailing shards sweep all-pad lanes — the
+    MULTICHIP_r06 straggler_table smoking gun (shards
+    [3913, ..., 2305, 0, 0]). This permutation assigns histories
+    longest-first to the least-loaded shard with remaining capacity
+    (ties -> lowest shard id), then restores ascending original order
+    within each shard, so equal-work shards retire their bucket
+    together and the mesh stops idling behind one straggler.
+
+    Returns the permutation `perm` such that position j of the packed
+    launch holds original entry perm[j]; identity when the batch does
+    not split evenly (mirroring shard_real_steps' degraded contract) or
+    there is nothing to balance. Pure and deterministic — same counts,
+    same shard count, same order — so verdicts and compiled shapes are
+    independent of packing (tests/test_pod_scaling.py pins determinism
+    across mesh shapes)."""
+    n = len(step_counts)
+    if n_shards <= 1 or n == 0 or n % n_shards:
+        return list(range(n))
+    cap = n // n_shards
+    order = sorted(range(n), key=lambda i: (-int(step_counts[i]), i))
+    loads = [0] * n_shards
+    fill: list[list[int]] = [[] for _ in range(n_shards)]
+    for i in order:
+        s = min((j for j in range(n_shards) if len(fill[j]) < cap),
+                key=lambda j: (loads[j], j))
+        fill[s].append(i)
+        loads[s] += int(step_counts[i])
+    for block in fill:
+        block.sort()
+    return [i for block in fill for i in block]
+
+
 def _batch_bucket(n: int, cap: int) -> int:
     """Batch-axis bucket: {2^k, 1.5*2^k} growth from the batch floor,
     capped by the launch-size cap. The sharding-multiple round-up
@@ -267,7 +310,46 @@ def check_corpus(encs: Sequence, model=None, f_cap: int = 256
                             assign_step_buckets(
                                 [steps_of[i].n_steps for i in short_idx])):
                 buckets.setdefault(r, []).append(i)
-            pending = []   # (idxs, part_steps, device_out)
+            def _fetch_launch(entry):
+                part, part_steps, dev, lctx, perm = entry
+                t0f = time.monotonic_ns()
+                try:
+                    fetched = np.asarray(dev)
+                except Exception as e:
+                    # The drain fetch is where a dead backend finally
+                    # surfaces for async launches — tell the supervisor
+                    # before propagating.
+                    supervisor.note_failure(f"{type(e).__name__}: {e}",
+                                            source="sched.dispatch")
+                    raise
+                # The drain fetch is where async device time surfaces
+                # on the host — ledger it under the launch's context so
+                # padding/straggler decomposition sees the real wait.
+                obs.get_ledger().record_fetch(t0f, time.monotonic_ns(),
+                                              ctx=lctx)
+                if perm is None:
+                    rows = fetched[:len(part)]
+                else:
+                    # Shard packing permuted the batch: row j holds
+                    # original lane perm[j]; invert to read the real
+                    # histories back in part order.
+                    inv = [0] * len(perm)
+                    for j, p in enumerate(perm):
+                        inv[p] = j
+                    rows = fetched[[inv[p] for p in range(len(part))]]
+                out = wgl3.unpack_np(rows)
+                for i, one in zip(part, wgl3.assemble_batch_results(
+                        out, part_steps, cfg)):
+                    results[i] = one
+
+            # In-flight launch window (plan/dispatch.py LaunchPipeline,
+            # depth = limits().pod_pipeline_depth): bucket N+1's host
+            # stack + H2D staging overlaps bucket N's device execute,
+            # and undrained device results stay bounded — the corpus-
+            # level form of the long sweep's double buffering.
+            from ..plan import LaunchPipeline
+
+            pipe = LaunchPipeline(resolve=_fetch_launch)
             for r in sorted(buckets):
                 idxs = buckets[r]
                 # Launch-size cap: stacked bytes for one launch stay
@@ -300,37 +382,31 @@ def check_corpus(encs: Sequence, model=None, f_cap: int = 256
                     lctx = obs.ledger.plan_context(plan_obj)
                     lctx.update(batch_real=len(part), batch_padded=b,
                                 steps_real=real, steps_padded=b * r)
-                    if lctx.get("n_shards", 1) > 1:
+                    perm = None
+                    n_shards = lctx.get("n_shards", 1)
+                    if n_shards > 1:
+                        if lim.shard_bucket_mode:
+                            # Shard-aware packing: permute the padded
+                            # batch so contiguous per-shard blocks carry
+                            # balanced REAL steps (pads interleave
+                            # instead of stacking on the tail shards).
+                            perm = lpt_shard_order(
+                                [s.n_steps for s in padded], n_shards)
+                            if perm == list(range(len(padded))):
+                                perm = None
+                            else:
+                                padded = [padded[j] for j in perm]
+                                lctx["shard_packed"] = True
                         lctx["shard_real"] = obs.ledger.shard_real_steps(
-                            [s.n_steps for s in padded],
-                            lctx["n_shards"])
+                            [s.n_steps for s in padded], n_shards)
                     with obs.ledger.launch_context(**lctx):
                         arrays = wgl3.stack_steps3(padded, r)
                         dev = run(*arrays)
-                    pending.append((part, part_steps, dev, lctx))
+                    pipe.submit((part, part_steps, dev, lctx, perm))
                     stats.record_launch(real, b, r)
                     kernels.add(plan_obj.label)
-            for part, part_steps, dev, lctx in pending:
-                t0f = time.monotonic_ns()
-                try:
-                    fetched = np.asarray(dev)
-                except Exception as e:
-                    # The drain fetch is where a dead backend finally
-                    # surfaces for async launches — tell the supervisor
-                    # before propagating.
-                    supervisor.note_failure(f"{type(e).__name__}: {e}",
-                                            source="sched.dispatch")
-                    raise
-                # The drain fetch is where async device time surfaces
-                # on the host — ledger it under the launch's context so
-                # padding/straggler decomposition sees the real wait.
-                obs.get_ledger().record_fetch(t0f, time.monotonic_ns(),
-                                              ctx=lctx)
-                out = wgl3.unpack_np(fetched[:len(part)])
-                for i, one in zip(part, wgl3.assemble_batch_results(
-                        out, part_steps, cfg)):
-                    results[i] = one
-            if pending:
+            pipe.drain()
+            if pipe.dispatched:
                 supervisor.note_ok(source="sched.dispatch")
 
         if general_idx:

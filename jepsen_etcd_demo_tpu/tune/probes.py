@@ -66,6 +66,7 @@ SEED_PALLAS = 0x9A11
 SEED_STREAM = 0x57E4
 SEED_DEDUP = 0xDED0
 SEED_ELLE = 0xE17E
+SEED_POD = 0x90D5
 
 # Per-knob limit pins applied UNDER the candidate override while probing
 # (e.g. the density threshold only matters once the sparse engine is
@@ -516,6 +517,46 @@ class ElleProbe:
             self.ctx.repeats)
 
 
+class PodProbe:
+    """Pod-scaling knobs (ISSUE 17) on a fixed ragged corpus through
+    the mesh-sharded batch lane: `encode_mode` trades host encode + big
+    packed-table H2D against the on-device expansion; `shard_bucket_mode`
+    toggles the LPT shard packing; `pod_pipeline_depth` sets how many
+    launches the dispatch window keeps in flight. All three only earn
+    their keep on real multi-device meshes — measuring HERE (the current
+    platform's mesh, virtual or not) is the point, exactly like the
+    pipeline group."""
+
+    knobs = ("encode_mode", "pod_pipeline_depth", "shard_bucket_mode")
+
+    def __init__(self, ctx: ProbeContext):
+        import jax
+
+        from ..ops.encode import encode_register_history
+        from ..utils.fuzz import gen_register_history
+
+        if jax.device_count() < 2:
+            raise ProbeUnavailable(
+                "pod probe needs a multi-device mesh (the knobs are "
+                "no-ops on one device)")
+        self.ctx = ctx
+        rng = random.Random(SEED_POD)
+        n_hist = ctx.n(128, 16)
+        hi = ctx.n(240, 48)
+        self.encs = [encode_register_history(
+            gen_register_history(rng, n_ops=rng.randrange(10, hi),
+                                 n_procs=8, p_info=0.002), k_slots=32)
+            for _ in range(n_hist)]
+
+    def measure(self, knob: str, overrides: dict[str, int]) -> float:
+        from ..parallel import dense as pdense
+
+        return _with_overrides(
+            overrides,
+            lambda: pdense.check_batch_sharded(self.encs, self.ctx.model),
+            self.ctx.repeats)
+
+
 class ProbeUnavailable(RuntimeError):
     """This probe group cannot run on this backend (recorded as skipped,
     never an error — a CPU tune simply has no pallas lane)."""
@@ -532,4 +573,5 @@ PROBES = {
     "stream": StreamProbe,
     "dedup": DedupProbe,
     "elle": ElleProbe,
+    "pod": PodProbe,
 }

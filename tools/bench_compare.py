@@ -45,6 +45,14 @@ from pathlib import Path
 from typing import Any, Optional
 
 DEFAULT_THRESHOLD_PCT = 10.0
+# Per-lane tighter ratchets (ISSUE 17): lanes named here gate at
+# min(their pct, --threshold-pct) instead of the global default. The
+# pod-scaling work bought scaling_eps_per_chip a big step up; a 5%
+# leash keeps the win from quietly eroding back while leaving the
+# noisier single-host lanes on the 10% default.
+LANE_THRESHOLD_PCT: dict[str, float] = {
+    "scaling_eps_per_chip": 5.0,
+}
 
 # (lane name, path into the record). All higher-is-better.
 LANES: list[tuple[str, tuple]] = [
@@ -322,11 +330,14 @@ def compare(old: dict, new: dict,
                                  "skipped": True})
             continue
         delta = (n - o) / o * 100.0
-        reg = delta < -threshold_pct
-        out["lanes"].append({"lane": lane, "old": round(o, 4),
-                             "new": round(n, 4),
-                             "delta_pct": round(delta, 2),
-                             "regression": reg})
+        lane_thr = min(threshold_pct,
+                       LANE_THRESHOLD_PCT.get(lane, threshold_pct))
+        reg = delta < -lane_thr
+        row = {"lane": lane, "old": round(o, 4), "new": round(n, 4),
+               "delta_pct": round(delta, 2), "regression": reg}
+        if lane_thr != threshold_pct:
+            row["threshold_pct"] = lane_thr
+        out["lanes"].append(row)
         if reg:
             out["regressions"].append(lane)
     # Informational lanes: deltas reported, never gated (a flops move

@@ -14,9 +14,18 @@ Usage:
   python tools/scaling_report.py <file.jsonl> [...]   # explicit files
   python tools/scaling_report.py <run_dir> --json     # machine-readable
   python tools/scaling_report.py <run_dir> --wall 12.5  # known wall secs
+  python tools/scaling_report.py --diff OLD.json NEW.json  # CI gate
 
 With a telemetry.jsonl present in the run dir, the report appends the
 span-tree critical path of the runner/serve path.
+
+``--diff`` compares two ledger-armed scaling records (MULTICHIP_rNN.json
+wrappers, raw records carrying ``scaling.ledger``, or bare attribution
+objects) bucket-by-bucket as SHARES of their own measured wall, and
+exits nonzero when a gated loss bucket (padding, straggler, dispatch
+gap, H2D, encode, compile) regresses beyond the tolerance — the
+round-over-round teeth behind ISSUE 17's "padding+straggler cut 2x"
+acceptance line.
 """
 
 from __future__ import annotations
@@ -102,16 +111,134 @@ def render_report(report: dict, trace_path: Path | None = None) -> str:
     return "\n".join(lines)
 
 
+# Loss buckets --diff gates (shares of wall, LOWER is better). The
+# useful bucket (execute_s) and the outside-window remainder (other_s)
+# are reported but never gated — losses moving INTO useful execute is
+# the goal, not a regression.
+GATED_BUCKETS = ("padding_s", "straggler_s", "dispatch_gap_s",
+                 "h2d_s", "encode_s", "compile_s")
+# A gated bucket regresses when its share of wall grows BOTH by more
+# than the relative tolerance and by more than the absolute slack —
+# the two-sided guard keeps near-zero buckets (0.1% -> 0.3%) from
+# tripping CI on noise while still catching real structural slides.
+DIFF_TOLERANCE_PCT = 25.0
+DIFF_ABS_SLACK = 0.02
+
+
+def extract_attribution(obj: dict) -> dict | None:
+    """The windowed attribution out of any record shape we ship:
+    a bare attribution (has "buckets"), a bench/MULTICHIP record
+    (scaling.ledger), or the driver wrapper around one (parsed...)."""
+    if not isinstance(obj, dict):
+        return None
+    if isinstance(obj.get("buckets"), dict):
+        return obj
+    if isinstance(obj.get("parsed"), dict):
+        return extract_attribution(obj["parsed"])
+    scal = obj.get("scaling")
+    if isinstance(scal, dict):
+        return extract_attribution(scal.get("ledger") or {})
+    return None
+
+
+def diff_records(old: dict, new: dict,
+                 tolerance_pct: float = DIFF_TOLERANCE_PCT,
+                 abs_slack: float = DIFF_ABS_SLACK) -> dict:
+    """Bucket-by-bucket diff of two scaling attributions as shares of
+    their own wall. Returns {"comparable", "reason", "buckets":
+    [{bucket, old_share, new_share, delta_pp, gated, regression}],
+    "regressions": [names]}."""
+    out: dict = {"comparable": True, "reason": None, "buckets": [],
+                 "regressions": [], "tolerance_pct": tolerance_pct}
+    atts = []
+    for name, obj in (("old", old), ("new", new)):
+        att = extract_attribution(obj)
+        if att is None or not att.get("wall_s"):
+            out["comparable"] = False
+            out["reason"] = (f"{name} record carries no ledger-armed "
+                             f"scaling attribution (no buckets/wall_s)")
+            return out
+        atts.append(att)
+    (o_att, n_att) = atts
+    o_wall, n_wall = float(o_att["wall_s"]), float(n_att["wall_s"])
+    names = sorted(set(o_att["buckets"]) | set(n_att["buckets"]))
+    for bucket in names:
+        o_share = float(o_att["buckets"].get(bucket, 0.0)) / o_wall
+        n_share = float(n_att["buckets"].get(bucket, 0.0)) / n_wall
+        gated = bucket in GATED_BUCKETS
+        reg = bool(
+            gated
+            and n_share > o_share * (1.0 + tolerance_pct / 100.0)
+            and n_share > o_share + abs_slack)
+        out["buckets"].append({
+            "bucket": bucket, "old_share": round(o_share, 4),
+            "new_share": round(n_share, 4),
+            "delta_pp": round((n_share - o_share) * 100.0, 2),
+            "gated": gated, "regression": reg})
+        if reg:
+            out["regressions"].append(bucket)
+    return out
+
+
+def render_diff(res: dict, old_name: str, new_name: str) -> str:
+    lines = [f"scaling diff — loss-bucket shares of wall "
+             f"({old_name} -> {new_name})"]
+    for row in res["buckets"]:
+        flag = ""
+        if row["regression"]:
+            flag = "  << REGRESSION"
+        elif not row["gated"]:
+            flag = "  (ungated)"
+        lines.append(
+            f"  {row['bucket']:<16} {row['old_share']:>7.1%} -> "
+            f"{row['new_share']:>7.1%}  {row['delta_pp']:+6.2f}pp{flag}")
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("paths", nargs="+",
+    ap.add_argument("paths", nargs="*",
                     help="run dir (merges ledger-*.jsonl) or files")
     ap.add_argument("--wall", type=float, default=None,
                     help="measured wall seconds (defaults to the "
                          "instrumented window)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the report as JSON")
+    ap.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"),
+                    default=None,
+                    help="diff two ledger-armed scaling records "
+                         "bucket-by-bucket; exit 1 when a gated loss "
+                         "bucket regresses beyond the tolerance")
+    ap.add_argument("--tolerance-pct", type=float,
+                    default=DIFF_TOLERANCE_PCT,
+                    help="[--diff] relative share-growth tolerance per "
+                         f"gated bucket (default {DIFF_TOLERANCE_PCT:g})")
     ns = ap.parse_args(argv)
+    if ns.diff is not None:
+        try:
+            old = json.loads(Path(ns.diff[0]).read_text())
+            new = json.loads(Path(ns.diff[1]).read_text())
+        except (OSError, ValueError) as e:
+            print(f"scaling_report --diff: {e}", file=sys.stderr)
+            return 2
+        res = diff_records(old, new, tolerance_pct=ns.tolerance_pct)
+        if ns.as_json:
+            print(json.dumps(res, indent=2))
+        elif not res["comparable"]:
+            print(f"not comparable: {res['reason']}")
+        else:
+            print(render_diff(res, ns.diff[0], ns.diff[1]))
+        if not res["comparable"]:
+            return 0
+        if res["regressions"]:
+            print(f"FAIL: gated bucket(s) regressed beyond "
+                  f"{ns.tolerance_pct:g}%: "
+                  f"{', '.join(res['regressions'])}", file=sys.stderr)
+            return 1
+        print("ok: no gated bucket regressed")
+        return 0
+    if not ns.paths:
+        ap.error("paths required (or use --diff OLD NEW)")
     paths = collect_paths(ns.paths)
     if not paths:
         print("scaling_report: no ledger-*.jsonl found", file=sys.stderr)
