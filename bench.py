@@ -896,6 +896,317 @@ def bench_serve(model, n_hist: int = 96, clients: int = 8,
     }
 
 
+def _fleet_quantile(lats: list[float], q: float) -> float:
+    if not lats:
+        return 0.0
+    s = sorted(lats)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def fleet_zero_lane() -> dict:
+    """The degraded-path fleet record: every contract key present as
+    zeros (tools/bench_compare.py check_fleet_record — the same
+    zeros-never-absent rule as the ledger object)."""
+    arm = {"wall_s": 0.0, "agg_eps": 0.0, "agg_rps": 0.0,
+           "p50_s": 0.0, "p99_s": 0.0, "warm_p99_s": 0.0,
+           "hit_rate": 0.0, "lookups": 0}
+    return {
+        "replicas": 0, "histories": 0, "events": 0,
+        "affine": dict(arm), "random": dict(arm),
+        "hit_rate_delta": 0.0, "agg_eps_ratio": 0.0,
+        "knee_rate_rps": 0.0, "agg_eps": 0.0, "p99_s": 0.0,
+        "knee_rungs": [], "spillover": 0,
+        "replica_fill": {}, "replica_fill_min": 0.0,
+        "invalid": 0, "verdicts_identical": False,
+    }
+
+
+def bench_fleet(model, n_hist: int = 48, replicas: int = 2,
+                ops_range=(8, 200), n_procs: int = 4,
+                seed: int = 0xF1EE7, invalid_every: int = 7,
+                max_knee_rungs: int = 4, assert_win: bool = True,
+                request_timeout_s: float = 300.0) -> dict:
+    """Fleet-scale serving lane (ISSUE 18 tentpole): N subprocess
+    `serve --check` replicas behind the in-process shape-affine router
+    (serve/router.py), driven OPEN-LOOP — Poisson arrivals at a fixed
+    offered rate, the way a production inference fleet is loaded, not
+    the closed-loop K-clients of the serve lane (closed loops
+    self-throttle at the knee; open loops expose it).
+
+    Two arms on identical corpora, schedules, and fresh fleets:
+    *random* routing (the shape-blind control, fleet_spillover_mode=2)
+    vs *affine* rendezvous routing. Each arm runs the same Poisson
+    schedule twice — a cold pass that pays the compiles its routing
+    policy induces, then a warm pass — so the arm aggregate carries the
+    structural difference: random compiles ~every bucket on ~every
+    replica, affine compiles each bucket once fleet-wide. Replicas run
+    with the persistent XLA cache DISABLED (JEPSEN_TPU_NO_COMPILE_CACHE)
+    so neither arm can launder its compile bill through the other's
+    disk artifacts, and on the CPU backend — two subprocesses cannot
+    share one TPU, and the lane measures routing economics (compile
+    amortization, LRU locality, spillover), not chip throughput, which
+    serve_agg_eps already gates.
+
+    After the arms, an arrival-rate ladder walks the warm affine fleet
+    to the latency knee: offered rate doubles per rung until p99
+    inflects (> 4x the first rung's) or completions fall behind offered
+    (< 0.7x), and the LAST GOOD rung's aggregate events/s and p99 are
+    the gated `fleet_agg_eps` / `fleet_p99_s` headline — serving
+    capacity at acceptable latency, not peak-burst throughput.
+
+    Every verdict from every arm and pass is asserted bit-identical to
+    the post-hoc analyze route; with `assert_win`, affine must beat
+    random on whole-arm aggregate events/s AND warm kernel-cache hit
+    rate (strictly)."""
+    import threading
+    import urllib.request
+
+    from http.server import ThreadingHTTPServer
+
+    from jepsen_etcd_demo_tpu.ops import wgl3_pallas
+    from jepsen_etcd_demo_tpu.ops.encode import encode_register_history
+    from jepsen_etcd_demo_tpu.serve.fleet import (FleetSupervisor,
+                                                  make_fleet_handler)
+    from jepsen_etcd_demo_tpu.serve.router import RANDOM, FleetRouter
+    from jepsen_etcd_demo_tpu.utils.fuzz import (gen_register_history,
+                                                 mutate_history)
+
+    rng = random.Random(seed)
+    lo, hi = ops_range
+    hists, encs = [], []
+    for i in range(n_hist):
+        h = gen_register_history(rng, n_ops=rng.randrange(lo, hi),
+                                 n_procs=n_procs, p_info=0.002)
+        if invalid_every and i % invalid_every == invalid_every - 1:
+            h = mutate_history(rng, h)
+        hists.append(h)
+        encs.append(encode_register_history(h, k_slots=8))
+    events = int(sum(e.n_events for e in encs))
+    bodies = [json.dumps({
+        "tenant": f"tenant-{i % 3}", "model": model.name, "wait": True,
+        "history": [json.loads(op.to_json()) for op in h],
+    }).encode() for i, h in enumerate(hists)]
+
+    posthoc = []
+    for e in encs:
+        outs, _kernel = wgl3_pallas.check_batch_encoded_auto([e], model)
+        posthoc.append(outs[0])
+
+    # One Poisson arrival schedule, reused by every pass of both arms
+    # (same seed -> same offered load; the policy is the only variable).
+    # The base rate is intentionally modest: the arms measure routing
+    # economics under feasible load; the knee ladder finds capacity.
+    arm_rate = max(2.0, n_hist / 12.0)
+    sched_rng = random.Random(seed ^ 0xA221)
+    t_arr, arm_schedule = 0.0, []
+    for _ in range(n_hist):
+        t_arr += sched_rng.expovariate(arm_rate)
+        arm_schedule.append(t_arr)
+
+    child_env = {
+        "JAX_PLATFORMS": "cpu",
+        "JEPSEN_TPU_NO_WARMUP": "1",
+        "JEPSEN_TPU_NO_COMPILE_CACHE": "1",
+        "JEPSEN_TPU_TELEMETRY": "0",
+    }
+
+    def open_loop_pass(base: str, schedule: list[float]
+                       ) -> tuple[float, list, list]:
+        """Dispatch every body at its absolute arrival offset; block a
+        worker thread per request on the verdict. Returns (wall to last
+        verdict, verdicts, latencies)."""
+        results: list = [None] * len(bodies)
+        lats: list = [0.0] * len(bodies)
+        errors: list = []
+
+        def worker(i: int):
+            t_req = time.perf_counter()
+            try:
+                req = urllib.request.Request(
+                    base + "/check", data=bodies[i],
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(
+                        req, timeout=request_timeout_s) as r:
+                    results[i] = json.loads(r.read().decode())
+                lats[i] = time.perf_counter() - t_req
+            except Exception as e:
+                errors.append((i, e))
+
+        threads = []
+        t0 = time.perf_counter()
+        for i, due in enumerate(schedule):
+            delay = t0 + due - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(target=worker, args=(i,))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(request_timeout_s)
+        wall = time.perf_counter() - t0
+        if errors:
+            i, e = errors[0]
+            raise RuntimeError(
+                f"fleet open-loop request {i} failed: "
+                f"{type(e).__name__}: {e}")
+        return wall, results, lats
+
+    def fleet_up(mode: int):
+        router = FleetRouter(spillover_mode=mode, salt=0,
+                             poll_interval_s=0.5)
+        sup = FleetSupervisor(_FLEET_STORE.name, n=replicas,
+                              router=router, max_inflight=n_hist,
+                              env=child_env)
+        sup.start()
+        httpd = ThreadingHTTPServer(
+            ("127.0.0.1", 0),
+            make_fleet_handler(_FLEET_STORE.name, router, sup))
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        return sup, router, httpd, base
+
+    def fleet_down(sup, httpd):
+        httpd.shutdown()
+        httpd.server_close()
+        sup.close()
+
+    def replica_cache_totals(base: str) -> tuple[int, int]:
+        with urllib.request.urlopen(base + "/serve/stats",
+                                    timeout=30) as r:
+            st = json.loads(r.read().decode())
+        hits = misses = 0
+        for rep in st["replicas"].values():
+            kc = rep["scheduler"]["kernel_cache"]
+            hits += kc["hits"]
+            misses += kc["misses"]
+        return hits, misses
+
+    import tempfile
+    _FLEET_STORE = tempfile.TemporaryDirectory(prefix="bench-fleet-")
+
+    def run_arm(mode: int):
+        """Fresh fleet, cold LRUs; the same schedule twice. The arm
+        aggregate (both passes) carries the policy's compile bill; the
+        warm pass isolates steady-state latency."""
+        sup, router, httpd, base = fleet_up(mode)
+        try:
+            wall_a, res_a, lats_a = open_loop_pass(base, arm_schedule)
+            wall_b, res_b, lats_b = open_loop_pass(base, arm_schedule)
+            hits, misses = replica_cache_totals(base)
+            with urllib.request.urlopen(base + "/fleet/stats",
+                                        timeout=30) as r:
+                fstats = json.loads(r.read().decode())
+            lookups = hits + misses
+            wall = wall_a + wall_b
+            arm = {
+                "wall_s": round(wall, 4),
+                "agg_eps": round(2 * events / wall, 1),
+                "agg_rps": round(2 * n_hist / wall, 2),
+                "p50_s": round(_fleet_quantile(lats_a + lats_b, 0.50), 4),
+                "p99_s": round(_fleet_quantile(lats_a + lats_b, 0.99), 4),
+                "warm_p99_s": round(_fleet_quantile(lats_b, 0.99), 4),
+                "hit_rate": round(hits / lookups, 4) if lookups else 0.0,
+                "lookups": lookups,
+            }
+            return arm, (res_a, res_b), fstats, (sup, router, httpd, base)
+        except BaseException:
+            fleet_down(sup, httpd)
+            raise
+
+    # --- control arm: shape-blind random routing, then torn down ----
+    rand_arm, rand_results, _rand_fstats, handles = run_arm(RANDOM)
+    fleet_down(handles[0], handles[2])
+
+    # --- affine arm: kept alive (warm) for the knee ladder -----------
+    aff_arm, aff_results, aff_fstats, handles = run_arm(0)
+    sup, router, httpd, base = handles
+
+    # Verdict parity: every pass of every arm vs the analyze route.
+    for arm_name, passes in (("random", rand_results),
+                             ("affine", aff_results)):
+        for res in passes:
+            for i, (srv, post) in enumerate(zip(res, posthoc)):
+                assert srv["valid"] == post["valid"] \
+                    and srv["dead_step"] == int(post["dead_step"]), \
+                    (f"fleet {arm_name} verdict diverged from analyze "
+                     f"at history {i}: {srv['valid']}/{srv['dead_step']}"
+                     f" vs {post['valid']}/{int(post['dead_step'])}")
+
+    # --- open-loop knee ladder on the warm affine fleet --------------
+    knee_rungs = []
+    try:
+        base_rate = max(arm_rate, 2 * n_hist / max(aff_arm["wall_s"], 1e-6))
+        rate = base_rate / 2
+        first_p99 = None
+        for _ in range(max_knee_rungs):
+            k_rng = random.Random(seed ^ int(rate * 1000))
+            t_arr, schedule = 0.0, []
+            for _ in range(n_hist):
+                t_arr += k_rng.expovariate(rate)
+                schedule.append(t_arr)
+            wall, res, lats = open_loop_pass(base, schedule)
+            p99 = _fleet_quantile(lats, 0.99)
+            rung = {"offered_rps": round(rate, 2),
+                    "agg_rps": round(n_hist / wall, 2),
+                    "agg_eps": round(events / wall, 1),
+                    "p99_s": round(p99, 4)}
+            knee_rungs.append(rung)
+            if first_p99 is None:
+                first_p99 = max(p99, 1e-4)
+            elif p99 > 4 * first_p99 \
+                    or rung["agg_rps"] < 0.7 * rate:
+                break   # past the knee — the previous rung is it
+            rate *= 2
+    finally:
+        fleet_down(sup, httpd)
+        _FLEET_STORE.cleanup()
+
+    # The knee = the last rung still inside the latency/completion
+    # envelope (the final entry may be the one that broke it).
+    good = [r for r in knee_rungs
+            if r["p99_s"] <= 4 * max(knee_rungs[0]["p99_s"], 1e-4)
+            and r["agg_rps"] >= 0.7 * r["offered_rps"]]
+    knee = good[-1] if good else knee_rungs[0]
+
+    fills = {r["id"]: r["routed"] + r["spilled_in"]
+             for r in aff_fstats["replicas"]}
+    total_fill = sum(fills.values()) or 1
+    spillover = int(aff_fstats["fleet"]["spillover"])
+
+    if assert_win:
+        assert aff_arm["agg_eps"] > rand_arm["agg_eps"], \
+            (f"fleet acceptance: affine aggregate {aff_arm['agg_eps']} "
+             f"ev/s does not beat random {rand_arm['agg_eps']} ev/s")
+        assert aff_arm["hit_rate"] > rand_arm["hit_rate"], \
+            (f"fleet acceptance: affine warm hit rate "
+             f"{aff_arm['hit_rate']} not strictly above random "
+             f"{rand_arm['hit_rate']}")
+
+    return {
+        "replicas": replicas,
+        "histories": n_hist,
+        "events": events,
+        "affine": aff_arm,
+        "random": rand_arm,
+        "hit_rate_delta": round(
+            aff_arm["hit_rate"] - rand_arm["hit_rate"], 4),
+        "agg_eps_ratio": round(
+            aff_arm["agg_eps"] / rand_arm["agg_eps"], 2)
+        if rand_arm["agg_eps"] else 0.0,
+        "knee_rate_rps": knee["offered_rps"],
+        "agg_eps": knee["agg_eps"],
+        "p99_s": knee["p99_s"],
+        "knee_rungs": knee_rungs,
+        "spillover": spillover,
+        "replica_fill": fills,
+        "replica_fill_min": round(
+            min(fills.values()) / total_fill, 4) if fills else 0.0,
+        "invalid": sum(1 for r in posthoc if r["valid"] is not True),
+        "verdicts_identical": True,
+    }
+
+
 def bench_campaign(model, n_specs: int = 48, seed: int = 0xCA3,
                    shrink_ops: int = 140) -> dict:
     """Scenario-factory lane (ISSUE 15 tentpole), three measurements:
@@ -1613,6 +1924,7 @@ def main():
                 "sweep": obs.sweep_stats(None),
                 "elle": obs.elle_stats(None),
                 "serve": obs.serve_stats(None),
+                "fleet": obs.fleet_stats(None),
                 "campaign": obs.campaign_stats(None),
                 "ledger": obs.ledger_stats(None),
                 # Which tuning profile the run INTENDED to use (ISSUE 4:
@@ -1695,6 +2007,13 @@ def main():
             # daemon vs the serial baseline, verdicts certified
             # bit-identical to the analyze route; acceptance >= 3x.
             serve_lane = bench_serve(model, min_speedup=3.0)
+            # Fleet-scale serving lane (ISSUE 18): open-loop Poisson
+            # arrivals against N subprocess replicas behind the shape-
+            # affine router; affine must beat shape-blind random on
+            # aggregate events/s AND warm cache hit rate, p99 reported
+            # at the measured latency knee, verdicts certified
+            # bit-identical to the analyze route.
+            fleet_lane = bench_fleet(model)
             # Scenario-factory lane (ISSUE 15): campaign specs/s end to
             # end, batched-vs-sequential ddmin shrink checks/s, and the
             # banked-corpus replay wall.
@@ -1725,6 +2044,7 @@ def main():
             "sweep": obs.sweep_stats(cap.metrics),
             "elle": obs.elle_stats(cap.metrics),
             "serve": obs.serve_stats(cap.metrics),
+            "fleet": obs.fleet_stats(cap.metrics),
             "campaign": obs.campaign_stats(cap.metrics),
             "ledger": obs.ledger_stats(cap.metrics),
             "profile": _profile_record(),
@@ -1768,6 +2088,7 @@ def main():
         "streaming": stream_lane,
         "elle": elle_lane,
         "serve": serve_lane,
+        "fleet": fleet_lane,
         "campaign": campaign_lane,
     }
     if "roofline" in corpus:
@@ -1811,6 +2132,11 @@ def main():
         # zeros permitted, never absent (the degraded records above
         # carry the all-zero shape).
         "serve": obs.serve_stats(cap.metrics),
+        # Fleet-router accounting over the same capture (ISSUE 18):
+        # routed/spillover/error/reject counters and replica occupancy
+        # gauges — zeros permitted, never absent; detail.fleet carries
+        # the measured open-loop lane.
+        "fleet": obs.fleet_stats(cap.metrics),
         # Scenario-factory accounting over the same capture (ISSUE 15):
         # spec/falsification/shrink/bank counters — zeros permitted,
         # never absent.

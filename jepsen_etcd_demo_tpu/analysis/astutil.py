@@ -27,12 +27,21 @@ CACHE_NAME_RE = re.compile(r"cache", re.I)
 
 
 def parse_module(text: str, filename: str = "<lint>") -> ast.Module:
-    """Parse + annotate every node with ``.jt_parent`` (None at root)."""
+    """Parse + annotate every node with ``.jt_parent`` (None at root).
+
+    The annotation pass visits every node in ``ast.walk`` (BFS) order
+    anyway, so it doubles as the flattening pass: the sequence is
+    stored as the tree's ``walk_cached`` entry and every later
+    full-module walk (ImportMap, ``ModuleSource.walk_nodes``, rules)
+    reads the list instead of re-traversing."""
     tree = ast.parse(text, filename=filename)
     tree.jt_parent = None  # type: ignore[attr-defined]
-    for node in ast.walk(tree):
+    nodes: list[ast.AST] = [tree]
+    for node in nodes:     # grows while iterating — exactly BFS order
         for child in ast.iter_child_nodes(node):
             child.jt_parent = node  # type: ignore[attr-defined]
+            nodes.append(child)
+    tree._jt_walk = tuple(nodes)  # type: ignore[attr-defined]
     return tree
 
 
@@ -71,7 +80,7 @@ class ImportMap:
 
     def __init__(self, tree: ast.Module):
         self.names: dict[str, str] = {}
-        for node in ast.walk(tree):
+        for node in walk_cached(tree):
             if isinstance(node, ast.Import):
                 for a in node.names:
                     self.names[a.asname or a.name.split(".")[0]] = a.name
@@ -133,18 +142,47 @@ def in_loop(node: ast.AST) -> bool:
     return False
 
 
-def walk_same_scope(node: ast.AST) -> Iterator[ast.AST]:
+def walk_cached(node: ast.AST) -> tuple:
+    """``ast.walk`` flattened once and memoized on the node. Lint trees
+    are immutable after ``parse_module``, yet every rule re-walks the
+    same module/function subtrees — the repeated generator traversal is
+    the single hottest path in the strict-lint budget. The cache rides
+    the node itself (like ``jt_parent``) so its lifetime matches the
+    tree's and ``ast.iter_fields`` never sees it."""
+    cached = getattr(node, "_jt_walk", None)
+    if cached is None:
+        cached = tuple(ast.walk(node))
+        try:
+            node._jt_walk = cached  # type: ignore[attr-defined]
+        except AttributeError:
+            pass
+    return cached
+
+
+def walk_same_scope(node: ast.AST) -> tuple:
     """Descendants of `node` WITHOUT crossing into nested function /
     lambda bodies: a `with lock:` inside a deferred callback defined
     here runs later, under different held state, and must not count as
-    nested under this scope's locks (same boundary in_loop respects)."""
-    stack = list(ast.iter_child_nodes(node))
-    while stack:
-        n = stack.pop()
-        yield n
-        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                              ast.Lambda)):
-            stack.extend(ast.iter_child_nodes(n))
+    nested under this scope's locks (same boundary in_loop respects).
+
+    Memoized like ``walk_cached`` — the donation/flow/sync rules each
+    re-scan the same function and with-block scopes."""
+    cached = getattr(node, "_jt_walk_ss", None)
+    if cached is None:
+        out: list[ast.AST] = []
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            n = stack.pop()
+            out.append(n)
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(n))
+        cached = tuple(out)
+        try:
+            node._jt_walk_ss = cached  # type: ignore[attr-defined]
+        except AttributeError:
+            pass
+    return cached
 
 
 def ancestors_same_scope(node: ast.AST) -> Iterator[ast.AST]:

@@ -81,7 +81,8 @@ class ModuleSource:
 
     def walk_nodes(self) -> list:
         if self._walked is None:
-            self._walked = list(ast.walk(self.tree))
+            from .astutil import walk_cached
+            self._walked = walk_cached(self.tree)
         return self._walked
 
     @classmethod

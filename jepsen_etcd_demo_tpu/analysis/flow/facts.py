@@ -38,7 +38,7 @@ import re
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..astutil import dotted
+from ..astutil import dotted, walk_cached
 from ..core import ModuleSource
 from .index import FlowIndex
 
@@ -425,7 +425,7 @@ def _extract_carries(facts: FlowFacts, mod: ModuleSource) -> None:
     for fn in mod.walk_nodes():
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
-        for ret in ast.walk(fn):
+        for ret in walk_cached(fn):
             if isinstance(ret, ast.Return) and isinstance(ret.value,
                                                           ast.Call):
                 callee = dotted(ret.value.func)
@@ -593,12 +593,12 @@ def _extract_snapshot_reads(facts: FlowFacts, mod: ModuleSource) -> None:
             continue
         has_snapshot = any(
             isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
-            and n.func.attr == "snapshot" for n in ast.walk(fn))
+            and n.func.attr == "snapshot" for n in walk_cached(fn))
         if not has_snapshot:
             continue
         nested = {n.name for n in walk_same_scope(fn)
                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
-        for call in ast.walk(fn):
+        for call in walk_cached(fn):
             if not (isinstance(call, ast.Call) and call.args):
                 continue
             is_get = (isinstance(call.func, ast.Attribute)

@@ -72,7 +72,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Iterator, Optional
 
-from ..astutil import ancestors_same_scope, dotted
+from ..astutil import ancestors_same_scope, dotted, walk_cached
 from ..core import ModuleSource, PACKAGE_NAME
 from .facts import _bind_line, _const_str, _stmt_at
 from .index import FlowIndex
@@ -82,7 +82,11 @@ from .index import FlowIndex
 # fixture mini-projects) and "" (top-level modules) are always in.
 # "campaign" joined in ISSUE 15: the scenario-factory executor spawns
 # worker pools and in-process cluster serve threads — JTL505's
-# join-on-shutdown discipline applies to all of them.
+# join-on-shutdown discipline applies to all of them. The ISSUE 18
+# fleet modules (serve/router.py, serve/fleet.py — the router's
+# health-poller thread and both classes' membership locks) ride the
+# existing "serve" scope; their locks/threads land in contracts.json's
+# sync section like every other scoped module.
 SYNC_SCOPES = ("serve", "stream", "sched", "runner", "web", "obs", "db",
                "clients", "control", "campaign")
 
@@ -515,7 +519,7 @@ class SyncModel:
                   if meth.name == "__init__"
                   for a in meth.args.args + meth.args.kwonlyargs}
         for meth in ci.methods.values():
-            for st in ast.walk(meth):
+            for st in walk_cached(meth):
                 if isinstance(st, ast.Assign):
                     targets = st.targets
                 elif isinstance(st, ast.AnnAssign) \
@@ -579,13 +583,13 @@ class SyncModel:
             # (`sess = ServeSession(...); self._sessions[sess.id] =
             # sess` — the SessionManager idiom).
             meth_locals: dict[str, str] = {}
-            for st in ast.walk(meth):
+            for st in walk_cached(meth):
                 if isinstance(st, ast.Assign) and len(st.targets) == 1 \
                         and isinstance(st.targets[0], ast.Name):
                     cls = self._value_class(mod, st.value)
                     if cls is not None:
                         meth_locals[st.targets[0].id] = cls
-            for st in ast.walk(meth):
+            for st in walk_cached(meth):
                 if isinstance(st, ast.Assign):
                     for t in st.targets:
                         if isinstance(t, ast.Subscript):

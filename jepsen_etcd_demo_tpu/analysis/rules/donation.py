@@ -30,7 +30,7 @@ import ast
 from typing import Iterator, Optional
 
 from ..astutil import (ancestors, assigned_names, dotted, statement_of,
-                       walk_same_scope)
+                       walk_cached, walk_same_scope)
 from ..core import KERNEL_SCOPES, ModuleSource, Rule, register
 from ..findings import Finding
 
@@ -66,7 +66,7 @@ class _Resolver:
         # name is ambiguous and resolving the wrong one would flag or
         # clear the wrong call sites.
         self.fn_nodes: list[ast.AST] = [
-            n for n in ast.walk(mod.tree)
+            n for n in mod.walk_nodes()
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
         counts: dict[str, int] = {}
         for n in self.fn_nodes:
@@ -105,7 +105,7 @@ class _Resolver:
         if fn is None or depth > 6:
             return None
         result: Optional[tuple[int, ...]] = None
-        for node in ast.walk(fn):
+        for node in walk_cached(fn):
             if isinstance(node, ast.Return) and node.value is not None:
                 val = node.value
                 if isinstance(val, ast.Subscript):
@@ -123,7 +123,7 @@ class _Resolver:
         base = dotted(sub.value)
         if base is None:
             return None
-        for node in ast.walk(fn):
+        for node in walk_cached(fn):
             if isinstance(node, ast.Assign):
                 for t in node.targets:
                     if isinstance(t, ast.Subscript) \

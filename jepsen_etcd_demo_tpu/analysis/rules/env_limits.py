@@ -43,7 +43,7 @@ class RawLimitEnvRule(Rule):
     def check(self, mod: ModuleSource) -> Iterator[Finding]:
         if mod.relpath.endswith("ops/limits.py"):
             return
-        for node in ast.walk(mod.tree):
+        for node in mod.walk_nodes():
             key, write = None, False
             if isinstance(node, ast.Subscript):
                 base = mod.imports.resolve(node.value)

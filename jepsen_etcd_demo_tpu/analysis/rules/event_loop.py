@@ -23,7 +23,8 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from ..astutil import ancestors, enclosing_function, statement_of
+from ..astutil import (ancestors, enclosing_function, statement_of,
+                       walk_cached)
 from ..core import CONCURRENCY_SCOPES, ModuleSource, Rule, register
 from ..findings import Finding
 
@@ -50,7 +51,7 @@ class LoopBoundPrimitiveRule(Rule):
             "with its lifetime argument")
 
     def check(self, mod: ModuleSource) -> Iterator[Finding]:
-        for node in ast.walk(mod.tree):
+        for node in mod.walk_nodes():
             if not (isinstance(node, ast.Call)
                     and mod.imports.resolve(node.func) in _PRIMITIVES):
                 continue
@@ -97,7 +98,7 @@ class LoopBoundPrimitiveRule(Rule):
         out: set[str] = set()
         if fn is None:
             return out
-        for node in ast.walk(fn):
+        for node in walk_cached(fn):
             if isinstance(node, ast.Assign) \
                     and isinstance(node.value, ast.Call):
                 origin = mod.imports.resolve(node.value.func) or ""

@@ -49,7 +49,7 @@ class HostSyncInLoopRule(Rule):
             "suppress with the justification inline")
 
     def check(self, mod: ModuleSource) -> Iterator[Finding]:
-        for node in ast.walk(mod.tree):
+        for node in mod.walk_nodes():
             if not (isinstance(node, ast.Call) and in_loop(node)):
                 continue
             if isinstance(node.func, ast.Attribute):

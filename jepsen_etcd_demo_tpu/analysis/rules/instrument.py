@@ -48,7 +48,7 @@ class UninstrumentedKernelRule(Rule):
             "outside a capture")
 
     def check(self, mod: ModuleSource) -> Iterator[Finding]:
-        for node in ast.walk(mod.tree):
+        for node in mod.walk_nodes():
             if not (isinstance(node, ast.Call)
                     and mod.imports.is_call_to(node, "jax.jit")):
                 continue
@@ -85,14 +85,14 @@ class UninstrumentedKernelRule(Rule):
         # Resolve factories by name only when the name is UNIQUE in the
         # module: with duplicates (nested `measure`/`build` defs recur)
         # a bare name could consult the wrong def — stay conservative.
-        all_fns = [n for n in ast.walk(mod.tree)
+        all_fns = [n for n in mod.walk_nodes()
                    if isinstance(n, (ast.FunctionDef,
                                      ast.AsyncFunctionDef))]
         counts: dict[str, int] = {}
         for n in all_fns:
             counts[n.name] = counts.get(n.name, 0) + 1
         fns = {n.name: n for n in all_fns if counts[n.name] == 1}
-        for node in ast.walk(mod.tree):
+        for node in mod.walk_nodes():
             if not isinstance(node, ast.Assign):
                 continue
             for tgt in node.targets:

@@ -58,7 +58,7 @@ class JitCacheKeyRule(Rule):
             "static_argnums must be a literal")
 
     def check(self, mod: ModuleSource) -> Iterator[Finding]:
-        for node in ast.walk(mod.tree):
+        for node in mod.walk_nodes():
             if not isinstance(node, ast.Call):
                 continue
             # jax.jit(f)(...) — immediately-invoked jit.
@@ -83,7 +83,7 @@ class JitCacheKeyRule(Rule):
         yield from self._cache_key_stores(mod)
 
     def _cache_key_stores(self, mod: ModuleSource) -> Iterator[Finding]:
-        for node in ast.walk(mod.tree):
+        for node in mod.walk_nodes():
             if not isinstance(node, ast.Assign):
                 continue
             for tgt in node.targets:

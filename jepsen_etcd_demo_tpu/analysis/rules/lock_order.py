@@ -56,7 +56,7 @@ class LockOrderRule(Rule):
     def check(self, mod: ModuleSource) -> Iterator[Finding]:
         edges: dict[tuple[str, str], ast.AST] = {}
         class_locks: dict[tuple[str, str], set[str]] = {}  # (cls,meth)->locks
-        for node in ast.walk(mod.tree):
+        for node in mod.walk_nodes():
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 cls = enclosing_class(node)
                 if cls is not None:
@@ -68,7 +68,7 @@ class LockOrderRule(Rule):
                         for item in w.items
                         for lid in [_lock_id(item.context_expr, mod)]
                         if lid is not None}
-        for node in ast.walk(mod.tree):
+        for node in mod.walk_nodes():
             if not isinstance(node, (ast.With, ast.AsyncWith)):
                 continue
             ids = [(_lock_id(i.context_expr, mod), i.context_expr)

@@ -61,7 +61,7 @@ class ComputedMetricNameRule(Rule):
             "labeled series")
 
     def check(self, mod: ModuleSource) -> Iterator[Finding]:
-        for node in ast.walk(mod.tree):
+        for node in mod.walk_nodes():
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
                     and node.func.attr in _METHODS and node.args):

@@ -152,7 +152,7 @@ class UnlockedSharedStateRule(Rule):
             "(join() the thread before touching its state)")
 
     def check(self, mod: ModuleSource) -> Iterator[Finding]:
-        for node in ast.walk(mod.tree):
+        for node in mod.walk_nodes():
             if isinstance(node, ast.ClassDef):
                 yield from self._check_class(node, mod)
 

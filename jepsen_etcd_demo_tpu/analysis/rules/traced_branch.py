@@ -40,7 +40,7 @@ class TracedBranchRule(Rule):
             "(bool(np.asarray(x))) so the sync is visible and bounded")
 
     def check(self, mod: ModuleSource) -> Iterator[Finding]:
-        for node in ast.walk(mod.tree):
+        for node in mod.walk_nodes():
             if not isinstance(node, (ast.If, ast.While)):
                 continue
             jnp_name = self._jnp_use(node.test, mod)

@@ -313,6 +313,15 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--ready-file", default=None,
                    help="[--check] also write the startup JSON (port, "
                         "url) to this file once bound")
+    s.add_argument("--fleet", action="store_true",
+                   help="[--check] fleet mode: spawn N serve replicas "
+                        "and route requests by (model, step bucket) via "
+                        "rendezvous hashing with health-aware spillover "
+                        "and zero-downtime restarts (serve/fleet.py; "
+                        "doc/serve.md 'Fleet')")
+    s.add_argument("--replicas", type=positive_int, default=None,
+                   help="[--fleet] replica count (default: "
+                        "limits().fleet_replicas)")
 
     wu = sub.add_parser(
         "warmup",
@@ -900,6 +909,17 @@ def cmd_warmup(args) -> int:
 
 
 def cmd_serve(args) -> int:
+    if getattr(args, "fleet", False):
+        # Fleet-scale serving (ISSUE 18): N subprocess replicas behind
+        # the shape-affine rendezvous router, sharing one store root
+        # (one persistent XLA cache + one O_EXCL tuned-profile file).
+        from ..serve.fleet import serve_fleet
+
+        return serve_fleet(
+            args.store, host=args.host, port=args.port,
+            replicas=args.replicas, default_model=args.model,
+            coalesce_ms=args.coalesce_ms, max_batch=args.max_batch,
+            max_inflight=args.max_inflight, ready_file=args.ready_file)
     if getattr(args, "check", False):
         # Checking-as-a-service (serve/, ISSUE 13): the warm pool only
         # pays off across requests if compiles persist, so the daemon
@@ -912,12 +932,12 @@ def cmd_serve(args) -> int:
         # the plan-family corpus BEFORE accepting traffic, so the first
         # request never pays a cold compile. JEPSEN_TPU_NO_WARMUP=1
         # skips; failures are swallowed (warmup is an optimization).
-        startup_warmup(args.store, source="serve")
+        wrec = startup_warmup(args.store, source="serve")
         return serve_check(
             args.store, host=args.host, port=args.port,
             default_model=args.model, coalesce_ms=args.coalesce_ms,
             max_batch=args.max_batch, max_inflight=args.max_inflight,
-            ready_file=args.ready_file)
+            ready_file=args.ready_file, warmup=wrec)
     from ..web.server import serve
     serve(args.store, host=args.host, port=args.port)
     return 0

@@ -219,6 +219,20 @@ LEDGER_GAUGES = ("ledger.step_fill", "ledger.batch_fill")
 # jtflow: metrics preregistered
 SLO_GAUGES = ("serve.slo_p50_s", "serve.slo_p99_s",
               "serve.slo_burn_rate")
+# Fleet router (serve/router.py + serve/fleet.py, ISSUE 18): requests
+# admitted by the shape-affine router, spillover re-routes past an
+# unavailable replica, upstream forward failures, no-replica-available
+# rejections, and completed zero-downtime restarts — pre-registered so
+# every capture's metrics.json carries them (zeros permitted, never
+# absent; fleet_stats() is the bench/web reader).
+# jtflow: metrics preregistered
+FLEET_COUNTERS = ("fleet.requests", "fleet.spillover",
+                  "fleet.replica_errors", "fleet.rejected",
+                  "fleet.restarts")
+# Fleet occupancy: replicas registered with the router and how many of
+# them are currently routable (ready + not degraded/wedged/down).
+# jtflow: metrics preregistered
+FLEET_GAUGES = ("fleet.replicas", "fleet.replicas_ready")
 
 _NULL_TRACER = Tracer(enabled=False)
 _NULL_METRICS = MetricsRegistry(enabled=False)
@@ -245,10 +259,10 @@ class Capture:
             for name in PHASE_COUNTERS + SCHED_COUNTERS + SWEEP_COUNTERS \
                     + COST_COUNTERS + ELLE_COUNTERS + SERVE_COUNTERS \
                     + SYNC_COUNTERS + CAMPAIGN_COUNTERS \
-                    + LEDGER_COUNTERS:
+                    + LEDGER_COUNTERS + FLEET_COUNTERS:
                 self.metrics.counter(name)
             for name in ELLE_GAUGES + SERVE_GAUGES + CAMPAIGN_GAUGES \
-                    + LEDGER_GAUGES + SLO_GAUGES:
+                    + LEDGER_GAUGES + SLO_GAUGES + FLEET_GAUGES:
                 self.metrics.gauge(name)
             self.metrics.histogram(SERVE_HISTOGRAM)
             self.metrics.gauge(PHASE_GAUGE)
@@ -713,6 +727,34 @@ def serve_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
     if h and h.get("p50") is not None:
         out["latency_p50_s"] = round(float(h["p50"]), 6)
         out["latency_p99_s"] = round(float(h.get("p99") or 0.0), 6)
+    return out
+
+
+def fleet_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
+    """The fleet router's bench/web contract fields (serve/router.py,
+    ISSUE 18), from a registry snapshot: routed/spillover/error/reject
+    counters, completed zero-downtime restarts, and the replica
+    occupancy gauges. Zeros when no registry / no router — like every
+    reader here, the contract is "zeros permitted, never absent"."""
+    out = {"requests": 0, "spillover": 0, "replica_errors": 0,
+           "rejected": 0, "restarts": 0, "replicas": 0,
+           "replicas_ready": 0}
+    if metrics is None or not metrics.enabled:
+        return out
+    snap = metrics.snapshot()
+    for key, name in (("requests", "fleet.requests"),
+                      ("spillover", "fleet.spillover"),
+                      ("replica_errors", "fleet.replica_errors"),
+                      ("rejected", "fleet.rejected"),
+                      ("restarts", "fleet.restarts")):
+        rec = snap.get(name)
+        if rec and rec.get("type") == "counter":
+            out[key] = int(rec["value"])
+    for key, name in (("replicas", "fleet.replicas"),
+                      ("replicas_ready", "fleet.replicas_ready")):
+        g = snap.get(name)
+        if g and g.get("last") is not None:
+            out[key] = int(g["last"])
     return out
 
 

@@ -349,6 +349,29 @@ class KernelLimits:
     # admission-control half of the serve daemon's backpressure
     # (supervisor state drives the other half: shed/503).
     serve_max_inflight: int = _f(256, "arch", 1, 4096)
+    # [tunable] Replica count the fleet supervisor (serve/fleet.py)
+    # spawns when `jepsen-tpu serve --fleet` is not given an explicit
+    # --replicas: how many `serve --check` daemons share the traffic
+    # behind the shape-affine router. The right value is a property of
+    # the MACHINE (cores / chips per replica), not the code — more
+    # replicas buy isolation of each shard's kernel LRU at the cost of
+    # per-replica batch fill (doc/serve.md "Fleet").
+    fleet_replicas: int = _f(2, "tunable", 1, 64)
+    # [arch] Router spillover policy when a routed replica is
+    # unavailable (serve/router.py): 0 = affine-with-spillover (walk
+    # the rendezvous preference order past unhealthy/failed replicas —
+    # the default), 1 = strict affinity (no spillover; 503 when the
+    # owning replica cannot take the request), 2 = random routing
+    # (shape affinity off — the bench's comparison arm and the
+    # locality-off escape hatch).
+    fleet_spillover_mode: int = _f(0, "arch", 0, 2)
+    # [arch] Salt mixed into the router's rendezvous hash
+    # (serve/router.py routing_key -> replica scores): changing it
+    # re-deals the shape->replica placement wholesale, which is the
+    # operational lever for breaking a pathological placement (one
+    # replica owning every hot bucket) without restarting the fleet.
+    # Same salt fleet-wide or routing is not a function.
+    fleet_hash_salt: int = _f(0, "arch", 0, 1 << 30)
 
 
 def field_meta() -> dict[str, dict]:

@@ -15,6 +15,12 @@ process-wide warm-kernel pool.
   * daemon.py    — the HTTP surface (`jepsen-tpu serve --check`): the
     ingestion endpoints on top of web/server.py's observability plane,
     store artifacts for every verdict, webhooks
+  * router.py    — the fleet router (ISSUE 18): rendezvous-hashes
+    (model, sched bucket shape) to a replica so each shard's kernel
+    LRU/XLA cache stays hot for its slice, with health-aware spillover
+  * fleet.py     — the fleet supervisor (`jepsen-tpu serve --check
+    --fleet`): spawn/adopt N replicas over one shared store root,
+    zero-downtime warm restarts, the /fleet/stats surface
 
 See doc/serve.md for the API schema and capacity-planning notes.
 """
@@ -23,16 +29,24 @@ from .scheduler import (CAMPAIGN_TENANT, CoalescingScheduler, Rejected,
                         ServeRequest)
 from .sessions import ServeSession, SessionManager, op_from_dict
 from .daemon import ServeDaemon, make_serve_handler, serve_check
+from .router import FleetRouter, rendezvous_order, routing_key
+from .fleet import FleetSupervisor, make_fleet_handler, serve_fleet
 
 __all__ = [
     "CAMPAIGN_TENANT",
     "CoalescingScheduler",
+    "FleetRouter",
+    "FleetSupervisor",
     "Rejected",
     "ServeDaemon",
     "ServeRequest",
     "ServeSession",
     "SessionManager",
+    "make_fleet_handler",
     "make_serve_handler",
     "op_from_dict",
+    "rendezvous_order",
+    "routing_key",
     "serve_check",
+    "serve_fleet",
 ]

@@ -74,11 +74,18 @@ class ServeDaemon:
                  coalesce_ms: Optional[int] = None,
                  max_batch: Optional[int] = None,
                  max_inflight: Optional[int] = None,
-                 write_artifacts: bool = True):
+                 write_artifacts: bool = True,
+                 warmup: Optional[dict] = None):
         from ..obs.sync import maybe_wrap
 
         self.store = Store(store_root)
         self.default_model = default_model
+        # The startup warmup record (sched/warmup.startup_warmup), or
+        # None when skipped — /healthz surfaces it so the fleet router
+        # never routes to a cold replica (ISSUE 18 satellite).
+        self.warmup_record = warmup
+        self.ready = threading.Event()
+        self.ready.set()
         self._write_artifacts = write_artifacts
         self._lock = maybe_wrap(threading.Lock(),
                                 "serve.daemon.ServeDaemon._lock")
@@ -385,6 +392,20 @@ class ServeHandler(web_server.StoreHandler):
                     {"request_id": rid, "pending": True}, status=202)
             if path == "/serve/stats":
                 return self._send_json(d.stats())
+            if path == "/healthz":
+                # The StoreHandler healthz (supervisor snapshot, 503
+                # when wedged) + the replica's serving readiness and
+                # warmup provenance, so a fleet router can distinguish
+                # a cold replica from a merely healthy one.
+                status, body = web_server._healthz()
+                wrec = d.warmup_record
+                body["serve"] = {
+                    "ready": d.ready.is_set(),
+                    "warmed": wrec is not None,
+                    "warmup_launches": (wrec or {}).get("launches", 0),
+                    "warmup_families": (wrec or {}).get("families", []),
+                }
+                return self._send_json(body, status=status)
             if path == "/metrics":
                 text = web_server._metrics_text()
                 extra = d.tenant_metric_lines()
@@ -413,7 +434,8 @@ def serve_check(store_root: str = "store", host: str = "127.0.0.1",
                 coalesce_ms: Optional[int] = None,
                 max_batch: Optional[int] = None,
                 max_inflight: Optional[int] = None,
-                ready_file: Optional[str] = None) -> int:
+                ready_file: Optional[str] = None,
+                warmup: Optional[dict] = None) -> int:
     """Run the checking daemon until interrupted. Binds first and
     prints one JSON line naming the actual port (port 0 = ephemeral —
     the subprocess-integration contract), optionally also written to
@@ -422,13 +444,17 @@ def serve_check(store_root: str = "store", host: str = "127.0.0.1",
     daemon = ServeDaemon(store_root=store_root,
                          default_model=default_model,
                          coalesce_ms=coalesce_ms, max_batch=max_batch,
-                         max_inflight=max_inflight)
+                         max_inflight=max_inflight, warmup=warmup)
     httpd = ThreadingHTTPServer((host, port),
                                 make_serve_handler(store_root, daemon))
     actual_port = httpd.server_address[1]
+    # `warmed` rides the ready line/file: cmd_serve runs the startup
+    # warmup BEFORE serve_check, so ready implies warm (unless the
+    # JEPSEN_TPU_NO_WARMUP kill switch skipped it) — the fleet
+    # supervisor's zero-downtime restart gates on exactly this record.
     ready = {"serving": f"http://{host}:{actual_port}",
              "port": actual_port, "store": str(store_root),
-             "check": True}
+             "check": True, "warmed": warmup is not None}
     print(json.dumps(ready), flush=True)
     if ready_file:
         Path(ready_file).write_text(json.dumps(ready))

@@ -1,0 +1,443 @@
+"""Shape-affine fleet router (ISSUE 18 tentpole).
+
+One serve replica's kernel LRU and XLA cache are the only warm state in
+the world; a fleet of N replicas behind a shape-BLIND balancer compiles
+every (model, bucket) geometry N times and keeps N copies resident.
+This router closes that gap: it hashes the request's *routing key* —
+``(model, sched step bucket)``, i.e. the plan cache key
+(plan/core.py `KernelPlan.cache_key`) minus the mesh, which a replica
+derives locally — to a replica via rendezvous (HRW) hashing, so each
+shard's kernel LRU and persistent XLA cache stay hot for *its* slice of
+shape space and a replica joining/leaving only re-deals 1/N of keys.
+
+Health-aware spillover: the router polls every replica's ``/healthz``
+(plus passive connect-failure signals) and walks the rendezvous
+preference order, skipping replicas per the ``fleet_spillover_mode``
+knob (ops/limits.py):
+
+* 0 — affine with spillover: prefer the key's owner, spill down the
+  HRW order past ``degraded``/``wedged``/``down`` replicas (degraded
+  still serves as last resort — shedding load elsewhere is exactly
+  what a degraded replica wants).
+* 1 — strict affinity: owner or 503 (capacity experiments).
+* 2 — random: ignore the key (the bench's control arm).
+
+Wedged/down replicas are *drained*: no new work, re-admitted the first
+time a ``/healthz`` poll comes back clean. Per-replica state is
+surfaced on ``/fleet/stats`` and the fleet.* counters/gauges
+(obs/__init__.py, pre-registered on every capture) on ``/metrics``.
+
+The router is deliberately thin: stdlib HTTP client, no jax import —
+the step-bucket ladder is 6 lines of integer math mirrored from
+ops/wgl3.step_bucket (drift-pinned by tests/test_fleet.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+from typing import Any, Optional
+
+from ..obs.sync import maybe_wrap
+
+#: Spillover modes (fleet_spillover_mode knob).
+AFFINE, STRICT, RANDOM = 0, 1, 2
+
+#: Replica routing states. READY accepts traffic; COLD is spawned but
+#: not yet past its --ready-file contract; DEGRADED serves only as
+#: spillover of last resort; WEDGED/DOWN are drained until a clean
+#: /healthz poll re-admits them.
+READY, COLD, DEGRADED, WEDGED, DOWN = (
+    "ready", "cold", "degraded", "wedged", "down")
+
+#: States the router will hand new work to, in preference tiers.
+_ROUTABLE = (READY, DEGRADED)
+
+#: Stickiness maps are bounded: verdict ids older than this many
+#: entries fall out (matches the daemon's own results ring order of
+#: magnitude — a poller that lost the race re-submits, checks are pure).
+STICKY_CAP = 4096
+
+
+def step_bucket(n_steps: int, floor: int) -> int:
+    """The {2^k, 1.5*2^k} step-bucket ladder — the same boundary set
+    the corpus scheduler groups launches by. Mirrors ops/wgl3
+    .step_bucket (pure int math; re-stated here so the router never
+    imports jax). Parity is pinned by tests/test_fleet.py."""
+    r = max(1, floor)
+    while r < n_steps:
+        if r + r // 2 >= n_steps:
+            return r + r // 2
+        r *= 2
+    return r
+
+
+def routing_key(model: str, history: list[dict], floor: int) -> str:
+    """``(model, sched bucket shape)`` as a string — the plan cache key
+    minus the mesh. The shape a replica compiles for is set by the step
+    bucket of the history's *completion* count (ops/encode.py builds
+    one return step per ok/fail/info, nemesis ops excluded), so one
+    cheap pass over the raw op dicts lands the request on the replica
+    whose kernel LRU already holds that geometry."""
+    steps = 0
+    for op in history:
+        if not isinstance(op, dict):
+            continue
+        if op.get("process") == "nemesis":
+            continue
+        if op.get("type") in ("ok", "fail", "info"):
+            steps += 1
+    return f"{model}|r{step_bucket(max(1, steps), floor)}"
+
+
+def rendezvous_order(key: str, replica_ids: list[str],
+                     salt: int = 0) -> list[str]:
+    """Replica ids in highest-random-weight order for `key`: each
+    replica scores sha1(salt|key|id); the max owns the key and the
+    descending order IS the spillover preference. Removing a replica
+    re-deals only its own keys; adding one steals 1/N from everyone."""
+    prefix = f"{salt}|{key}|".encode()
+    return sorted(
+        replica_ids,
+        key=lambda rid: hashlib.sha1(prefix + rid.encode()).digest(),
+        reverse=True)
+
+
+class Replica:
+    """One serve --check replica as the router sees it: base URL,
+    routing state, passive/active health evidence, and per-replica
+    traffic counters (surfaced on /fleet/stats)."""
+
+    def __init__(self, rid: str, url: str):
+        self.id = rid
+        self.url = url.rstrip("/")
+        self.state = COLD
+        self.last_error: Optional[str] = None
+        self.last_healthz: dict[str, Any] = {}
+        self.routed = 0          # requests this replica owned
+        self.spilled_in = 0      # requests it served for another owner
+        self.consecutive_failures = 0
+
+
+class FleetRouter:
+    """Rendezvous-hash router over N serve replicas with health-aware
+    spillover and warm hand-off (serve/fleet.py swaps a warmed
+    replacement in atomically before the old replica drains)."""
+
+    def __init__(self, *, salt: Optional[int] = None,
+                 spillover_mode: Optional[int] = None,
+                 bucket_floor: Optional[int] = None,
+                 poll_interval_s: float = 1.0,
+                 request_timeout_s: float = 300.0,
+                 health_timeout_s: float = 5.0):
+        from ..ops.limits import limits
+        lim = limits()
+        self.salt = lim.fleet_hash_salt if salt is None else int(salt)
+        self.mode = (lim.fleet_spillover_mode if spillover_mode is None
+                     else int(spillover_mode))
+        self.bucket_floor = (lim.step_bucket_floor if bucket_floor is None
+                             else int(bucket_floor))
+        self.poll_interval_s = poll_interval_s
+        self.request_timeout_s = request_timeout_s
+        self.health_timeout_s = health_timeout_s
+        self._lock = maybe_wrap(threading.Lock(),
+                                "serve.router.FleetRouter._lock")
+        # jtsan: guarded-by=self._lock
+        self._replicas: dict[str, Replica] = {}
+        # jtsan: guarded-by=self._lock
+        self._verdict_origin: OrderedDict[str, str] = OrderedDict()
+        # jtsan: guarded-by=self._lock
+        self._session_origin: OrderedDict[str, str] = OrderedDict()
+        self._rr = 0             # jtsan: guarded-by=self._lock
+        self._closed = threading.Event()
+        self._poller: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # membership
+
+    def add_replica(self, url: str, rid: Optional[str] = None,
+                    state: str = COLD) -> Replica:
+        rep = Replica(rid or url.rsplit(":", 1)[-1], url)
+        with self._lock:
+            rep.state = state
+            self._replicas[rep.id] = rep
+        return rep
+
+    def remove_replica(self, rid: str) -> Optional[Replica]:
+        """Drop a replica from the hash ring (its keys re-deal to the
+        survivors). The caller owns draining/terminating the process."""
+        with self._lock:
+            rep = self._replicas.pop(rid, None)
+            for sticky in (self._verdict_origin, self._session_origin):
+                stale = [k for k, v in sticky.items() if v == rid]
+                for k in stale:
+                    del sticky[k]
+        return rep
+
+    def swap_replica(self, old_rid: str, url: str,
+                     rid: Optional[str] = None) -> Replica:
+        """Zero-downtime hand-off: admit the (already warm) replacement
+        READY and drop the old replica in one lock hold, so no routing
+        decision ever sees neither."""
+        rep = Replica(rid or url.rsplit(":", 1)[-1], url)
+        with self._lock:
+            rep.state = READY
+            self._replicas[rep.id] = rep
+            self._replicas.pop(old_rid, None)
+            for sticky in (self._verdict_origin, self._session_origin):
+                stale = [k for k, v in sticky.items() if v == old_rid]
+                for k in stale:
+                    del sticky[k]
+        from .. import obs
+        obs.get_metrics().counter("fleet.restarts").add(1)
+        return rep
+
+    def replica_ids(self) -> list[str]:
+        with self._lock:
+            return list(self._replicas)
+
+    # ------------------------------------------------------------------
+    # health
+
+    def poll_health_once(self) -> None:
+        """One active /healthz sweep: state transitions READY/DEGRADED/
+        WEDGED from the body, DOWN on connect failure; a clean poll
+        re-admits a drained replica (the recovery path)."""
+        with self._lock:
+            targets = [(r.id, r.url) for r in self._replicas.values()]
+        for rid, url in targets:
+            state, body, err = self._probe(url)
+            with self._lock:
+                rep = self._replicas.get(rid)
+                if rep is None:
+                    continue
+                rep.last_healthz = body
+                rep.last_error = err
+                if state is not None:
+                    rep.state = state
+                    rep.consecutive_failures = 0
+                else:
+                    rep.consecutive_failures += 1
+                    rep.state = DOWN
+
+    def _probe(self, url: str):
+        """(state, healthz body, error) for one replica; state None on
+        connect failure."""
+        try:
+            req = urllib.request.Request(url + "/healthz")
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.health_timeout_s) as resp:
+                    body = json.loads(resp.read().decode())
+            except urllib.error.HTTPError as e:
+                # 503 wedged still has a JSON body — that's a live,
+                # drained replica, not a dead one.
+                body = json.loads(e.read().decode())
+        except Exception as e:
+            return None, {}, f"{type(e).__name__}: {e}"
+        serve = body.get("serve") or {}
+        if serve and not serve.get("ready", True):
+            return COLD, body, None
+        st = body.get("status", "healthy")
+        if st == "wedged":
+            return WEDGED, body, None
+        if st == "degraded":
+            return DEGRADED, body, None
+        return READY, body, None
+
+    def start(self) -> None:
+        """Start the background health poller (joined by close —
+        JTL505)."""
+        if self._poller is not None:
+            return
+        self._closed.clear()
+        self._poller = threading.Thread(
+            target=self._poll_loop, name="fleet-health-poller",
+            daemon=True)
+        self._poller.start()
+
+    def _poll_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                self.poll_health_once()
+            except Exception:
+                pass   # the poller must outlive any one bad replica
+            self._closed.wait(self.poll_interval_s)
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._poller is not None:
+            self._poller.join(timeout=10)
+            self._poller = None
+
+    # ------------------------------------------------------------------
+    # routing
+
+    def candidates(self, key: str) -> list[Replica]:
+        """Replicas to try for `key`, in order. Affine modes walk the
+        rendezvous order with READY tiers before DEGRADED; random mode
+        round-robins over routable replicas (the bench control arm)."""
+        with self._lock:
+            reps = dict(self._replicas)
+            self._rr += 1
+            rr = self._rr
+        if not reps:
+            return []
+        if self.mode == RANDOM:
+            routable = [reps[i] for i in sorted(reps)
+                        if reps[i].state in _ROUTABLE]
+            if not routable:
+                return []
+            k = rr % len(routable)
+            return routable[k:] + routable[:k]
+        order = [reps[i] for i in rendezvous_order(
+            key, list(reps), self.salt)]
+        ready = [r for r in order if r.state == READY]
+        degraded = [r for r in order if r.state == DEGRADED]
+        if self.mode == STRICT:
+            owner = order[0]
+            return [owner] if owner.state in _ROUTABLE else []
+        return ready + degraded
+
+    def forward(self, method: str, path: str, body: Optional[bytes],
+                key: str) -> tuple[int, bytes, Optional[str]]:
+        """Send one request to the key's owner, spilling down the
+        preference order on connect failure or 5xx/429 (checks are
+        pure — a replica that died mid-request is safe to retry
+        elsewhere, which is what makes kill-mid-load lossless).
+        Returns (status, body bytes, answering replica id or None)."""
+        from .. import obs
+        met = obs.get_metrics()
+        met.counter("fleet.requests").add(1)
+        cands = self.candidates(key)
+        if not cands:
+            met.counter("fleet.rejected").add(1)
+            return 503, json.dumps(
+                {"error": "no routable replica for key",
+                 "key": key, "retry_after_s": 5}).encode(), None
+        last: tuple[int, bytes] = (502, b'{"error": "unreachable"}')
+        for i, rep in enumerate(cands):
+            status, out = self._send(rep, method, path, body)
+            if status is None:                      # connect failure
+                met.counter("fleet.replica_errors").add(1)
+                with self._lock:
+                    rep.consecutive_failures += 1
+                    rep.state = DOWN
+                    rep.last_error = out.decode(errors="replace")
+                continue
+            if status in (429, 503) or status >= 500:
+                # Per-replica admission bound or wedge: another replica
+                # has its own inflight budget — spill before bouncing
+                # the client.
+                met.counter("fleet.replica_errors").add(1)
+                last = (status, out)
+                continue
+            with self._lock:
+                if i == 0 and self.mode != RANDOM:
+                    rep.routed += 1
+                else:
+                    rep.spilled_in += 1
+            if i > 0:
+                met.counter("fleet.spillover").add(1)
+            return status, out, rep.id
+        met.counter("fleet.rejected").add(1)
+        return last[0], last[1], None
+
+    def record_sticky(self, kind: str, sticky_id: str,
+                      rep_id: str) -> None:
+        """Bind a verdict/session id to the replica that answered, so
+        follow-ups (polls, session ops) land on the same process."""
+        with self._lock:
+            smap = (self._verdict_origin if kind == "verdict"
+                    else self._session_origin)
+            smap[sticky_id] = rep_id
+            while len(smap) > STICKY_CAP:
+                smap.popitem(last=False)
+
+    def send_to(self, rid: str, method: str, path: str,
+                body: Optional[bytes] = None):
+        """One request to one named replica (fan-out stats, drains).
+        (status, body); status None on connect failure/unknown id."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+        if rep is None:
+            return None, b'{"error": "unknown replica"}'
+        return self._send(rep, method, path, body)
+
+    def forward_sticky(self, method: str, path: str,
+                       body: Optional[bytes], sticky_map: str,
+                       sticky_id: str) -> tuple[int, bytes]:
+        """Route a follow-up (verdict poll, session op) to the replica
+        that owns the id; 404 when the origin is unknown or gone."""
+        with self._lock:
+            smap = (self._verdict_origin if sticky_map == "verdict"
+                    else self._session_origin)
+            rid = smap.get(sticky_id)
+            rep = self._replicas.get(rid) if rid else None
+        if rep is None:
+            return 404, json.dumps(
+                {"error": f"unknown id {sticky_id!r} "
+                          "(origin replica gone — re-submit)"}).encode()
+        status, out = self._send(rep, method, path, body)
+        if status is None:
+            from .. import obs
+            obs.get_metrics().counter("fleet.replica_errors").add(1)
+            return 502, out
+        return status, out
+
+    def _send(self, rep: Replica, method: str, path: str,
+              body: Optional[bytes]):
+        """(status, body) from one replica; (None, error bytes) on
+        connect failure."""
+        req = urllib.request.Request(
+            rep.url + path, data=body, method=method,
+            headers={"Content-Type": "application/json"} if body else {})
+        try:
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.request_timeout_s) as resp:
+                    return resp.status, resp.read()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read()
+        except Exception as e:
+            return None, f"{type(e).__name__}: {e}".encode()
+
+    # ------------------------------------------------------------------
+    # observability
+
+    def refresh_gauges(self) -> None:
+        from .. import obs
+        met = obs.get_metrics()
+        with self._lock:
+            n = len(self._replicas)
+            ready = sum(1 for r in self._replicas.values()
+                        if r.state == READY)
+        met.gauge("fleet.replicas").set(n)
+        met.gauge("fleet.replicas_ready").set(ready)
+
+    def stats(self) -> dict[str, Any]:
+        self.refresh_gauges()
+        from .. import obs
+        with self._lock:
+            # Snapshot inline under the membership lock (JTL501: the
+            # per-replica health fields are poller-written).
+            reps = [{"id": r.id, "url": r.url, "state": r.state,
+                     "routed": r.routed, "spilled_in": r.spilled_in,
+                     "last_error": r.last_error,
+                     "health": r.last_healthz}
+                    for r in self._replicas.values()]
+            sticky = {"verdicts": len(self._verdict_origin),
+                      "sessions": len(self._session_origin)}
+        return {
+            "mode": {AFFINE: "affine", STRICT: "strict",
+                     RANDOM: "random"}.get(self.mode, str(self.mode)),
+            "salt": self.salt,
+            "bucket_floor": self.bucket_floor,
+            "replicas": reps,
+            "sticky": sticky,
+            "fleet": obs.fleet_stats(obs.get_metrics()),
+        }

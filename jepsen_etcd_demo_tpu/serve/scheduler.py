@@ -65,6 +65,12 @@ from ..ops.limits import limits
 # chances, short enough to re-attach promptly after one.
 RETRY_AFTER_S = 5
 
+# Retry-After seconds a 429 in-flight-bound rejection advertises: the
+# bound clears as soon as one batch drains (tens of ms on a warm
+# kernel), so 1s is the floor a well-behaved client — and the fleet
+# router's backoff (serve/router.py) — can act on.
+RETRY_AFTER_INFLIGHT_S = 1
+
 # Kernel label of the degraded-shed route (results / bench / web).
 ORACLE_KERNEL = "cpu-oracle-shed"
 
@@ -212,7 +218,8 @@ class CoalescingScheduler:
                 m.counter("serve.rejected_inflight").add(1)
                 raise Rejected(
                     f"tenant {req.tenant!r} at the in-flight bound "
-                    f"({self.max_inflight()}); drain verdicts first", 429)
+                    f"({self.max_inflight()}); drain verdicts first", 429,
+                    retry_after_s=RETRY_AFTER_INFLIGHT_S)
             q = self._queues.get(req.tenant)
             if q is None:
                 q = self._queues[req.tenant] = deque()
@@ -254,7 +261,8 @@ class CoalescingScheduler:
                 raise Rejected(
                     f"tenant {tenant!r} wave of {len(reqs)} would "
                     f"overrun the in-flight bound "
-                    f"({self.max_inflight()}); chunk and drain", 429)
+                    f"({self.max_inflight()}); chunk and drain", 429,
+                    retry_after_s=RETRY_AFTER_INFLIGHT_S)
             q = self._queues.get(tenant)
             if q is None:
                 q = self._queues[tenant] = deque()

@@ -27,7 +27,7 @@ from typing import Any, Optional
 from ..obs import health
 from ..obs.sync import maybe_wrap
 from ..ops.op import Op
-from .scheduler import RETRY_AFTER_S, Rejected
+from .scheduler import RETRY_AFTER_INFLIGHT_S, RETRY_AFTER_S, Rejected
 
 # Bounds on client-driven session state (the same no-unbounded-growth
 # discipline the scheduler applies to tenant queues): most sessions
@@ -171,11 +171,13 @@ class SessionManager:
             if len(self._sessions) >= MAX_OPEN_SESSIONS:
                 raise Rejected(
                     f"daemon at the global session bound "
-                    f"({MAX_OPEN_SESSIONS}); close sessions first", 429)
+                    f"({MAX_OPEN_SESSIONS}); close sessions first", 429,
+                    retry_after_s=RETRY_AFTER_INFLIGHT_S)
             if self._per_tenant.get(tenant, 0) >= self._cap():
                 raise Rejected(
                     f"tenant {tenant!r} at the session bound "
-                    f"({self._cap()}); close sessions first", 429)
+                    f"({self._cap()}); close sessions first", 429,
+                    retry_after_s=RETRY_AFTER_INFLIGHT_S)
             sess = ServeSession(tenant, model, model_name, keyed=keyed)
             self._sessions[sess.id] = sess
             self._per_tenant[tenant] = self._per_tenant.get(tenant, 0) + 1

@@ -376,3 +376,128 @@ def test_check_ledger_record_gates_low_coverage_and_omission():
     rec["scaling"] = {"ledger": _att(coverage=0.5)}
     assert any("scaling.ledger" in p
                for p in bench_compare.check_ledger_record(rec))
+
+
+# ---------------------------------------------------------------------
+# fleet lane gates (ISSUE 18)
+
+
+def _fleet_arm(eps: float, hit_rate: float, p99: float = 0.5) -> dict:
+    return {"wall_s": 1.0, "agg_eps": eps, "agg_rps": eps / 100.0,
+            "p50_s": p99 / 2, "p99_s": p99, "warm_p99_s": p99 / 2,
+            "hit_rate": hit_rate, "lookups": 64}
+
+
+def _fleet_lane(agg_eps: float = 5000.0, p99_s: float = 0.4) -> dict:
+    return {
+        "replicas": 2, "histories": 24, "events": 2400,
+        "affine": _fleet_arm(agg_eps, 0.9, p99_s),
+        "random": _fleet_arm(agg_eps * 0.7, 0.5, p99_s * 1.5),
+        "hit_rate_delta": 0.4, "agg_eps_ratio": 1.43,
+        "knee_rate_rps": 40.0, "agg_eps": agg_eps, "p99_s": p99_s,
+        "knee_rungs": [{"offered_rps": 20.0, "agg_rps": 19.0,
+                        "agg_eps": agg_eps, "p99_s": p99_s}],
+        "spillover": 0, "replica_fill": {"r0": 12, "r1": 12},
+        "replica_fill_min": 12, "invalid": 3,
+        "verdicts_identical": True,
+    }
+
+
+def _fleet_stats(**over) -> dict:
+    base = {k: 0 for k in bench_compare.FLEET_STATS_KEYS}
+    base.update(over)
+    return base
+
+
+def _fleet_record(agg_eps: float = 5000.0, p99_s: float = 0.4) -> dict:
+    rec = _record(1000.0)
+    rec["fleet"] = _fleet_stats(requests=96, replicas=2,
+                                replicas_ready=2)
+    rec["detail"]["fleet"] = _fleet_lane(agg_eps, p99_s)
+    return rec
+
+
+def test_fleet_agg_eps_gated_like_the_others():
+    res = bench_compare.compare(_fleet_record(5000.0),
+                                _fleet_record(3000.0),
+                                threshold_pct=10.0)
+    assert "fleet_agg_eps" in res["regressions"]
+    res = bench_compare.compare(_fleet_record(5000.0),
+                                _fleet_record(4900.0),
+                                threshold_pct=10.0)
+    assert "fleet_agg_eps" not in res["regressions"]
+
+
+def test_fleet_p99_is_gated_inverted():
+    """Latency at the knee is lower-is-better: a RISE past the leash is
+    the regression, a fall never is."""
+    res = bench_compare.compare(_fleet_record(p99_s=0.4),
+                                _fleet_record(p99_s=0.8),
+                                threshold_pct=10.0)
+    assert "fleet_p99_s" in res["regressions"]
+    by_lane = {r["lane"]: r for r in res["lanes"]}
+    assert by_lane["fleet_p99_s"]["lower_is_better"] is True
+    res = bench_compare.compare(_fleet_record(p99_s=0.4),
+                                _fleet_record(p99_s=0.1),
+                                threshold_pct=10.0)
+    assert "fleet_p99_s" not in res["regressions"]
+
+
+def test_fleet_p99_dropped_from_new_record_fails_by_name():
+    old, new = _fleet_record(), _fleet_record()
+    del new["detail"]["fleet"]["p99_s"]
+    del new["detail"]["fleet"]["agg_eps"]
+    res = bench_compare.compare(old, new, threshold_pct=10.0)
+    assert set(res["missing"]) >= {"fleet_p99_s", "fleet_agg_eps"}
+
+
+def test_fleet_affinity_diagnostics_are_informational():
+    """The affine-vs-random decomposition (hit-rate delta, per-arm eps,
+    spillover, knee rate, per-replica fill) explains the gated numbers;
+    it never gates on its own."""
+    old, new = _fleet_record(), _fleet_record()
+    new["detail"]["fleet"]["hit_rate_delta"] = 0.01
+    new["detail"]["fleet"]["random"]["agg_eps"] = 9999.0
+    new["detail"]["fleet"]["knee_rate_rps"] = 1.0
+    res = bench_compare.compare(old, new, threshold_pct=10.0)
+    assert res["regressions"] == []
+    by_lane = {r["lane"]: r for r in res["lanes"]}
+    for lane in ("fleet_hit_rate_delta", "fleet_random_eps",
+                 "fleet_knee_rate_rps", "fleet_affine_eps",
+                 "fleet_agg_eps_ratio", "fleet_replica_fill_min"):
+        assert by_lane[lane]["informational"] is True, lane
+
+
+def test_check_fleet_record_requires_object_on_every_record():
+    rec = _record(1000.0)
+    assert bench_compare.check_fleet_record(rec) == \
+        ["record omits the `fleet` object entirely"]
+    rec["fleet"] = _fleet_stats()
+    del rec["fleet"]["spillover"]
+    assert any("spillover" in p
+               for p in bench_compare.check_fleet_record(rec))
+
+
+def test_check_fleet_record_degraded_needs_only_zeros():
+    """ISSUE 18 zeros-never-absent: the degraded paths owe the zeroed
+    router-stats object, nothing more — no measured lane exists when no
+    fleet ran."""
+    rec = {"value": 0, "degraded": True, "backend": "none",
+           "fleet": _fleet_stats()}
+    assert bench_compare.check_fleet_record(rec) == []
+
+
+def test_check_fleet_record_gates_lane_arms_and_parity():
+    rec = _fleet_record()
+    assert bench_compare.check_fleet_record(rec) == []
+    del rec["detail"]["fleet"]["affine"]["hit_rate"]
+    assert any("affine missing key 'hit_rate'" in p
+               for p in bench_compare.check_fleet_record(rec))
+    rec = _fleet_record()
+    rec["detail"]["fleet"]["verdicts_identical"] = False
+    assert any("verdict parity" in p
+               for p in bench_compare.check_fleet_record(rec))
+    rec = _fleet_record()
+    del rec["detail"]["fleet"]
+    assert any("omits the detail.fleet lane" in p
+               for p in bench_compare.check_fleet_record(rec))

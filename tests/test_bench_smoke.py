@@ -27,6 +27,13 @@ def _assert_ledger_zeros(out: dict) -> None:
     for key in bench_compare.LEDGER_STATS_KEYS:
         assert led[key] == 0, (key, led)
     assert bench_compare.check_ledger_record(out) == []
+    # ISSUE 18: the fleet router-stats object rides the same contract —
+    # all keys present as zeros on every degraded path, and the fleet
+    # schema gate passes the record.
+    fl = out["fleet"]
+    for key in bench_compare.FLEET_STATS_KEYS:
+        assert fl[key] == 0, (key, fl)
+    assert bench_compare.check_fleet_record(out) == []
 
 
 def test_sched_corpus_lane_contract():
@@ -193,6 +200,7 @@ def test_bench_jit_timeout_probe_routes_through_degraded_record(
     for key in ("kernel_phases", "padding_waste", "cache_hit_rate",
                 "sweep", "profile"):
         assert key in out, key
+    _assert_ledger_zeros(out)
 
 
 def test_bench_degraded_rerun_lane_crash_still_emits_record(monkeypatch,

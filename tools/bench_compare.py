@@ -14,10 +14,11 @@ Accepted inputs, per file: the driver wrapper (`{"parsed": {...}}` —
 the `parsed` record is used; a wrapper whose bench crashed carries no
 parsed record and compares as degraded), or the raw bench line itself.
 
-Lanes (all higher-is-better events/s or ratios): the top-level
+Lanes (higher-is-better events/s or ratios, plus the INVERTED_LANES
+seconds where a RISE is the regression — fleet_p99_s): the top-level
 throughput + vs_baseline, the corpus_sched / sparse / tuned / streaming
-lane rates, the long-history lanes keyed by op count, and cache /
-padding health. A lane absent from the OLD record is reported as
+/ fleet lane rates, the long-history lanes keyed by op count, and
+cache / padding health. A lane absent from the OLD record is reported as
 skipped, never a failure (older rounds predate newer lanes) — but a
 lane the old record HAS and the new record LACKS means the candidate
 bench dropped a lane (a lane crash, a schema break): that exits
@@ -83,6 +84,16 @@ LANES: list[tuple[str, tuple]] = [
     ("campaign_specs_eps", ("detail", "campaign", "specs_per_sec")),
     ("campaign_shrink_cps",
      ("detail", "campaign", "shrink_checks_per_sec")),
+    # Fleet lane (ISSUE 18): aggregate events/s at the measured open-
+    # loop latency knee — serving capacity at acceptable latency.
+    ("fleet_agg_eps", ("detail", "fleet", "agg_eps")),
+]
+# Gated lanes where LOWER is better (seconds at the knee): regression
+# when the value RISES past the threshold. Kept separate from LANES so
+# every entry there stays uniformly higher-is-better.
+INVERTED_LANES: list[tuple[str, tuple]] = [
+    # Fleet lane (ISSUE 18): p99 request latency at the knee rung.
+    ("fleet_p99_s", ("detail", "fleet", "p99_s")),
 ]
 # Scaling-efficiency lanes (ISSUE 12): events/s PER CHIP on the mesh
 # and the per-chip-vs-single-device efficiency ratio, recorded by
@@ -158,6 +169,18 @@ INFO_LANES: list[tuple[str, tuple]] = [
      ("detail", "corpus_sched", "ledger", "coverage")),
     ("sched_ledger_overhead_pct",
      ("detail", "corpus_sched", "ledger_overhead_pct")),
+    # Fleet lane context (ISSUE 18): the knee arrival rate is load-
+    # shaped, per-replica fill and spillover move with membership and
+    # health events, and the affine-vs-random deltas are ratios of two
+    # measurements — all informational; the gates stay on
+    # fleet_agg_eps / fleet_p99_s above.
+    ("fleet_knee_rate_rps", ("detail", "fleet", "knee_rate_rps")),
+    ("fleet_hit_rate_delta", ("detail", "fleet", "hit_rate_delta")),
+    ("fleet_agg_eps_ratio", ("detail", "fleet", "agg_eps_ratio")),
+    ("fleet_spillover", ("detail", "fleet", "spillover")),
+    ("fleet_replica_fill_min", ("detail", "fleet", "replica_fill_min")),
+    ("fleet_affine_eps", ("detail", "fleet", "affine", "agg_eps")),
+    ("fleet_random_eps", ("detail", "fleet", "random", "agg_eps")),
 ]
 
 # The zeros-never-absent `ledger` object every bench record carries
@@ -210,6 +233,61 @@ def check_ledger_record(rec: dict) -> list[str]:
             problems.append(
                 f"{where} buckets explain only {cov:.1%} of wall "
                 f"(need >= {LEDGER_MIN_COVERAGE:.0%})")
+    return problems
+
+
+# The zeros-never-absent `fleet` object every bench record carries
+# (obs.fleet_stats — router counters/gauges) and the measured lane
+# shape (bench.bench_fleet / bench.fleet_zero_lane) a NON-degraded
+# record's detail.fleet must carry. check_fleet_record validates both,
+# mirroring check_ledger_record's contract.
+FLEET_STATS_KEYS = ("requests", "spillover", "replica_errors",
+                    "rejected", "restarts", "replicas",
+                    "replicas_ready")
+FLEET_LANE_KEYS = ("replicas", "histories", "events", "affine",
+                   "random", "hit_rate_delta", "agg_eps_ratio",
+                   "knee_rate_rps", "agg_eps", "p99_s", "knee_rungs",
+                   "spillover", "replica_fill", "replica_fill_min",
+                   "invalid", "verdicts_identical")
+FLEET_ARM_KEYS = ("wall_s", "agg_eps", "agg_rps", "p50_s", "p99_s",
+                  "warm_p99_s", "hit_rate", "lookups")
+
+
+def check_fleet_record(rec: dict) -> list[str]:
+    """Schema gate for the fleet lane (ISSUE 18), returning the list
+    of problems (empty = pass). Every record — the degraded paths
+    included — must carry the all-keys `fleet` router object (zeros
+    permitted, never absent); a NON-degraded record must additionally
+    carry the measured detail.fleet lane with both routing arms and
+    certified verdict parity."""
+    problems: list[str] = []
+    fl = rec.get("fleet")
+    if not isinstance(fl, dict):
+        return ["record omits the `fleet` object entirely"]
+    for key in FLEET_STATS_KEYS:
+        if key not in fl:
+            problems.append(f"fleet object missing key {key!r}")
+    if is_degraded(rec):
+        return problems
+    lane = _dig_raw(rec, ("detail", "fleet"))
+    if not isinstance(lane, dict):
+        problems.append("non-degraded record omits the detail.fleet "
+                        "lane")
+        return problems
+    for key in FLEET_LANE_KEYS:
+        if key not in lane:
+            problems.append(f"detail.fleet missing key {key!r}")
+    for arm in ("affine", "random"):
+        obj = lane.get(arm)
+        if not isinstance(obj, dict):
+            continue   # absence already reported above
+        for key in FLEET_ARM_KEYS:
+            if key not in obj:
+                problems.append(
+                    f"detail.fleet.{arm} missing key {key!r}")
+    if lane.get("verdicts_identical") is not True:
+        problems.append("non-degraded fleet lane did not certify "
+                        "verdict parity (verdicts_identical != true)")
     return problems
 
 
@@ -335,6 +413,34 @@ def compare(old: dict, new: dict,
         reg = delta < -lane_thr
         row = {"lane": lane, "old": round(o, 4), "new": round(n, 4),
                "delta_pct": round(delta, 2), "regression": reg}
+        if lane_thr != threshold_pct:
+            row["threshold_pct"] = lane_thr
+        out["lanes"].append(row)
+        if reg:
+            out["regressions"].append(lane)
+    # Lower-is-better gated lanes (seconds at the knee): the SAME
+    # missing/skip/threshold contract as above with the regression
+    # direction flipped — a rise past the leash fails.
+    for lane, path in INVERTED_LANES:
+        o, n = _dig(old, path), _dig(new, path)
+        if o is not None and n is None:
+            out["lanes"].append({"lane": lane, "old": round(o, 4),
+                                 "new": None, "delta_pct": None,
+                                 "regression": False, "missing": True})
+            out["missing"].append(lane)
+            continue
+        if o is None or o == 0:
+            out["lanes"].append({"lane": lane, "old": o, "new": n,
+                                 "delta_pct": None, "regression": False,
+                                 "skipped": True})
+            continue
+        delta = (n - o) / o * 100.0
+        lane_thr = min(threshold_pct,
+                       LANE_THRESHOLD_PCT.get(lane, threshold_pct))
+        reg = delta > lane_thr
+        row = {"lane": lane, "old": round(o, 4), "new": round(n, 4),
+               "delta_pct": round(delta, 2), "regression": reg,
+               "lower_is_better": True}
         if lane_thr != threshold_pct:
             row["threshold_pct"] = lane_thr
         out["lanes"].append(row)
