@@ -1832,6 +1832,89 @@ def bench_long(model, n_ops: int, oracle_too: bool, p_info: float = 0.0005):
     return d
 
 
+def longhaul_zero_lane() -> dict:
+    """The degraded-path long-haul record: every contract key present
+    as zeros (tools/bench_compare.py check_longhaul_record — the same
+    zeros-never-absent rule as the ledger/fleet objects)."""
+    return {"events": 0, "segments": 0, "segments_run": 0,
+            "resumed_from": -1, "survived": False, "dead_step": -1,
+            "max_frontier": 0, "escalations": 0, "spilled": False,
+            "wall_s": 0.0, "events_per_sec": 0.0, "peak_rss_mb": 0.0,
+            "rss_budget_mb": 0, "rss_ok": False,
+            "verdicts_identical": False, "crosscheck_events": 0}
+
+
+def bench_longhaul(model, events: int | None = None,
+                   seg_events: int = 16384, seed: int = 0x10A6,
+                   rss_budget_mb: int = 512,
+                   crosscheck_cap: int = 120_000) -> dict:
+    """Long-haul out-of-core lane (ISSUE 20 tentpole): a synthetic
+    multi-segment register history is generated chunk-by-chunk (the
+    whole history NEVER exists in RAM), encoded through the
+    content-addressed cache tier, and checked end-to-end through the
+    spilled wgl2 route (stream/longhaul.py) under a PINNED host RSS
+    budget — `peak_rss_mb` is the lane's ru_maxrss DELTA, gated
+    inverted (lower is better) by tools/bench_compare.py next to the
+    gated `longhaul_eps` throughput.
+
+    Default scale keeps the driver's bench round fast;
+    JEPSEN_TPU_BENCH_LONGHAUL_EVENTS scales the same lane to 10^8+
+    events for the full out-of-core claim. Verdict parity is certified
+    every round at the largest cross-checkable scale: the spilled route
+    and the all-RAM route (host_spill_mode pinned off) must agree on
+    survived/dead_step bit-identically."""
+    import shutil
+    import tempfile
+
+    from dataclasses import replace
+
+    from jepsen_etcd_demo_tpu.ops.limits import limits, set_limits
+    from jepsen_etcd_demo_tpu.store import spill
+    from jepsen_etcd_demo_tpu.stream import longhaul
+
+    if events is None:
+        events = int(os.environ.get(
+            "JEPSEN_TPU_BENCH_LONGHAUL_EVENTS", 120_000))
+    # Pay the XLA compile (and its RSS spike) BEFORE the measured lane:
+    # the gated peak_rss_mb must measure the out-of-core engine, not
+    # the one-time jit of the chunk kernel.
+    longhaul.run_longhaul(model, events=4096, seg_events=2048,
+                          seed=seed ^ 0x5A5A)
+    td = tempfile.mkdtemp(prefix="jepsen-longhaul-")
+    prev = set_limits(replace(limits(), host_spill_mode=2,
+                              host_rss_budget_mb=rss_budget_mb))
+    try:
+        with spill.spilling(td):
+            rec = longhaul.run_longhaul(
+                model, events=events, seg_events=seg_events, seed=seed)
+        ce = min(events, crosscheck_cap)
+        if ce == events:
+            spilled_verdict = (rec["survived"], rec["dead_step"])
+        else:
+            with spill.spilling(td):
+                cc_spill = longhaul.run_longhaul(
+                    model, events=ce, seg_events=seg_events, seed=seed,
+                    tag="longhaul-cc")
+            spilled_verdict = (cc_spill["survived"],
+                               cc_spill["dead_step"])
+        set_limits(replace(limits(), host_spill_mode=1))
+        inram = longhaul.run_longhaul(model, events=ce,
+                                      seg_events=seg_events, seed=seed)
+        identical = spilled_verdict == (inram["survived"],
+                                        inram["dead_step"])
+        assert identical, (
+            f"longhaul verdict divergence at {ce} events: spilled "
+            f"{spilled_verdict} vs in-RAM "
+            f"{(inram['survived'], inram['dead_step'])}")
+    finally:
+        set_limits(prev)
+        shutil.rmtree(td, ignore_errors=True)
+    rec["verdicts_identical"] = identical
+    rec["crosscheck_events"] = ce
+    rec["kernel"] = "wgl2-sort-chunked"
+    return rec
+
+
 def bench_100k(model) -> dict:
     """Opt-in 100k-op lane (BENCH_100K=1; minutes of wall clock): one
     100k-op register history through the production router — the step
@@ -1927,6 +2010,7 @@ def main():
                 "fleet": obs.fleet_stats(None),
                 "campaign": obs.campaign_stats(None),
                 "ledger": obs.ledger_stats(None),
+                "longhaul": obs.longhaul_stats(None),
                 # Which tuning profile the run INTENDED to use (ISSUE 4:
                 # tools/print_profile.py prints the full resolved view).
                 "profile": _profile_record(),
@@ -2018,6 +2102,10 @@ def main():
             # end, batched-vs-sequential ddmin shrink checks/s, and the
             # banked-corpus replay wall.
             campaign_lane = bench_campaign(model)
+            # Long-haul out-of-core lane (ISSUE 20): segment-chained
+            # checking through the spill tier under a pinned host RSS
+            # budget; spilled vs in-RAM verdicts certified identical.
+            longhaul_lane = bench_longhaul(model)
             # Inside the capture: the 100k lane's compile/execute/encode
             # seconds must land in the same kernel_phases breakdown as
             # every other lane when it actually runs.
@@ -2047,6 +2135,7 @@ def main():
             "fleet": obs.fleet_stats(cap.metrics),
             "campaign": obs.campaign_stats(cap.metrics),
             "ledger": obs.ledger_stats(cap.metrics),
+            "longhaul": obs.longhaul_stats(cap.metrics),
             "profile": _profile_record(),
             "health": health_rec,
             "degraded": True,
@@ -2090,6 +2179,7 @@ def main():
         "serve": serve_lane,
         "fleet": fleet_lane,
         "campaign": campaign_lane,
+        "longhaul": longhaul_lane,
     }
     if "roofline" in corpus:
         detail["roofline"] = corpus["roofline"]
@@ -2147,6 +2237,11 @@ def main():
         # — zeros permitted, never absent; the corpus_sched lane's
         # `ledger` object carries the windowed attribution.
         "ledger": obs.ledger_stats(cap.metrics),
+        # Spill-tier accounting over the same capture (ISSUE 20):
+        # out-of-core read/write/eviction counters, the compress-ratio
+        # and peak-RSS gauges — zeros permitted, never absent;
+        # detail.longhaul carries the measured RSS-ceiling lane.
+        "longhaul": obs.longhaul_stats(cap.metrics),
         # The tuning profile this round resolved (ISSUE 4): hash +
         # non-default fields with provenance; detail.tuned measures it.
         "profile": _profile_record(),

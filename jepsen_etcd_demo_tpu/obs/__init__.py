@@ -208,7 +208,8 @@ CAMPAIGN_GAUGES = ("campaign.unique_signatures", "campaign.shrink_ratio",
 LEDGER_COUNTERS = ("ledger.launches", "ledger.encode_s", "ledger.h2d_s",
                    "ledger.h2d_bytes", "ledger.compile_s",
                    "ledger.execute_s", "ledger.padding_s",
-                   "ledger.straggler_s", "ledger.dispatch_gap_s")
+                   "ledger.straggler_s", "ledger.dispatch_gap_s",
+                   "ledger.spill_read_s", "ledger.spill_write_s")
 # Last-launch occupancy: real/padded step fill and real/padded batch
 # fill of the most recent decomposed launch.
 # jtflow: metrics preregistered
@@ -233,6 +234,20 @@ FLEET_COUNTERS = ("fleet.requests", "fleet.spillover",
 # them are currently routable (ready + not degraded/wedged/down).
 # jtflow: metrics preregistered
 FLEET_GAUGES = ("fleet.replicas", "fleet.replicas_ready")
+# Out-of-core spill tier (store/spill.py + store/encode_cache.py GC,
+# ISSUE 20): disk-tier transfer counts and bytes in each direction,
+# in-RAM window evictions, encode-cache LRU collections — pre-
+# registered so every capture's metrics.json carries them (zeros
+# permitted, never absent; longhaul_stats() is the bench/web reader).
+# jtflow: metrics preregistered
+SPILL_COUNTERS = ("spill.writes", "spill.reads",
+                  "spill.bytes_written", "spill.bytes_read",
+                  "spill.evictions", "encode.cache_evictions")
+# Spill-tier occupancy: last measured checkpoint compression ratio
+# (raw packed bytes / stored bytes; >1 means the canon-quotient codec
+# beat raw) and the long-haul lane's peak RSS growth in MiB.
+# jtflow: metrics preregistered
+SPILL_GAUGES = ("spill.compress_ratio", "spill.peak_rss_mb")
 
 _NULL_TRACER = Tracer(enabled=False)
 _NULL_METRICS = MetricsRegistry(enabled=False)
@@ -259,10 +274,12 @@ class Capture:
             for name in PHASE_COUNTERS + SCHED_COUNTERS + SWEEP_COUNTERS \
                     + COST_COUNTERS + ELLE_COUNTERS + SERVE_COUNTERS \
                     + SYNC_COUNTERS + CAMPAIGN_COUNTERS \
-                    + LEDGER_COUNTERS + FLEET_COUNTERS:
+                    + LEDGER_COUNTERS + FLEET_COUNTERS \
+                    + SPILL_COUNTERS:
                 self.metrics.counter(name)
             for name in ELLE_GAUGES + SERVE_GAUGES + CAMPAIGN_GAUGES \
-                    + LEDGER_GAUGES + SLO_GAUGES + FLEET_GAUGES:
+                    + LEDGER_GAUGES + SLO_GAUGES + FLEET_GAUGES \
+                    + SPILL_GAUGES:
                 self.metrics.gauge(name)
             self.metrics.histogram(SERVE_HISTOGRAM)
             self.metrics.gauge(PHASE_GAUGE)
@@ -758,6 +775,38 @@ def fleet_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
     return out
 
 
+def longhaul_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
+    """The out-of-core spill tier's bench/web contract fields
+    (store/spill.py + the encode-cache GC, ISSUE 20), from a registry
+    snapshot: disk-tier transfer counts/bytes both directions, window
+    and cache evictions, the last measured checkpoint compression
+    ratio, and the long-haul lane's peak RSS growth. Zeros when no
+    registry / nothing spilled — like every reader here, the contract
+    is "zeros permitted, never absent"."""
+    out = {"spill_writes": 0, "spill_reads": 0,
+           "spill_bytes_written": 0, "spill_bytes_read": 0,
+           "spill_evictions": 0, "cache_evictions": 0,
+           "compress_ratio": 0.0, "peak_rss_mb": 0.0}
+    if metrics is None or not metrics.enabled:
+        return out
+    snap = metrics.snapshot()
+    for key, name in (("spill_writes", "spill.writes"),
+                      ("spill_reads", "spill.reads"),
+                      ("spill_bytes_written", "spill.bytes_written"),
+                      ("spill_bytes_read", "spill.bytes_read"),
+                      ("spill_evictions", "spill.evictions"),
+                      ("cache_evictions", "encode.cache_evictions")):
+        rec = snap.get(name)
+        if rec and rec.get("type") == "counter":
+            out[key] = int(rec["value"])
+    for key, name in (("compress_ratio", "spill.compress_ratio"),
+                      ("peak_rss_mb", "spill.peak_rss_mb")):
+        g = snap.get(name)
+        if g and g.get("last") is not None:
+            out[key] = round(float(g["last"]), 6)
+    return out
+
+
 def ledger_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
     """The scaling ledger's bench/web contract fields (obs/ledger.py,
     ISSUE 16), from a registry snapshot: launch count, the per-bucket
@@ -769,6 +818,7 @@ def ledger_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
     out = {"launches": 0, "encode_s": 0.0, "h2d_s": 0.0, "h2d_bytes": 0,
            "compile_s": 0.0, "execute_s": 0.0, "padding_s": 0.0,
            "straggler_s": 0.0, "dispatch_gap_s": 0.0,
+           "spill_read_s": 0.0, "spill_write_s": 0.0,
            "step_fill": 0.0, "batch_fill": 0.0,
            "slo_p50_s": 0.0, "slo_p99_s": 0.0, "slo_burn_rate": 0.0}
     if metrics is None or not metrics.enabled:
@@ -789,6 +839,8 @@ def ledger_stats(metrics: Optional[MetricsRegistry] = None) -> dict:
     out["padding_s"] = counter_value("ledger.padding_s")
     out["straggler_s"] = counter_value("ledger.straggler_s")
     out["dispatch_gap_s"] = counter_value("ledger.dispatch_gap_s")
+    out["spill_read_s"] = counter_value("ledger.spill_read_s")
+    out["spill_write_s"] = counter_value("ledger.spill_write_s")
     for key, name in (("step_fill", "ledger.step_fill"),
                       ("batch_fill", "ledger.batch_fill"),
                       ("slo_p50_s", "serve.slo_p50_s"),

@@ -71,11 +71,16 @@ LEDGER_ENV = "JEPSEN_TPU_LEDGER"
 # window no instrumented span covers; other_s is wall outside the
 # instrumented window.
 BUCKETS = ("encode_s", "h2d_s", "compile_s", "execute_s",
-           "padding_s", "straggler_s", "dispatch_gap_s", "other_s")
+           "padding_s", "straggler_s", "dispatch_gap_s",
+           "spill_read_s", "spill_write_s", "other_s")
 
 # Record kinds whose spans carry padding context and decompose into
 # useful/padding/straggler (dispatch wall + the blocking result fetch).
 _DEVICE_KINDS = ("execute", "fetch")
+
+# Host disk-tier spans of the out-of-core checking tier (store/spill.py):
+# each maps 1:1 onto its same-named `_s` bucket above.
+_SPILL_KINDS = ("spill_read", "spill_write")
 
 
 def ledger_enabled() -> bool:
@@ -334,6 +339,12 @@ class Ledger:
             m.counter("ledger.launches").add(1)
             m.counter("ledger.compile_s").add(rec.dur_s)
             self._bucket(m, "compile_s", rec.dur_s)
+        elif rec.kind == "spill_read":
+            m.counter("ledger.spill_read_s").add(rec.dur_s)
+            self._bucket(m, "spill_read_s", rec.dur_s)
+        elif rec.kind == "spill_write":
+            m.counter("ledger.spill_write_s").add(rec.dur_s)
+            self._bucket(m, "spill_write_s", rec.dur_s)
         else:
             if rec.kind == "execute":
                 m.counter("ledger.launches").add(1)
@@ -406,6 +417,20 @@ class Ledger:
         if not self.enabled:
             return
         self._emit(LaunchRecord(kind="h2d", bytes=int(nbytes),
+                                t0_ns=t0_ns, t1_ns=t1_ns,
+                                ctx=current_context() or {}))
+
+    def record_spill(self, kind: str, nbytes: int, t0_ns: int,
+                     t1_ns: int) -> None:
+        """One disk-tier transfer of the out-of-core checker
+        (store/spill.py): kind is "spill_read" or "spill_write", bytes
+        is the on-disk payload size. These decompose into their own
+        first-class buckets so scaling_report shows where the
+        disk-seconds go."""
+        if not self.enabled:
+            return
+        assert kind in _SPILL_KINDS, kind
+        self._emit(LaunchRecord(kind=kind, bytes=int(nbytes),
                                 t0_ns=t0_ns, t1_ns=t1_ns,
                                 ctx=current_context() or {}))
 
@@ -491,6 +516,10 @@ def attribute(records: list[dict], wall_s: Optional[float] = None,
         elif kind == "compile":
             out["launches"] += 1
             b["compile_s"] += dur
+        elif kind == "spill_read":
+            b["spill_read_s"] += dur
+        elif kind == "spill_write":
+            b["spill_write_s"] += dur
         elif kind in _DEVICE_KINDS:
             if kind == "execute":
                 out["launches"] += 1

@@ -372,6 +372,33 @@ class KernelLimits:
     # replica owning every hot bucket) without restarting the fleet.
     # Same salt fleet-wide or routing is not a function.
     fleet_hash_salt: int = _f(0, "arch", 0, 1 << 30)
+    # [tunable] Host spill routing for the out-of-core checking tier
+    # (store/spill.py): 0 = auto (spill encoded chunks / frontier
+    # checkpoints to disk only when the estimated working set exceeds
+    # host_rss_budget_mb), 1 = off (everything stays in RAM — the seed
+    # behaviour), 2 = force (every checkpoint/chunk goes through the
+    # spill tier — the bench/test lane). Verdicts are bit-identical in
+    # every mode: the spill tier moves bytes, never meaning.
+    host_spill_mode: int = _f(0, "tunable", 0, 2, group="spill")
+    # [tunable] Host-RAM working-set budget (MiB) of the out-of-core
+    # tier: the bounded in-RAM window of spilled encoded chunks and
+    # frontier checkpoints evicts to disk past this budget, and the
+    # long-haul bench lane pins its RSS-growth ceiling to it.
+    host_rss_budget_mb: int = _f(4096, "tunable", 64, 1 << 20,
+                                 group="spill")
+    # [tunable] Spilled frontier-checkpoint compression (store/spill.py
+    # FrontierCodec): 0 = auto (canon-quotient per-class counts when the
+    # frontier is canonical, raw packed rows otherwise), 1 = raw always,
+    # 2 = force-canonical (refuse the raw fallback — the codec test
+    # lane). Decompression is bit-identical in every mode; a payload
+    # that fails its digest reads as absent (recompute), never as data.
+    spill_compress_mode: int = _f(0, "tunable", 0, 2, group="spill")
+    # [tunable] On-disk size cap (MiB) of the content-addressed encode
+    # cache (store/encode_cache.py): past it, store() garbage-collects
+    # least-recently-used entries (mtime order) until under the cap.
+    # 0 disables collection (the seed's unbounded growth).
+    encode_cache_cap_mb: int = _f(2048, "tunable", 0, 1 << 20,
+                                  group="spill")
 
 
 def field_meta() -> dict[str, dict]:

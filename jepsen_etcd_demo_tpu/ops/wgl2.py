@@ -268,6 +268,30 @@ def _init_carry2(model: Model, cfg: WGLConfig) -> _Carry2:
     )
 
 
+def _seed_carry2(cfg: WGLConfig, states_np: np.ndarray) -> _Carry2:
+    """A carry seeded from a QUIESCENT frontier: a plain state set. At
+    a history point where every invoked op has returned, each config's
+    pending mask is zero, so a cross-segment carry is fully described
+    by its surviving states — the out-of-core segment chaining
+    (stream/longhaul.py) threads exactly this between segments."""
+    f_cap, w = cfg.f_cap, cfg.words
+    n = int(states_np.size)
+    assert 0 < n <= f_cap, (n, f_cap)
+    st = np.zeros((f_cap,), np.int32)
+    st[:n] = states_np
+    vd = np.zeros((f_cap,), bool)
+    vd[:n] = True
+    return _Carry2(
+        states=jnp.asarray(st),
+        masks=jnp.zeros((f_cap, w), jnp.uint32),
+        valid=jnp.asarray(vd),
+        dead=jnp.bool_(False),
+        overflow=jnp.bool_(False),
+        dead_step=jnp.int32(-1),
+        max_frontier=jnp.int32(n),
+    )
+
+
 def _check_one_fn(model: Model, cfg: WGLConfig):
     step = make_step_fn2(model, cfg)
 
@@ -416,7 +440,10 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
                           f_cap: int = 256, chunk: int = DEFAULT_CHUNK,
                           f_cap_max: int = 1 << 20,
                           time_budget_s: float | None = None,
-                          keep_death_checkpoint: bool = False
+                          keep_death_checkpoint: bool = False,
+                          init_frontier: np.ndarray | None = None,
+                          return_frontier: bool = False,
+                          spill_tag: str | None = None
                           ) -> dict[str, Any]:
     """Exact verdict via chunked scan + checkpointed capacity escalation.
 
@@ -454,7 +481,22 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
     is NOT donated here: the pre-chunk buffer must survive as the
     escalation/death checkpoint. The budget check happens at each
     resolution, so overshoot grows from one chunk to at most the
-    pipeline depth."""
+    pipeline depth.
+
+    Out-of-core extensions (ISSUE 20): `init_frontier` seeds the carry
+    from a QUIESCENT frontier — a plain i32 state set; sound only at a
+    history point where every invoked op has returned (masks all
+    zero), which is exactly what the out-of-core segment chaining
+    (stream/longhaul.py) guarantees at segment boundaries.
+    `return_frontier=True` returns the final carry as host arrays
+    under `"frontier"`. `spill_tag` (with an active store/spill.py
+    SpillDir and the `host_spill_mode` policy engaged) writes a
+    canon-quotient-compressed frontier checkpoint at every
+    resolved-clean chunk boundary — while later chunks are still in
+    flight, so the spill write overlaps device execute — and resumes
+    from a matching checkpoint on re-entry (a torn or mismatched
+    checkpoint degrades to recompute from the start, never a wrong
+    verdict)."""
     import time as _time
 
     from ..sched.pipeline import InflightWindow
@@ -476,10 +518,52 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
 
     pairs_np = history_canon_pairs(padded)
     pairs_dev = None if pairs_np is None else jnp.asarray(pairs_np)
+    if init_frontier is not None:
+        seed = np.asarray(init_frontier, dtype=np.int32).reshape(-1)
+        while f_cap < seed.size:
+            f_cap *= 4
     cfg = config_for(rs, model, f_cap)
-    carry = _init_carry2(model, cfg)
+    carry = _init_carry2(model, cfg) if init_frontier is None \
+        else _seed_carry2(cfg, seed)
     escalations = 0
     death_ckpt = None
+    n_pad = int(padded.targets.shape[0])
+    # Spill-tier routing (store/spill.py): engaged only with an active
+    # SpillDir, a caller tag, and the host_spill_mode policy saying yes
+    # for this history's host working set.
+    from ..store import spill as _spill
+
+    sdir = _spill.active_spill() if spill_tag is not None else None
+    do_spill = False
+    ck_name = None
+    start_pos = 0
+    if sdir is not None:
+        est_mb = (padded.slot_tabs.nbytes + padded.slot_active.nbytes
+                  + padded.targets.nbytes) / (1 << 20)
+        do_spill = _spill.spill_active(est_mb)
+    if do_spill:
+        ck_name = f"{spill_tag}.ck"
+        d = _spill.load_frontier(sdir, ck_name)
+        mt = (d or {}).get("meta") or {}
+        if d is not None and mt.get("n_steps") == n_pad \
+                and mt.get("chunk") == chunk \
+                and mt.get("k_slots") == int(rs.k_slots) \
+                and 0 < int(mt.get("pos", 0)) and "f_cap" in mt:
+            # Resume from the spilled chunk checkpoint: the carry is
+            # exact by construction (only resolved-clean chunks are
+            # spilled), so the continuation is bit-identical to a
+            # from-scratch run reaching the same boundary.
+            f_cap = int(mt["f_cap"])
+            escalations = int(mt.get("escalations", 0))
+            cfg = config_for(rs, model, f_cap)
+            carry = _Carry2(
+                states=jnp.asarray(d["states"]),
+                masks=jnp.asarray(d["masks"]),
+                valid=jnp.asarray(d["valid"]),
+                dead=jnp.bool_(False), overflow=jnp.bool_(False),
+                dead_step=jnp.int32(-1),
+                max_frontier=jnp.int32(int(mt.get("max_frontier", 1))))
+            start_pos = int(mt["pos"])
 
     def budget_check(c0: int) -> None:
         if (time_budget_s is not None
@@ -516,7 +600,7 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
 
     chunk_starts = list(range(0, padded.targets.shape[0], chunk))
     window = InflightWindow(limits().sched_pipeline_depth)
-    pos = 0
+    pos = start_pos
     while pos < len(chunk_starts) or window:
         while pos < len(chunk_starts) and not window.full():
             c0 = chunk_starts[pos]
@@ -578,9 +662,31 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
             window.clear()
             carry = out
             break
+        if do_spill:
+            # Spill this resolved-clean boundary's frontier — while
+            # chunks c0+chunk.. are still in flight on the device, so
+            # the disk write rides under real execute (the overlap
+            # contract). Classes from the last step the canon pass ran
+            # with; the codec verifies packed-low per row and falls
+            # back to raw, so compression is an attempt, soundness is
+            # unconditional.
+            classes = None
+            if pairs_np is not None:
+                classes = _spill.classes_from_pairs(
+                    pairs_np[min(c0 + chunk, n_pad) - 1])
+            _spill.spill_frontier(
+                sdir, ck_name, np.asarray(out.states),
+                np.asarray(out.masks), np.asarray(out.valid),
+                classes=classes,
+                meta={"pos": c0 // chunk + 1, "f_cap": f_cap,
+                      "escalations": escalations,
+                      "max_frontier": int(out.max_frontier),
+                      "n_steps": n_pad, "chunk": chunk,
+                      "k_slots": int(rs.k_slots)})
     res = {
         "survived": not bool(carry.dead),
         "overflow": False,
+        "n_steps": r,
         "dead_step": int(carry.dead_step),
         "max_frontier": int(carry.max_frontier),
         "f_cap": f_cap,
@@ -589,6 +695,10 @@ def check_steps_resumable(rs: ReturnSteps, model: Model | None = None,
     }
     if death_ckpt is not None:
         res["death_checkpoint"] = death_ckpt
+    if return_frontier:
+        res["frontier"] = (np.asarray(carry.states),
+                           np.asarray(carry.masks),
+                           np.asarray(carry.valid))
     return res
 
 
@@ -622,7 +732,10 @@ def check_encoded_resumable(enc: EncodedHistory, model: Model | None = None,
                             f_cap: int = 256,
                             f_cap_max: int = 1 << 20,
                             time_budget_s: float | None = None,
-                            keep_death_checkpoint: bool = False
+                            keep_death_checkpoint: bool = False,
+                            init_frontier: np.ndarray | None = None,
+                            return_frontier: bool = False,
+                            spill_tag: str | None = None
                             ) -> dict[str, Any]:
     """The general-geometry production path (huge values or wide pending
     sets where the dense lattice is infeasible): tighten the slot table to
@@ -646,7 +759,10 @@ def check_encoded_resumable(enc: EncodedHistory, model: Model | None = None,
     out = check_steps_resumable(encode_return_steps(enc), model,
                                 f_cap=f_cap, f_cap_max=f_cap_max,
                                 time_budget_s=time_budget_s,
-                                keep_death_checkpoint=keep_death_checkpoint)
+                                keep_death_checkpoint=keep_death_checkpoint,
+                                init_frontier=init_frontier,
+                                return_frontier=return_frontier,
+                                spill_tag=spill_tag)
     out["op_count"] = enc.n_ops
     # Telemetry (obs/): the kernel paths record their own search metrics
     # at the launch/exit sites — consumers (checkers/linearizable.py)

@@ -529,7 +529,8 @@ def sweep_summary(cfg: DenseConfig, live_sum: float, real_steps: int,
 
 def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
                       chunk: int | None = None,
-                      time_budget_s: float | None = None) -> dict:
+                      time_budget_s: float | None = None,
+                      spill_tag: str | None = None) -> dict:
     """Single-history dense check for histories whose step count exceeds
     one scan program: pad to a chunk multiple, loop chunks host-side.
     Bit-identical to check_steps3 (same step fn; pads contribute nothing).
@@ -557,7 +558,16 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
     (the closure exits immediately on an empty table) and death-sticky
     carries keep dead_step/max_frontier exact, so the result is
     bit-identical to the per-chunk loop. The budgeted path stays
-    synchronous per chunk: the budget check must see device time."""
+    synchronous per chunk: the budget check must see device time.
+
+    `spill_tag` (with an active store/spill.py SpillDir and the
+    host_spill_mode policy engaged) spills the packed table at chunk
+    seams — the death-poll cadence, so the explicit host fetch the
+    DONATED carry requires rides the same sync the poll already pays —
+    and resumes from a matching checkpoint on re-entry. A torn or
+    mismatched checkpoint degrades to recompute from the start, never
+    a wrong verdict. The sparse-engine route ignores the tag (its
+    carry is gathered, not a whole table)."""
     import time as _time
 
     from ..sched.pipeline import double_buffer
@@ -586,6 +596,57 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
         run = _cached_chunk_run(model, cfg, chunk)
     carry = _init_carry3(model, cfg)
     cfgs_dev = None
+    # Out-of-core seam checkpoints (ISSUE 20): engaged only with an
+    # active SpillDir, a caller tag, and the host_spill_mode policy
+    # saying yes for this history's host working set.
+    from ..store import spill as _spill
+
+    sdir = _spill.active_spill() if spill_tag is not None else None
+    do_spill = False
+    ck_name = None
+    start_c = 0
+    n_words = 1 << (cfg.k_slots - 5)
+    if sdir is not None:
+        est_mb = (rs.slot_tabs.nbytes + rs.slot_active.nbytes
+                  + rs.targets.nbytes) / (1 << 20)
+        do_spill = _spill.spill_active(est_mb)
+    if do_spill:
+        ck_name = f"{spill_tag}.ck3"
+        d = _spill.load_frontier(sdir, ck_name)
+        mt = (d or {}).get("meta") or {}
+        if d is not None and mt.get("n_steps") == n_pad \
+                and mt.get("chunk") == chunk \
+                and mt.get("shape") == [cfg.n_states, n_words] \
+                and 0 < int(mt.get("pos", 0)):
+            # Resume from the spilled seam checkpoint (only live seams
+            # are spilled, so dead/dead_step reset is exact).
+            carry = _Carry3(table=jnp.asarray(d["masks"]),
+                            dead=jnp.bool_(False),
+                            dead_step=jnp.int32(-1),
+                            max_frontier=jnp.int32(
+                                int(mt.get("max_frontier", 1))))
+            if mt.get("cfgs") is not None:
+                cfgs_dev = jnp.asarray(
+                    np.asarray(mt["cfgs"], np.float32))
+            start_c = int(mt["pos"])
+
+    def seam_spill(done_c: int) -> None:
+        # The chunk fn DONATES its carry, so the seam checkpoint pays
+        # an explicit host fetch — scheduled at the death-poll cadence,
+        # where the pipeline already syncs. Raw codec route: the packed
+        # table is not per-config class bits, but a sparse table is
+        # mostly zero words and the frame compresses it anyway.
+        tbl = np.asarray(carry.table)
+        cf = None if cfgs_dev is None \
+            else [float(x) for x in np.asarray(cfgs_dev)]
+        _spill.spill_frontier(
+            sdir, ck_name, np.arange(tbl.shape[0], dtype=np.int32),
+            tbl, np.ones(tbl.shape[0], bool),
+            meta={"pos": done_c, "n_steps": n_pad, "chunk": chunk,
+                  "shape": [int(tbl.shape[0]), int(tbl.shape[1])],
+                  "max_frontier": int(np.asarray(carry.max_frontier)),
+                  "cfgs": cf})
+
     if time_budget_s is None:
         poll = max(1, limits().sched_poll_chunks)
 
@@ -599,17 +660,22 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
             return staged + (jnp.int32(c * chunk),)
 
         done = 0
-        for staged in double_buffer(range(n_pad // chunk), stage):
+        for staged in double_buffer(range(start_c, n_pad // chunk),
+                                    stage):
             carry, part = run(carry, *staged)
             cfgs_dev = part if cfgs_dev is None else cfgs_dev + part
             done += 1
-            # jtlint: disable=JTL103 -- bounded death poll: one fetch per
-            # sched_poll_chunks chunks (the [tunable] knob), not per
-            # iteration — the doc/perf.md early-exit contract.
-            if done % poll == 0 and bool(np.asarray(carry.dead)):
-                break
+            if done % poll == 0:
+                # jtlint: disable=JTL103 -- bounded death poll: one
+                # fetch per sched_poll_chunks chunks (the [tunable]
+                # knob), not per iteration — the doc/perf.md early-exit
+                # contract; the seam spill rides the same sync.
+                if bool(np.asarray(carry.dead)):
+                    break
+                if do_spill:
+                    seam_spill(start_c + done)
     else:
-        for c in range(n_pad // chunk):
+        for c in range(start_c, n_pad // chunk):
             if _time.monotonic() - t0 > time_budget_s:
                 return {"valid": "unknown", "survived": False,
                         "overflow": True, "dead_step": -1,
@@ -634,6 +700,8 @@ def check_steps3_long(rs: ReturnSteps, model: Model, cfg: DenseConfig,
             # per-chunk fetch is the bound on overshoot.
             if bool(np.asarray(carry.dead)):
                 break
+            if do_spill:
+                seam_spill(c + 1)
     from .wgl import verdict
 
     n_parts = 5 if pairs is not None else 3

@@ -30,11 +30,17 @@ import numpy as np
 
 from ..obs import get_metrics
 from ..ops.encode import ENCODING_VERSION, EncodedHistory
+from ..ops.limits import limits
 
 CACHE_DIRNAME = ".encode-cache"
 
 _active_root: Optional[Path] = None
 _refresh: bool = False
+
+# Stores between size-capped GC sweeps (gc() stats the whole cache dir,
+# so store() amortizes it instead of paying the scan per entry).
+_GC_EVERY = 32
+_stores_since_gc = 0
 
 
 def activate(root: str | os.PathLike | None,
@@ -102,6 +108,12 @@ def lookup(history: Sequence, model_name: str,
         m.counter("encode.cache_misses").add(1)
         return None
     m.counter("encode.cache_hits").add(1)
+    try:
+        # Touch for the size-capped GC's LRU (mtime) ordering: a hit
+        # is a use, so hot entries survive collection.
+        os.utime(path)
+    except OSError:
+        pass
     return enc
 
 
@@ -127,3 +139,58 @@ def store(history: Sequence, model_name: str, k_slots: int,
             raise
     except OSError:
         pass   # the cache is an optimization, never a failure mode
+    global _stores_since_gc
+    _stores_since_gc += 1
+    if _stores_since_gc >= _GC_EVERY:
+        _stores_since_gc = 0
+        gc()
+
+
+def gc(cap_mb: Optional[int] = None) -> int:
+    """Size-capped LRU collection (ISSUE 20 satellite): while the
+    cache's on-disk bytes exceed ``encode_cache_cap_mb`` (0 = the
+    seed's unbounded growth), evict least-recently-USED entries —
+    mtime order; lookup() touches its hit, so hot entries survive.
+    Concurrent-pod safe: writers land entries via O_EXCL mkstemp +
+    atomic replace, so the sweep never sees a half-written named
+    entry, and a concurrently vanished file (another pod's GC, or a
+    replace) is skipped, never an error. Returns the eviction count
+    (`encode.cache_evictions` on the registry)."""
+    root = _active_root
+    if root is None:
+        return 0
+    if cap_mb is None:
+        cap_mb = limits().encode_cache_cap_mb
+    if cap_mb <= 0:
+        return 0
+    cap = int(float(cap_mb) * (1 << 20))
+    entries = []
+    total = 0
+    try:
+        it = list(root.iterdir())
+    except OSError:
+        return 0
+    for p in it:
+        if not p.name.endswith(".npz"):
+            continue
+        try:
+            st = p.stat()
+        except OSError:
+            continue   # vanished under a concurrent pod's sweep
+        entries.append((st.st_mtime, st.st_size, p))
+        total += st.st_size
+    if total <= cap:
+        return 0
+    evicted = 0
+    for _, size, p in sorted(entries):
+        if total <= cap:
+            break
+        try:
+            p.unlink()
+        except OSError:
+            continue   # already gone: the other pod won the race
+        total -= size
+        evicted += 1
+    if evicted:
+        get_metrics().counter("encode.cache_evictions").add(evicted)
+    return evicted

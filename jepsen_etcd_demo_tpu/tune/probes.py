@@ -41,6 +41,12 @@ Groups (the `group` metadata on KernelLimits fields, ops/limits.py):
                  `elle_batch_floor` / `elle_density_threshold_pct` /
                  `elle_stream_flush` on fixed-seed dependency graphs
                  and a fixed txn stream (every route verdict-exact).
+  spill        — the out-of-core spill tier (store/spill.py +
+                 stream/longhaul.py): `host_spill_mode` /
+                 `host_rss_budget_mb` / `spill_compress_mode` /
+                 `encode_cache_cap_mb` via a fixed multi-segment
+                 long-haul mini-lane through a scratch SpillDir
+                 (verdict-exact in every mode).
 
 Every measurement is warmup-then-best-of-N: the warmup call eats the
 compile (the persistent XLA cache makes it cheap on re-tunes), the min
@@ -67,6 +73,7 @@ SEED_STREAM = 0x57E4
 SEED_DEDUP = 0xDED0
 SEED_ELLE = 0xE17E
 SEED_POD = 0x90D5
+SEED_SPILL = 0x5B11
 
 # Per-knob limit pins applied UNDER the candidate override while probing
 # (e.g. the density threshold only matters once the sparse engine is
@@ -77,6 +84,11 @@ KNOB_PINS: dict[str, dict[str, int]] = {
     # only matters once the table pass is forced on.
     "dedup_hash_slots": {"sparse_mode": 2, "sparse_min_tiles": 1},
     "dedup_min_frontier": {"dedup_mode": 2},
+    # Spill-window / codec knobs only matter once the out-of-core tier
+    # is actually engaged, so their probes pin force-spill.
+    "host_rss_budget_mb": {"host_spill_mode": 2},
+    "spill_compress_mode": {"host_spill_mode": 2},
+    "encode_cache_cap_mb": {"host_spill_mode": 2},
 }
 
 
@@ -557,6 +569,48 @@ class PodProbe:
             self.ctx.repeats)
 
 
+class SpillProbe:
+    """Out-of-core spill-tier knobs (ISSUE 20): a fixed multi-segment
+    long-haul mini-lane (stream/longhaul.py) replayed through an active
+    scratch SpillDir. host_spill_mode off/auto/force trades disk I/O
+    against host RSS; spill_compress_mode trades canon-quotient encode
+    cycles against checkpoint bytes; the RSS budget and encode-cache
+    cap steer the in-RAM window and GC cadence. Every mode is
+    verdict-exact (store/spill.py), so the search may pick whatever
+    measures fastest on this host's disk."""
+
+    knobs = ("host_spill_mode", "host_rss_budget_mb",
+             "spill_compress_mode", "encode_cache_cap_mb")
+
+    def __init__(self, ctx: ProbeContext):
+        self.ctx = ctx
+        self.events = ctx.n(60_000, 6_000)
+        self.seg_events = max(1024, ctx.n(8192, 1024))
+
+    def measure(self, knob: str, overrides: dict[str, int]) -> float:
+        import shutil
+        import tempfile
+
+        from ..store import spill
+        from ..stream import longhaul
+
+        def lane():
+            td = tempfile.mkdtemp(prefix="jepsen-spill-probe-")
+            try:
+                with spill.spilling(td):
+                    res = longhaul.run_longhaul(
+                        self.ctx.model, events=self.events,
+                        seg_events=self.seg_events, seed=SEED_SPILL,
+                        resume=False)
+                assert res["survived"], \
+                    "spill probe fixture must survive"
+                return res
+            finally:
+                shutil.rmtree(td, ignore_errors=True)
+
+        return _with_overrides(overrides, lane, self.ctx.repeats)
+
+
 class ProbeUnavailable(RuntimeError):
     """This probe group cannot run on this backend (recorded as skipped,
     never an error — a CPU tune simply has no pallas lane)."""
@@ -574,4 +628,5 @@ PROBES = {
     "dedup": DedupProbe,
     "elle": ElleProbe,
     "pod": PodProbe,
+    "spill": SpillProbe,
 }

@@ -36,9 +36,13 @@ def gen_register_history(
     p_write: float = 0.35,
     p_info: float = 0.05,
     p_fail_read: float = 0.05,
+    initial_value: Optional[int] = None,
 ) -> list[Op]:
-    """Generate a valid (linearizable) single-register history."""
-    value: Optional[int] = None  # the register; None == key missing
+    """Generate a valid (linearizable) single-register history.
+    `initial_value` seeds the simulated register (None == key missing) —
+    the out-of-core segment chain (stream/longhaul.py) uses it so each
+    segment is valid FROM the previous segment's final state."""
+    value = initial_value  # the register; None == key missing
     history: list[Op] = []
     # pending: proc -> dict(op fields, linearized?, result)
     pending: dict[int, dict] = {}

@@ -241,6 +241,7 @@ def _perf_summary_html(run_dir) -> str:
     bits += _dedup_bits(run_dir)
     bits += _stream_gauge_bits(run_dir)
     bits += _elle_bits(run_dir)
+    bits += _spill_bits(run_dir)
     shown = [f"{name}: <b>{html.escape(val)}</b>"
              for name, val in bits if val]
     return f"<p class='a'>{' · '.join(shown)}</p>" if shown else ""
@@ -292,6 +293,44 @@ def _elle_bits(run_dir) -> list[tuple[str, str]]:
     txns = counter("elle.stream_txns")
     if txns:
         out.append(("elle streamed txns", f"{txns:,}"))
+    return out
+
+
+def _spill_bits(run_dir) -> list[tuple[str, str]]:
+    """Out-of-core spill-tier telemetry (ISSUE 20, store/spill.py +
+    store/encode_cache.py) for the strip: spill traffic (writes/reads
+    with byte volumes), checkpoint compression ratio, eviction counts
+    (window + encode-cache GC), and the long-haul lane's peak-RSS delta
+    — all blank for runs that never spilled."""
+    try:
+        metrics = read_metrics(run_dir / METRICS_FILE)
+    except Exception:
+        return []
+
+    def counter(name: str) -> int:
+        c = metrics.get(name) or {}
+        return int(c.get("value") or 0) if c.get("type") == "counter" \
+            else 0
+
+    out: list[tuple[str, str]] = []
+    w, r = counter("spill.writes"), counter("spill.reads")
+    if w or r:
+        out.append(("spill",
+                    f"{w} w / {r} r "
+                    f"({counter('spill.bytes_written') / (1 << 20):.1f}"
+                    f" / {counter('spill.bytes_read') / (1 << 20):.1f}"
+                    " MB)"))
+    g = metrics.get("spill.compress_ratio") or {}
+    if g.get("type") == "gauge" and g.get("n") \
+            and isinstance(g.get("last"), (int, float)):
+        out.append(("spill compress", f"{g['last']:.2f}x"))
+    ev = counter("spill.evictions") + counter("encode.cache_evictions")
+    if ev:
+        out.append(("spill evictions", f"{ev:,}"))
+    g = metrics.get("spill.peak_rss_mb") or {}
+    if g.get("type") == "gauge" and g.get("n") \
+            and isinstance(g.get("last"), (int, float)):
+        out.append(("long-haul peak rss", f"{g['last']:g} MB"))
     return out
 
 

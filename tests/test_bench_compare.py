@@ -501,3 +501,75 @@ def test_check_fleet_record_gates_lane_arms_and_parity():
     del rec["detail"]["fleet"]
     assert any("omits the detail.fleet lane" in p
                for p in bench_compare.check_fleet_record(rec))
+
+
+# -- long-haul out-of-core lane (ISSUE 20) ----------------------------------
+
+def _longhaul_stats() -> dict:
+    return {k: 0 for k in bench_compare.LONGHAUL_STATS_KEYS}
+
+
+def _longhaul_record(eps: float = 10000.0,
+                     peak_rss_mb: float = 40.0) -> dict:
+    rec = _record(1000.0)
+    rec["longhaul"] = _longhaul_stats()
+    rec["detail"]["longhaul"] = {
+        "events": 120000, "segments": 8, "segments_run": 8,
+        "survived": True, "dead_step": -1, "max_frontier": 4,
+        "escalations": 0, "spilled": True, "wall_s": 12.0,
+        "events_per_sec": eps, "peak_rss_mb": peak_rss_mb,
+        "rss_budget_mb": 512, "rss_ok": True,
+        "verdicts_identical": True, "crosscheck_events": 120000,
+    }
+    return rec
+
+
+def test_longhaul_eps_gated_and_peak_rss_inverted():
+    """Throughput gates like every lane; the RSS ceiling gates
+    INVERTED — more resident bytes is the regression the out-of-core
+    tier exists to prevent."""
+    res = bench_compare.compare(_longhaul_record(eps=10000.0),
+                                _longhaul_record(eps=7000.0),
+                                threshold_pct=10.0)
+    assert "longhaul_eps" in res["regressions"]
+    res = bench_compare.compare(
+        _longhaul_record(peak_rss_mb=40.0),
+        _longhaul_record(peak_rss_mb=400.0), threshold_pct=10.0)
+    assert "longhaul_peak_rss_mb" in res["regressions"]
+    # Lower RSS is an improvement, never a regression.
+    res = bench_compare.compare(
+        _longhaul_record(peak_rss_mb=400.0),
+        _longhaul_record(peak_rss_mb=40.0), threshold_pct=10.0)
+    assert res["regressions"] == []
+
+
+def test_check_longhaul_record_requires_object_on_every_record():
+    rec = _record(1000.0)
+    assert bench_compare.check_longhaul_record(rec) == \
+        ["record omits the `longhaul` object entirely"]
+    rec["longhaul"] = _longhaul_stats()
+    del rec["longhaul"]["peak_rss_mb"]
+    assert any("peak_rss_mb" in p
+               for p in bench_compare.check_longhaul_record(rec))
+
+
+def test_check_longhaul_record_degraded_needs_only_zeros():
+    rec = {"value": 0, "degraded": True, "backend": "none",
+           "longhaul": _longhaul_stats()}
+    assert bench_compare.check_longhaul_record(rec) == []
+
+
+def test_check_longhaul_record_gates_lane_parity_and_ceiling():
+    rec = _longhaul_record()
+    assert bench_compare.check_longhaul_record(rec) == []
+    rec["detail"]["longhaul"]["verdicts_identical"] = False
+    assert any("verdict parity" in p
+               for p in bench_compare.check_longhaul_record(rec))
+    rec = _longhaul_record()
+    rec["detail"]["longhaul"]["rss_ok"] = False
+    assert any("RSS budget" in p
+               for p in bench_compare.check_longhaul_record(rec))
+    rec = _longhaul_record()
+    del rec["detail"]["longhaul"]
+    assert any("omits the detail.longhaul lane" in p
+               for p in bench_compare.check_longhaul_record(rec))

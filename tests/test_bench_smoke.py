@@ -34,6 +34,13 @@ def _assert_ledger_zeros(out: dict) -> None:
     for key in bench_compare.FLEET_STATS_KEYS:
         assert fl[key] == 0, (key, fl)
     assert bench_compare.check_fleet_record(out) == []
+    # ISSUE 20: the out-of-core spill-tier stats object rides the same
+    # contract — all keys present as zeros on every degraded path, and
+    # the long-haul schema gate passes the record.
+    lh = out["longhaul"]
+    for key in bench_compare.LONGHAUL_STATS_KEYS:
+        assert lh[key] == 0, (key, lh)
+    assert bench_compare.check_longhaul_record(out) == []
 
 
 def test_sched_corpus_lane_contract():
@@ -69,7 +76,8 @@ def test_sched_corpus_lane_contract():
     assert att["coverage"] >= 0.95, att
     assert set(att["buckets"]) == {
         "encode_s", "h2d_s", "compile_s", "execute_s", "padding_s",
-        "straggler_s", "dispatch_gap_s", "other_s"}
+        "straggler_s", "dispatch_gap_s", "spill_read_s",
+        "spill_write_s", "other_s"}
     assert att["buckets"]["execute_s"] > 0
     assert "ledger_overhead_pct" in lane
 
@@ -300,3 +308,36 @@ def test_elle_lane_contract(tmp_path, monkeypatch):
     assert lane["txns_per_sec"] > 0
     # The tiny-scale pin landed in the scratch file, not the repo's.
     assert (tmp_path / "bench_baseline.json").exists()
+
+
+def test_longhaul_lane_contract():
+    """The long-haul out-of-core lane at tiny scale (ISSUE 20): every
+    contract field present and JSON-serializable, the spilled route's
+    verdict cross-checked bit-identical against the in-RAM route, RSS
+    delta under the lane's pinned budget, and the zero-lane (degraded
+    paths) carrying exactly the same key set."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(bench.__file__).resolve().parent
+                           / "tools"))
+    import bench_compare
+
+    model = CASRegister()
+    lane = bench.bench_longhaul(model, events=16_384, seg_events=2048)
+    json.dumps(lane)
+    for key in bench_compare.LONGHAUL_LANE_KEYS:
+        assert key in lane, key
+    assert lane["spilled"] is True
+    assert lane["survived"] is True and lane["dead_step"] == -1
+    assert lane["verdicts_identical"] is True
+    assert lane["crosscheck_events"] == 16_384
+    assert lane["events_per_sec"] > 0
+    assert lane["rss_ok"] is True
+    assert lane["peak_rss_mb"] <= lane["rss_budget_mb"]
+    # The zero-lane (every degraded path's longhaul object) carries the
+    # same keys the gate requires of a healthy record.
+    zero = bench.longhaul_zero_lane()
+    for key in bench_compare.LONGHAUL_LANE_KEYS:
+        assert key in zero, key
+    assert zero["survived"] is False and zero["rss_ok"] is False

@@ -1,0 +1,205 @@
+"""Long-haul out-of-core lane (ISSUE 20, stream/longhaul.py): segment
+chaining is bit-identical to whole-history checking (surviving AND
+dead, exact global dead step), the spilled route matches the in-RAM
+route under a pinned RSS-delta ceiling, and a crash mid-lane resumes
+from the segment-chain checkpoint (torn checkpoint -> recompute from
+scratch, never a wrong verdict)."""
+
+import random
+import shutil
+import tempfile
+
+import pytest
+
+from jepsen_etcd_demo_tpu import obs
+from jepsen_etcd_demo_tpu.models import CASRegister
+from jepsen_etcd_demo_tpu.ops import wgl2
+from jepsen_etcd_demo_tpu.ops.encode import encode_register_history
+from jepsen_etcd_demo_tpu.ops.limits import KernelLimits, set_limits
+from jepsen_etcd_demo_tpu.store import spill
+from jepsen_etcd_demo_tpu.stream import longhaul
+from jepsen_etcd_demo_tpu.utils.fuzz import mutate_history
+
+_VERDICT_KEYS = ("survived", "dead_step")
+
+
+def _whole_history(seed, n_segments, n_ops_per_seg, *, mutate_segment=None,
+                   n_procs=4, value_range=5):
+    """The materialized history the lane refuses to build — segments
+    concatenated, re-indexed; the parity oracle for small scales."""
+    hist = []
+    for k in range(n_segments):
+        seg = longhaul.segment_history(seed, k, n_ops_per_seg,
+                                       n_procs=n_procs,
+                                       value_range=value_range)
+        if mutate_segment is not None and k == mutate_segment:
+            seg = mutate_history(
+                random.Random(f"{seed}|mut|{k}"), seg,
+                value_range=value_range)
+        hist.extend(seg)
+    for i, op in enumerate(hist):
+        op.index = i
+        op.time = i * 1000
+    return hist
+
+
+def test_segment_history_is_deterministic_and_anchored():
+    a = longhaul.segment_history(7, 3, 40)
+    b = longhaul.segment_history(7, 3, 40)
+    assert [(o.type, o.f, o.value, o.process) for o in a] \
+        == [(o.type, o.f, o.value, o.process) for o in b]
+    # Closed by the anchor write: the last two events are the anchor's
+    # invoke/ok with the (seed, k)-derived value.
+    w = longhaul.anchor_value(7, 3, 5)
+    assert a[-2].type == "invoke" and a[-2].f == "write" \
+        and a[-2].value == w
+    assert a[-1].type == "ok" and a[-1].value == w
+    # No INFO ops: segments are quiescent at both ends by construction.
+    assert all(op.type != "info" for op in a)
+
+
+@pytest.mark.parametrize("mutate_segment", [None, 2])
+def test_longhaul_matches_whole_history_check(mutate_segment):
+    model = CASRegister()
+    seed, seg_events, events = 0xA11, 1024, 4096
+    n_ops = max(2, seg_events // 2)
+    n_segments = (events + seg_events - 1) // seg_events
+    res = longhaul.run_longhaul(model, events=events,
+                                seg_events=seg_events, seed=seed,
+                                mutate_segment=mutate_segment)
+    hist = _whole_history(seed, n_segments, n_ops,
+                          mutate_segment=mutate_segment)
+    if res["survived"]:   # a dead lane stops counting at its segment
+        assert res["events"] == len(hist)
+    enc = encode_register_history(hist, k_slots=32)
+    whole = wgl2.check_encoded_resumable(enc, model, f_cap=256)
+    assert {k: res[k] for k in _VERDICT_KEYS} \
+        == {k: whole[k] for k in _VERDICT_KEYS}
+    if mutate_segment is not None:
+        assert res["survived"] is False
+        assert res["dead_step"] > 0   # a real global return-step index
+
+
+def test_longhaul_spilled_matches_in_ram_under_rss_ceiling(tmp_path):
+    model = CASRegister()
+    kw = dict(events=24_000, seg_events=2048, seed=0xA12)
+    # Warmup pays the XLA compile RSS spike so the measured lane's
+    # ru_maxrss DELTA reflects the checker's working set, not the
+    # first-compile allocator high-water mark.
+    longhaul.run_longhaul(model, events=4096, seg_events=2048,
+                          seed=0xA12 ^ 0x5A5A)
+    prev = set_limits(KernelLimits(host_spill_mode=1))
+    try:
+        ram = longhaul.run_longhaul(model, **kw)
+        set_limits(KernelLimits(host_spill_mode=2,
+                                host_rss_budget_mb=512))
+        with obs.capture(tmp_path / "run"), \
+                spill.spilling(tmp_path / "spool") as sdir:
+            spilled = longhaul.run_longhaul(model, **kw)
+            assert sdir.names() == [], "lane must clean its checkpoints"
+            m = obs.get_metrics()
+            assert m.counter("spill.writes").value > 0
+            assert m.gauge("spill.peak_rss_mb").n == 1
+    finally:
+        set_limits(prev)
+    assert ram["spilled"] is False and spilled["spilled"] is True
+    for k in _VERDICT_KEYS + ("events", "segments", "max_frontier"):
+        assert ram[k] == spilled[k], k
+    assert spilled["rss_budget_mb"] == 512
+    assert spilled["peak_rss_mb"] <= 512 and spilled["rss_ok"] is True
+
+
+def test_longhaul_crash_resume_and_torn_checkpoint(tmp_path, monkeypatch):
+    model = CASRegister()
+    kw = dict(events=8192, seg_events=1024, seed=0xA13, tag="crash")
+    fresh = longhaul.run_longhaul(model, **kw)   # in-RAM oracle
+
+    calls = {"n": 0}
+    orig = wgl2.check_encoded_resumable
+
+    def crashy(*a, **kws):
+        calls["n"] += 1
+        if calls["n"] > 3:
+            raise RuntimeError("simulated crash")
+        return orig(*a, **kws)
+
+    prev = set_limits(KernelLimits(host_spill_mode=2))
+    try:
+        with spill.spilling(tmp_path / "spool") as sdir:
+            monkeypatch.setattr(wgl2, "check_encoded_resumable", crashy)
+            with pytest.raises(RuntimeError, match="simulated crash"):
+                longhaul.run_longhaul(model, **kw)
+            monkeypatch.setattr(wgl2, "check_encoded_resumable", orig)
+            # The chain checkpoint from the last COMPLETED segment
+            # survived the crash; the resumed lane runs only the rest.
+            assert sdir.read("crash.seg") is not None
+            resumed = longhaul.run_longhaul(model, **kw)
+            assert resumed["resumed_from"] == 3
+            assert resumed["segments_run"] == fresh["segments"] - 3
+            for k in _VERDICT_KEYS:
+                assert resumed[k] == fresh[k]
+            assert sdir.names() == []   # consumed on completion
+
+            # Torn chain checkpoint: decodes as absent -> the lane
+            # recomputes from segment 0 — slower, never wrong.
+            with pytest.raises(RuntimeError):
+                calls["n"] = 0
+                monkeypatch.setattr(wgl2, "check_encoded_resumable",
+                                    crashy)
+                longhaul.run_longhaul(model, **kw)
+            monkeypatch.setattr(wgl2, "check_encoded_resumable", orig)
+            path = sdir.path("crash.seg")
+            path.write_bytes(path.read_bytes()[:25])
+            recomputed = longhaul.run_longhaul(model, **kw)
+            assert recomputed["resumed_from"] == -1
+            assert recomputed["segments_run"] == fresh["segments"]
+            for k in _VERDICT_KEYS:
+                assert recomputed[k] == fresh[k]
+    finally:
+        set_limits(prev)
+
+
+def test_longhaul_tier1_smoke_spilled_route_bit_identical():
+    """The scaled-down tier-1 gate (ISSUE 20 satellite 5): a long-haul
+    lane big enough to cross many segment boundaries, spilled verdicts
+    bit-identical to in-RAM — the cheap always-on version of the bench
+    lane's full cross-check."""
+    model = CASRegister()
+    kw = dict(events=12_000, seg_events=1024, seed=0xA14)
+    prev = set_limits(KernelLimits(host_spill_mode=1))
+    td = tempfile.mkdtemp(prefix="jepsen-lh-smoke-")
+    try:
+        ram = longhaul.run_longhaul(model, **kw)
+        set_limits(KernelLimits(host_spill_mode=2))
+        with spill.spilling(td):
+            spilled = longhaul.run_longhaul(model, **kw)
+    finally:
+        set_limits(prev)
+        shutil.rmtree(td, ignore_errors=True)
+    assert spilled["spilled"] is True and ram["spilled"] is False
+    for k in _VERDICT_KEYS + ("events", "segments", "max_frontier",
+                              "escalations"):
+        assert ram[k] == spilled[k], k
+
+
+@pytest.mark.slow
+def test_longhaul_million_event_lane(tmp_path):
+    """The full-size lane (10^6 events) never materializes the history
+    and stays under the RSS budget; slow-marked — the bench lane and
+    the scaled-down smoke above carry the tier-1 guarantee."""
+    model = CASRegister()
+    longhaul.run_longhaul(model, events=8192, seg_events=8192,
+                          seed=0xBEEF ^ 0x5A5A)   # compile warmup
+    prev = set_limits(KernelLimits(host_spill_mode=2,
+                                   host_rss_budget_mb=512))
+    try:
+        with obs.capture(tmp_path / "run"), \
+                spill.spilling(tmp_path / "spool"):
+            res = longhaul.run_longhaul(model, events=1_000_000,
+                                        seed=0xBEEF)
+    finally:
+        set_limits(prev)
+    assert res["survived"] is True
+    assert res["events"] >= 1_000_000
+    assert res["spilled"] is True
+    assert res["rss_ok"] is True, res["peak_rss_mb"]

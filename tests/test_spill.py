@@ -1,0 +1,405 @@
+"""Out-of-core spill tier (ISSUE 20): frontier codec bit-identity
+(golden + fuzz, raw and canon-quotient modes), torn-blob degradation,
+the SpillDir/SpillWindow disk tiers, the encode-cache size-capped LRU
+GC, and spill/resume bit-identity through the wgl2 sort ladder (across
+escalation boundaries), the wgl3 seam checkpoints, and the streamed
+elle closure."""
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_etcd_demo_tpu import obs
+from jepsen_etcd_demo_tpu.models import CASRegister
+from jepsen_etcd_demo_tpu.ops.encode import (encode_register_history,
+                                             encode_return_steps)
+from jepsen_etcd_demo_tpu.ops.limits import (KernelLimits, limits,
+                                             set_limits)
+from jepsen_etcd_demo_tpu.store import encode_cache
+from jepsen_etcd_demo_tpu.store import spill
+from jepsen_etcd_demo_tpu.utils.fuzz import gen_register_history, \
+    mutate_history
+
+
+def _rand_frontier(rng, f, w):
+    states = np.asarray([rng.randrange(-5, 100) for _ in range(f)],
+                        np.int32)
+    masks = np.asarray([[rng.randrange(0, 1 << 32) for _ in range(w)]
+                        for _ in range(f)], np.uint32)
+    valid = np.asarray([rng.random() < 0.7 for _ in range(f)], bool)
+    return states, masks, valid
+
+
+def _assert_roundtrip(states, masks, valid, **kw):
+    d = spill.decode_frontier(
+        spill.encode_frontier(states, masks, valid, **kw))
+    assert d is not None
+    np.testing.assert_array_equal(d["states"], states)
+    np.testing.assert_array_equal(d["masks"], masks)
+    np.testing.assert_array_equal(d["valid"], valid)
+    return d
+
+
+# -- codec ------------------------------------------------------------------
+
+def test_codec_golden_raw_roundtrip():
+    states = np.asarray([3, -1, 7], np.int32)
+    masks = np.asarray([[0x5, 0x0], [0xFFFFFFFF, 0x1], [0x0, 0x80]],
+                       np.uint32)
+    valid = np.asarray([True, False, True])
+    d = _assert_roundtrip(states, masks, valid, mode=1)
+    assert d["mode"] == "raw"
+    assert d["raw_bytes"] == states.nbytes + masks.nbytes + valid.nbytes
+
+
+def test_codec_golden_canon_roundtrip():
+    # Class {0,1,2}: fired bits packed low (counts 2 / 0 / 3) — the
+    # canonical layout ops/canon.py produces. Bit 5 is residual.
+    classes = [[0, 1, 2]]
+    states = np.asarray([1, 2, 3], np.int32)
+    masks = np.asarray([[0b100011], [0b000000], [0b100111]], np.uint32)
+    valid = np.ones(3, bool)
+    d = _assert_roundtrip(states, masks, valid, classes=classes, mode=2)
+    assert d["mode"] == "canon"
+
+
+def test_codec_canon_force_mode_rejects_noncanonical():
+    # Bit 1 fired without bit 0: not packed-low for class {0,1}.
+    masks = np.asarray([[0b10]], np.uint32)
+    with pytest.raises(ValueError):
+        spill.encode_frontier(np.asarray([0], np.int32), masks,
+                              np.ones(1, bool), classes=[[0, 1]], mode=2)
+    # Auto mode: same frontier silently takes the raw fallback.
+    d = _assert_roundtrip(np.asarray([0], np.int32), masks,
+                          np.ones(1, bool), classes=[[0, 1]], mode=0)
+    assert d["mode"] == "raw"
+
+
+def test_codec_fuzz_roundtrip_both_modes():
+    rng = random.Random(0x5B1)
+    for _ in range(20):
+        f = rng.randrange(1, 40)
+        w = rng.randrange(1, 4)
+        states, masks, valid = _rand_frontier(rng, f, w)
+        _assert_roundtrip(states, masks, valid, mode=1)
+        # Canonical variant: pick classes and re-pack the class bits
+        # low per row so the canon route engages, then demand identity.
+        n_bits = 32 * w
+        bits = sorted(rng.sample(range(n_bits), min(6, n_bits)))
+        classes = [bits[:3], bits[3:]] if len(bits) >= 5 else [bits]
+        classes = [c for c in classes if len(c) > 1]
+        for row in range(f):
+            for cls in classes:
+                cnt = sum((masks[row, b // 32] >> (b % 32)) & 1
+                          for b in cls)
+                for j, b in enumerate(cls):
+                    if j < cnt:
+                        masks[row, b // 32] |= np.uint32(1 << (b % 32))
+                    else:
+                        masks[row, b // 32] &= np.uint32(
+                            ~(1 << (b % 32)) & 0xFFFFFFFF)
+        d = _assert_roundtrip(states, masks, valid, classes=classes,
+                              mode=2)
+        if valid.any() and classes:
+            assert d["mode"] == "canon"
+
+
+def test_codec_torn_blob_reads_as_absent():
+    rng = random.Random(0x70E)
+    states, masks, valid = _rand_frontier(rng, 8, 2)
+    blob = spill.encode_frontier(states, masks, valid)
+    assert spill.decode_frontier(None) is None
+    assert spill.decode_frontier(b"") is None
+    assert spill.decode_frontier(blob[:-7]) is None          # truncated
+    corrupt = bytearray(blob)
+    corrupt[len(blob) // 2] ^= 0xFF
+    assert spill.decode_frontier(bytes(corrupt)) is None     # bit flip
+    assert spill.decode_frontier(b"NOTSPILL" + blob[8:]) is None
+
+
+def test_classes_from_pairs():
+    assert spill.classes_from_pairs(None) == []
+    pairs = np.asarray([[0, 1], [1, 2], [4, 5], [-1, -1]])
+    assert spill.classes_from_pairs(pairs) == [[0, 1, 2], [4, 5]]
+
+
+# -- disk tiers -------------------------------------------------------------
+
+def test_spilldir_write_read_append_delete(tmp_path):
+    with obs.capture(tmp_path / "run"):
+        sdir = spill.SpillDir(tmp_path / "spool")
+        assert sdir.read("absent") is None
+        assert sdir.write("a", b"hello") is not None
+        assert sdir.read("a") == b"hello"
+        assert sdir.append("runs", b"one")
+        assert sdir.append("runs", b"two")
+        assert sdir.read("runs") == b"onetwo"
+        assert sdir.names() == ["a", "runs"]
+        sdir.delete("a")
+        sdir.delete("a")    # idempotent
+        assert sdir.names() == ["runs"]
+        m = obs.get_metrics()
+        assert m.counter("spill.writes").value == 3
+        assert m.counter("spill.reads").value == 2   # misses uncounted
+        assert m.counter("spill.bytes_written").value == len(b"hello") \
+            + len(b"one") + len(b"two")
+
+
+def test_spillwindow_evicts_oldest_and_rereads_disk(tmp_path):
+    with obs.capture(tmp_path / "run"):
+        sdir = spill.SpillDir(tmp_path / "spool")
+        win = spill.SpillWindow(sdir, budget_mb=3 / 1024)  # 3 KiB
+        blobs = {f"b{i}": bytes([i]) * 1024 for i in range(5)}
+        for name, blob in blobs.items():
+            win.put(name, blob)
+        assert win.resident_bytes <= win.budget_bytes
+        m = obs.get_metrics()
+        assert m.counter("spill.evictions").value >= 2
+        reads_before = m.counter("spill.reads").value
+        for name, blob in blobs.items():   # evicted copies re-read disk
+            assert win.get(name) == blob
+        assert m.counter("spill.reads").value > reads_before
+        assert win.get("b4") == blobs["b4"]   # resident: no extra read
+
+
+def test_frontier_spill_load_and_compress_gauge(tmp_path):
+    rng = random.Random(0xF0)
+    with obs.capture(tmp_path / "run"):
+        sdir = spill.SpillDir(tmp_path / "spool")
+        states, masks, valid = _rand_frontier(rng, 16, 2)
+        assert spill.spill_frontier(sdir, "f.ck", states, masks, valid,
+                                    meta={"pos": 3}) is not None
+        d = spill.load_frontier(sdir, "f.ck")
+        np.testing.assert_array_equal(d["states"], states)
+        np.testing.assert_array_equal(d["masks"], masks)
+        np.testing.assert_array_equal(d["valid"], valid)
+        assert d["meta"] == {"pos": 3}
+        assert obs.get_metrics().gauge("spill.compress_ratio").n == 1
+        # Torn on disk -> absent -> caller recomputes.
+        path = sdir.path("f.ck")
+        path.write_bytes(path.read_bytes()[:40])
+        assert spill.load_frontier(sdir, "f.ck") is None
+
+
+def test_spill_active_modes():
+    prev = set_limits(KernelLimits(host_spill_mode=1))
+    try:
+        assert spill.spill_active(1e9) is False
+        set_limits(KernelLimits(host_spill_mode=2))
+        assert spill.spill_active(None) is True
+        set_limits(KernelLimits(host_spill_mode=0,
+                                host_rss_budget_mb=100))
+        assert spill.spill_active(50) is False
+        assert spill.spill_active(200) is True
+        assert spill.spill_active(None) is False
+    finally:
+        set_limits(prev)
+
+
+# -- encode-cache GC --------------------------------------------------------
+
+def test_encode_cache_gc_evicts_lru(tmp_path):
+    rng = random.Random(0x6C)
+    model = CASRegister()
+    hists = [gen_register_history(rng, n_ops=30, n_procs=3)
+             for _ in range(6)]
+    with obs.capture(tmp_path / "run"), \
+            encode_cache.activated(tmp_path / "cache"):
+        import os
+        import time
+        for i, h in enumerate(hists):
+            encode_cache.store(
+                h, model.name, 16,
+                encode_register_history(h, k_slots=16))
+            # Distinct mtimes back in time, oldest first (utime beats
+            # the fs clock granularity the sweep sorts on).
+            p = encode_cache._entry_path(
+                encode_cache.history_fingerprint(h, model.name, 16))
+            t = time.time() - (len(hists) - i) * 1000
+            os.utime(p, (t, t))
+        entry = encode_cache._entry_path(
+            encode_cache.history_fingerprint(
+                hists[0], model.name, 16)).stat()
+        total_mb = entry.st_size * len(hists) / (1 << 20)
+        # Touch the OLDEST entry via a lookup hit: it must now survive
+        # a sweep that evicts half the cache.
+        assert encode_cache.lookup(hists[0], model.name, 16) is not None
+        evicted = encode_cache.gc(cap_mb=total_mb / 2)
+        assert evicted >= 2
+        assert obs.get_metrics() \
+            .counter("encode.cache_evictions").value == evicted
+        assert encode_cache.lookup(hists[0], model.name, 16) is not None
+        assert encode_cache.lookup(hists[1], model.name, 16) is None
+        # cap 0 = unbounded: never evicts.
+        assert encode_cache.gc(cap_mb=0) == 0
+
+
+# -- wgl2 sort-ladder spill/resume bit-identity -----------------------------
+
+def _sort_path_history(rng, n_ops=60, n_procs=6, mutate=False):
+    h = gen_register_history(rng, n_ops=n_ops, n_procs=n_procs,
+                             p_info=0.05)
+    if mutate:
+        h = mutate_history(rng, h)
+    for op in h:
+        if isinstance(op.value, int):
+            op.value = op.value * 211
+        elif isinstance(op.value, tuple):
+            op.value = tuple(v * 211 for v in op.value)
+    return h
+
+
+_RESULT_KEYS = ("survived", "dead_step", "max_frontier", "f_cap",
+                "escalations", "valid")
+
+
+@pytest.mark.parametrize("mutate", [False, True])
+def test_wgl2_spill_resume_bit_identical_across_escalations(
+        tmp_path, mutate):
+    from jepsen_etcd_demo_tpu.ops.wgl2 import check_steps_resumable
+    rng = random.Random(0x5F1 + mutate)
+    model = CASRegister()
+    h = _sort_path_history(rng, mutate=mutate)
+    rs = encode_return_steps(encode_register_history(h, k_slots=16))
+    # Baseline: the seed's all-RAM route (tiny f_cap forces the
+    # checkpointed escalation ladder the spill must be identical under).
+    base = check_steps_resumable(rs, model, f_cap=4, chunk=8)
+    assert base["escalations"] > 0 or mutate
+    prev = set_limits(KernelLimits(host_spill_mode=2))
+    try:
+        with obs.capture(tmp_path / "run"), \
+                spill.spilling(tmp_path / "spool") as sdir:
+            spilled = check_steps_resumable(rs, model, f_cap=4, chunk=8,
+                                            spill_tag="t")
+            assert {k: spilled[k] for k in _RESULT_KEYS} \
+                == {k: base[k] for k in _RESULT_KEYS}
+            if base["survived"] or base["dead_step"] >= 8:
+                assert sdir.read("t.ck") is not None   # ckpts landed
+            # Re-entry resumes from the last spilled boundary and must
+            # reach the SAME verdict (the crash-resume contract).
+            resumed = check_steps_resumable(rs, model, f_cap=4, chunk=8,
+                                            spill_tag="t")
+            for k in ("survived", "dead_step", "valid"):
+                assert resumed[k] == base[k]
+            # Torn checkpoint: degrade to recompute, never a wrong
+            # verdict. (A death inside chunk 0 never spills — the
+            # recompute then just runs from scratch again.)
+            path = sdir.path("t.ck")
+            if path.exists():
+                path.write_bytes(path.read_bytes()[:33])
+            recomputed = check_steps_resumable(
+                rs, model, f_cap=4, chunk=8, spill_tag="t")
+            assert {k: recomputed[k] for k in _RESULT_KEYS} \
+                == {k: base[k] for k in _RESULT_KEYS}
+    finally:
+        set_limits(prev)
+
+
+def test_wgl2_spill_resume_carries_frontier_identically(tmp_path):
+    """The resumed run's FINAL frontier (the out-of-core segment carry)
+    must match the all-RAM run's bit for bit — the quantity longhaul
+    chains between segments."""
+    from jepsen_etcd_demo_tpu.ops.wgl2 import check_steps_resumable
+    rng = random.Random(0x5F7)
+    model = CASRegister()
+    h = _sort_path_history(rng, n_ops=40, n_procs=4)
+    rs = encode_return_steps(encode_register_history(h, k_slots=16))
+    base = check_steps_resumable(rs, model, f_cap=4, chunk=8,
+                                 return_frontier=True)
+    prev = set_limits(KernelLimits(host_spill_mode=2))
+    try:
+        with obs.capture(tmp_path / "run"), \
+                spill.spilling(tmp_path / "spool"):
+            out = check_steps_resumable(rs, model, f_cap=4, chunk=8,
+                                        spill_tag="fr",
+                                        return_frontier=True)
+    finally:
+        set_limits(prev)
+    for a, b in zip(base["frontier"], out["frontier"]):
+        np.testing.assert_array_equal(a, b)
+
+
+# -- wgl3 dense seam spill/resume -------------------------------------------
+
+def test_wgl3_seam_spill_resume_bit_identical(tmp_path):
+    from jepsen_etcd_demo_tpu.ops.wgl3 import (check_steps3_long,
+                                               dense_config,
+                                               tight_k_slots)
+    rng = random.Random(0x3D5)
+    model = CASRegister()
+    base_by_mutate = {}
+    for mutate in (False, True):
+        h = gen_register_history(rng, n_ops=50, n_procs=4, p_info=0.05)
+        if mutate:
+            h = mutate_history(rng, h)
+        enc = encode_register_history(h, k_slots=16)
+        cfg = dense_config(model, tight_k_slots(enc), enc.max_value)
+        assert cfg is not None, "test must exercise the dense path"
+        rs = encode_return_steps(enc)
+        # Poll every chunk so seams spill at every boundary; sparse off
+        # so the table (not a gathered carry) route runs.
+        prev = set_limits(KernelLimits(sched_poll_chunks=1,
+                                       sparse_mode=1))
+        try:
+            base = check_steps3_long(rs, model, cfg, chunk=8)
+            set_limits(KernelLimits(sched_poll_chunks=1, sparse_mode=1,
+                                    host_spill_mode=2))
+            with obs.capture(tmp_path / f"run{mutate}"), \
+                    spill.spilling(tmp_path / f"spool{mutate}") as sdir:
+                tag = f"w3.{mutate}"
+                out = check_steps3_long(rs, model, cfg, chunk=8,
+                                        spill_tag=tag)
+                assert sdir.read(f"{tag}.ck3") is not None
+                resumed = check_steps3_long(rs, model, cfg, chunk=8,
+                                            spill_tag=tag)
+        finally:
+            set_limits(prev)
+        for k in ("survived", "dead_step", "max_frontier"):
+            assert out[k] == base[k], (mutate, k)
+            assert resumed[k] == base[k], (mutate, k)
+        base_by_mutate[mutate] = base["survived"]
+    assert base_by_mutate[True] is False or base_by_mutate[False]
+
+
+# -- streamed elle closure --------------------------------------------------
+
+def _chunks(edges, rng):
+    edges = list(edges)
+    rng.shuffle(edges)
+    i = 0
+    while i < len(edges):
+        step = rng.randrange(1, 7)
+        yield edges[i:i + step]
+        i += step
+
+
+def test_cycle_mask_stream_matches_dense_ram_and_spilled(tmp_path):
+    from jepsen_etcd_demo_tpu.ops.cycles import cycle_mask, \
+        cycle_mask_stream
+    rng = random.Random(0xC1C)
+    for trial in range(4):
+        n = rng.randrange(5, 60)
+        adj = np.zeros((n, n), bool)
+        for _ in range(rng.randrange(1, 4 * n)):
+            adj[rng.randrange(n), rng.randrange(n)] = True
+        edges = np.argwhere(adj)
+        expect = cycle_mask(adj)
+        got = cycle_mask_stream(n, _chunks(edges.tolist(),
+                                           random.Random(trial)))
+        np.testing.assert_array_equal(got, expect)
+        # Forced-spill route: runs/buckets spool through the SpillDir
+        # and every scratch entry is deleted on the way out.
+        prev = set_limits(KernelLimits(host_spill_mode=2,
+                                       host_rss_budget_mb=64))
+        try:
+            with obs.capture(tmp_path / f"run{trial}"), \
+                    spill.spilling(tmp_path / f"spool{trial}") as sdir:
+                got2 = cycle_mask_stream(
+                    n, _chunks(edges.tolist(), random.Random(~trial)),
+                    tag=f"es{trial}")
+                assert not [s for s in sdir.names()
+                            if s.startswith(f"es{trial}")]
+        finally:
+            set_limits(prev)
+        np.testing.assert_array_equal(got2, expect)

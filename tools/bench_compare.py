@@ -87,6 +87,9 @@ LANES: list[tuple[str, tuple]] = [
     # Fleet lane (ISSUE 18): aggregate events/s at the measured open-
     # loop latency knee — serving capacity at acceptable latency.
     ("fleet_agg_eps", ("detail", "fleet", "agg_eps")),
+    # Long-haul lane (ISSUE 20): out-of-core end-to-end checking
+    # throughput over the spilled route.
+    ("longhaul_eps", ("detail", "longhaul", "events_per_sec")),
 ]
 # Gated lanes where LOWER is better (seconds at the knee): regression
 # when the value RISES past the threshold. Kept separate from LANES so
@@ -94,6 +97,10 @@ LANES: list[tuple[str, tuple]] = [
 INVERTED_LANES: list[tuple[str, tuple]] = [
     # Fleet lane (ISSUE 18): p99 request latency at the knee rung.
     ("fleet_p99_s", ("detail", "fleet", "p99_s")),
+    # Long-haul lane (ISSUE 20): the lane's peak RSS DELTA — the whole
+    # out-of-core claim held to a ceiling; a rise past the leash means
+    # the spill tier stopped bounding host memory.
+    ("longhaul_peak_rss_mb", ("detail", "longhaul", "peak_rss_mb")),
 ]
 # Scaling-efficiency lanes (ISSUE 12): events/s PER CHIP on the mesh
 # and the per-chip-vs-single-device efficiency ratio, recorded by
@@ -165,6 +172,15 @@ INFO_LANES: list[tuple[str, tuple]] = [
     ("ledger_dispatch_gap_s", ("ledger", "dispatch_gap_s")),
     ("ledger_encode_s", ("ledger", "encode_s")),
     ("ledger_h2d_s", ("ledger", "h2d_s")),
+    # Spill-tier ledger buckets (ISSUE 20): disk-seconds are load- and
+    # mode-shaped (the force-spill bench lane pays them on purpose) —
+    # informational context for the gated longhaul_eps /
+    # longhaul_peak_rss_mb lanes above.
+    ("ledger_spill_read_s", ("ledger", "spill_read_s")),
+    ("ledger_spill_write_s", ("ledger", "spill_write_s")),
+    ("longhaul_compress_ratio", ("longhaul", "compress_ratio")),
+    ("longhaul_spill_bytes_written",
+     ("longhaul", "spill_bytes_written")),
     ("sched_ledger_coverage",
      ("detail", "corpus_sched", "ledger", "coverage")),
     ("sched_ledger_overhead_pct",
@@ -189,7 +205,8 @@ INFO_LANES: list[tuple[str, tuple]] = [
 # line carry. check_ledger_record validates both.
 LEDGER_STATS_KEYS = ("launches", "encode_s", "h2d_s", "h2d_bytes",
                      "compile_s", "execute_s", "padding_s",
-                     "straggler_s", "dispatch_gap_s")
+                     "straggler_s", "dispatch_gap_s",
+                     "spill_read_s", "spill_write_s")
 LEDGER_ATT_KEYS = ("wall_s", "coverage", "buckets")
 LEDGER_MIN_COVERAGE = 0.95
 
@@ -288,6 +305,59 @@ def check_fleet_record(rec: dict) -> list[str]:
     if lane.get("verdicts_identical") is not True:
         problems.append("non-degraded fleet lane did not certify "
                         "verdict parity (verdicts_identical != true)")
+    return problems
+
+
+# The zeros-never-absent `longhaul` object every bench record carries
+# (obs.longhaul_stats — spill-tier counters/gauges) and the measured
+# lane shape (bench.bench_longhaul / bench.longhaul_zero_lane) a
+# NON-degraded record's detail.longhaul must carry — the peak-RSS field
+# in particular, since the inverted longhaul_peak_rss_mb gate reads it.
+# check_longhaul_record validates both, mirroring check_fleet_record.
+LONGHAUL_STATS_KEYS = ("spill_writes", "spill_reads",
+                       "spill_bytes_written", "spill_bytes_read",
+                       "spill_evictions", "cache_evictions",
+                       "compress_ratio", "peak_rss_mb")
+LONGHAUL_LANE_KEYS = ("events", "segments", "segments_run",
+                      "survived", "dead_step", "max_frontier",
+                      "escalations", "spilled", "wall_s",
+                      "events_per_sec", "peak_rss_mb",
+                      "rss_budget_mb", "rss_ok",
+                      "verdicts_identical", "crosscheck_events")
+
+
+def check_longhaul_record(rec: dict) -> list[str]:
+    """Schema gate for the long-haul out-of-core lane (ISSUE 20),
+    returning the list of problems (empty = pass). Every record — the
+    degraded paths included — must carry the all-keys `longhaul`
+    spill-stats object (zeros permitted, never absent); a NON-degraded
+    record must additionally carry the measured detail.longhaul lane
+    with the peak-RSS field, the ceiling verdict, and certified
+    spilled-vs-in-RAM verdict parity."""
+    problems: list[str] = []
+    lh = rec.get("longhaul")
+    if not isinstance(lh, dict):
+        return ["record omits the `longhaul` object entirely"]
+    for key in LONGHAUL_STATS_KEYS:
+        if key not in lh:
+            problems.append(f"longhaul object missing key {key!r}")
+    if is_degraded(rec):
+        return problems
+    lane = _dig_raw(rec, ("detail", "longhaul"))
+    if not isinstance(lane, dict):
+        problems.append("non-degraded record omits the detail.longhaul "
+                        "lane")
+        return problems
+    for key in LONGHAUL_LANE_KEYS:
+        if key not in lane:
+            problems.append(f"detail.longhaul missing key {key!r}")
+    if lane.get("verdicts_identical") is not True:
+        problems.append("non-degraded longhaul lane did not certify "
+                        "spilled-vs-in-RAM verdict parity "
+                        "(verdicts_identical != true)")
+    if lane.get("rss_ok") is not True:
+        problems.append("non-degraded longhaul lane exceeded its host "
+                        "RSS budget (rss_ok != true)")
     return problems
 
 
